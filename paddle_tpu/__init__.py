@@ -1,0 +1,65 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+capabilities, rebuilt on JAX/XLA/Pallas/pjit.
+
+Top-level namespace mirrors ``paddle``: tensor ops, nn, optimizer, amp, io,
+distributed, jit, profiler. See SURVEY.md for the capability map against the
+reference (mounted at /root/reference)."""
+
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+# core
+from .framework import dtype as _dtype
+from .framework.dtype import (bfloat16, bool_, complex128, complex64, finfo, float16, float32,
+                              float64, iinfo, int16, int32, int64, int8, uint8)
+from .framework import flags as _flags
+from .framework.flags import get_flags, set_flags
+from .framework.random import Generator, get_rng_state, seed, set_rng_state
+from .device import (CPUPlace, DeviceGuard, Place, TPUPlace, XPUPlace, device_count,
+                     get_device, is_compiled_with_tpu, set_device, synchronize)
+
+# tensor surface
+from .tensor import *  # noqa: F401,F403
+from .tensor import Tensor, to_tensor, is_tensor
+from .tensor.creation import Parameter
+
+# autograd
+from .autograd import no_grad, enable_grad, grad, set_grad_enabled, is_grad_enabled
+from . import autograd
+
+# subsystems (lazy-ish: imported on attribute access to keep import light)
+from . import amp  # noqa: E402
+from . import nn  # noqa: E402
+from . import optimizer  # noqa: E402
+from . import io  # noqa: E402
+from . import distributed  # noqa: E402
+from . import jit  # noqa: E402
+from . import metric  # noqa: E402
+from . import vision  # noqa: E402
+from . import incubate  # noqa: E402
+from . import profiler  # noqa: E402
+from .framework.io import load, save  # noqa: E402
+
+
+def is_compiled_with_cuda() -> bool:
+    """Parity shim: reports False — this build targets TPU."""
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def in_dynamic_mode() -> bool:
+    return True
+
+
+def disable_static(*a, **k) -> None:
+    pass
+
+
+def enable_static(*a, **k) -> None:
+    raise NotImplementedError(
+        "paddle_tpu has no separate static graph mode: use paddle_tpu.jit.to_static "
+        "(whole-step XLA compilation) instead")

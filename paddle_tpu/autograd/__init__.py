@@ -1,0 +1,156 @@
+"""Autograd: eager tape + PyLayer (custom VJP) + functional grad helpers.
+
+Reference surface: `python/paddle/autograd` (backward, PyLayer, no_grad).
+PyLayer is rebuilt on ``jax.custom_vjp`` — the TP/SP parallel layers use it
+exactly like the reference's parallel PyLayers (`mpu/mp_ops.py`)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+
+from .tape import backward as _tape_backward
+from .tape import enable_grad, is_grad_enabled, no_grad, set_grad_enabled
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "is_grad_enabled",
+           "set_grad_enabled", "PyLayer", "PyLayerContext"]
+
+
+def backward(tensors, grad_tensors=None, retain_graph: bool = False) -> None:
+    """paddle.autograd.backward parity: seed multiple roots."""
+    from ..tensor.tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    for t, g in zip(tensors, grad_tensors):
+        _tape_backward(t, g, retain_graph=True)
+    if not retain_graph:
+        from .tape import release_graph
+
+        release_graph(tensors)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,
+         only_inputs=True, allow_unused=False, no_grad_vars=None):
+    """paddle.grad parity (eager): returns grads of ``outputs`` wrt ``inputs``
+    without touching ``.grad`` slots."""
+    from ..tensor.tensor import Tensor
+
+    from .tape import collect_graph, release_graph
+
+    outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    # save/restore .grad for EVERY leaf in the graph — paddle.grad must leave
+    # no side effects on .grad slots, not just on the requested inputs
+    _, leaves = collect_graph(outputs)
+    saved = [(t, t._grad) for t in set(leaves) | set(inputs)]
+    for t, _ in saved:
+        t._grad = None
+    try:
+        gos = grad_outputs if grad_outputs is not None else [None] * len(outputs)
+        keep = create_graph if retain_graph is None else retain_graph  # paddle default
+        for o, go in zip(outputs, gos):
+            _tape_backward(o, go, retain_graph=True)
+        if not keep:
+            release_graph(outputs)
+        results = []
+        for t in inputs:
+            if t._grad is None and not allow_unused:
+                results.append(Tensor(jax.numpy.zeros_like(t._value)))
+            else:
+                results.append(t._grad)
+        return results
+    finally:
+        for t, g in saved:
+            t._grad = g
+
+
+class PyLayerContext:
+    """Context passed to PyLayer.forward/backward (save_for_backward parity)."""
+
+    def __init__(self):
+        self._saved: tuple = ()
+        self.attrs: dict = {}
+
+    def save_for_backward(self, *tensors) -> None:
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+    saved_tensors = property(lambda self: self._saved)
+
+
+class _PyLayerMeta(type):
+    def __call__(cls, *args, **kwargs):  # PyLayer subclasses are not instantiated
+        raise RuntimeError("PyLayer subclasses are used via .apply(), not instantiated")
+
+
+class PyLayer(metaclass=_PyLayerMeta):
+    """User-defined differentiable function (reference:
+    `python/paddle/autograd/py_layer.py`). Subclass with static forward(ctx,
+    *args) and backward(ctx, *grads); call via ``.apply``.
+
+    Implementation: the forward runs eagerly; a tape node is recorded whose
+    vjp calls the user's backward. Inside jit-traced code the same path
+    traces correctly because forward/backward are pure jnp computations.
+    """
+
+    @staticmethod
+    def forward(ctx: PyLayerContext, *args: Any, **kwargs: Any):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx: PyLayerContext, *grads: Any):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args: Any, **kwargs: Any):
+        from ..tensor.tensor import Tensor
+
+        ctx = PyLayerContext()
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+
+        from .tape import TapeNode, is_grad_enabled
+
+        record = is_grad_enabled() and any(not t.stop_gradient for t in tensor_args)
+
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outs, (tuple, list))
+        out_list = list(outs) if multi else [outs]
+        out_tensors = [o if isinstance(o, Tensor) else Tensor(o) for o in out_list]
+
+        if record:
+            for o in out_tensors:
+                o.stop_gradient = False
+
+            def vjp_fn(cts):
+                cts = cts if isinstance(cts, tuple) else (cts,)
+                ct_tensors = [Tensor(c, stop_gradient=True) for c in cts]
+                with no_grad():
+                    gins = cls.backward(ctx, *(ct_tensors if multi else ct_tensors))
+                if isinstance(gins, Tensor) or gins is None:
+                    gins = (gins,)
+                vals = []
+                for g, t in zip(gins, tensor_args):
+                    if g is None:
+                        vals.append(jax.numpy.zeros_like(t._value))
+                    else:
+                        vals.append(g._value if isinstance(g, Tensor) else jax.numpy.asarray(g))
+                return tuple(vals)
+
+            node = TapeNode(cls.__name__, vjp_fn, tensor_args, out_tensors)
+            for i, o in enumerate(out_tensors):
+                o._producer = (node, i)
+
+        if multi:
+            return type(outs)(out_tensors) if isinstance(outs, tuple) else out_tensors
+        return out_tensors[0]
+
+
+class LegacyPyLayer(PyLayer):
+    pass

@@ -1,0 +1,217 @@
+"""Eager autograd: a lightweight tape over ``jax.vjp``.
+
+Design (TPU-first, not a port): the reference builds an explicit GradNode
+graph in C++ (`paddle/fluid/eager/grad_node_info.h:197`,
+`backward.cc:105` RunBackward). On JAX, differentiation is a functional
+transform, so the idiomatic fast path is whole-step ``jax.grad`` under jit
+(see `paddle_tpu.jit`). This tape exists to give *eager* code the
+``loss.backward()`` UX: every recorded op captures the ``jax.vjp`` closure of
+its primal function; ``backward`` walks producers in reverse topological
+order, accumulates cotangents, and deposits ``.grad`` on leaves.
+
+Hooks registered via ``Tensor.register_hook`` fire when the tensor's
+cotangent is finalized — this is the interception point the reference's DP
+reducer uses (`reducer.h:88` AddDistHook), and ours uses it the same way.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["no_grad", "enable_grad", "is_grad_enabled", "TapeNode", "backward", "set_grad_enabled"]
+
+_state = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_state, "grad_enabled", True)
+
+
+def set_grad_enabled(mode: bool) -> None:
+    _state.grad_enabled = bool(mode)
+
+
+class _GradMode:
+    def __init__(self, mode: bool):
+        self._mode = mode
+        self._saved: Optional[bool] = None
+
+    def __enter__(self):
+        self._saved = is_grad_enabled()
+        set_grad_enabled(self._mode)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._saved)
+
+    def __call__(self, fn: Callable) -> Callable:
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with self.__class__(self._mode):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+def no_grad(fn: Optional[Callable] = None):
+    """Context manager / decorator disabling tape recording (paddle.no_grad parity)."""
+    guard = _GradMode(False)
+    return guard(fn) if fn is not None else guard
+
+
+def enable_grad(fn: Optional[Callable] = None):
+    guard = _GradMode(True)
+    return guard(fn) if fn is not None else guard
+
+
+class TapeNode:
+    """One recorded eager op: inputs, vjp closure, output metadata."""
+
+    __slots__ = ("name", "vjp_fn", "inputs", "outputs", "out_avals", "__weakref__")
+
+    def __init__(self, name: str, vjp_fn: Callable, inputs: Sequence[Any],
+                 outputs: Sequence[Any]):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs: Tuple[Any, ...] = tuple(inputs)
+        # Strong refs to outputs are fine: nodes are only reachable from live
+        # tensors (via ._producer), so subgraph lifetime == tensor lifetime.
+        self.outputs: Tuple[Any, ...] = tuple(outputs)
+        self.out_avals = tuple((o._value.shape, o._value.dtype) for o in outputs)
+
+    def release(self) -> None:
+        self.vjp_fn = None  # free residuals
+
+
+def _toposort(root_nodes: List[TapeNode]) -> List[TapeNode]:
+    """Reverse-topological order over producer edges (iterative DFS)."""
+    order: List[TapeNode] = []
+    visited = set()
+    stack: List[Tuple[TapeNode, bool]] = [(n, False) for n in root_nodes]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for t in node.inputs:
+            prod = t._producer
+            if prod is not None and id(prod[0]) not in visited:
+                stack.append((prod[0], False))
+    order.reverse()  # consumers first
+    return order
+
+
+def collect_graph(roots: "List[Any]"):
+    """(nodes, leaves) reachable from ``roots`` via producer edges."""
+    root_nodes = [t._producer[0] for t in roots if t._producer is not None]
+    order = _toposort(root_nodes)
+    leaves = []
+    seen = set()
+    for node in order:
+        for t in node.inputs:
+            if t._producer is None and id(t) not in seen:
+                seen.add(id(t))
+                leaves.append(t)
+    return order, leaves
+
+
+def release_graph(roots: "List[Any]") -> None:
+    """Free vjp residuals + producer links for everything reachable from roots."""
+    order, _ = collect_graph(roots)
+    for node in order:
+        node.release()
+        for o in node.outputs:
+            o._producer = None
+
+
+def backward(loss, grad_tensor=None, retain_graph: bool = False) -> None:
+    """Run reverse-mode accumulation from ``loss``; deposits ``.grad`` on leaves.
+
+    Reference semantics (`eager/backward.cc:105`): grads accumulate across
+    calls until ``clear_grad``; hooks fire as each tensor's grad finalizes.
+    """
+    from ..tensor.tensor import Tensor  # local import to avoid cycle
+
+    if loss._producer is None and loss.stop_gradient:
+        raise RuntimeError("backward() on a tensor that does not require grad")
+
+    if grad_tensor is None:
+        if loss._value.size != 1:
+            raise RuntimeError(
+                f"grad_tensor must be given for non-scalar loss (shape {loss._value.shape})")
+        seed = jnp.ones_like(loss._value)
+    else:
+        seed = grad_tensor._value if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+
+    cotangents = {id(loss): seed}
+    keepalive = {id(loss): loss}
+
+    roots = [loss._producer[0]] if loss._producer is not None else []
+    order = _toposort(roots)
+
+    def finalize(t, g):
+        """Apply hooks; deposit on leaf."""
+        for hook in t._hooks:
+            out = hook(Tensor(g, stop_gradient=True))
+            if out is not None:
+                g = out._value if isinstance(out, Tensor) else jnp.asarray(out)
+        if t._producer is None and not t.stop_gradient:
+            t._accumulate_grad(g)
+        return g
+
+    # hooks on the loss itself / direct leaf case
+    if loss._producer is None:
+        finalize(loss, seed)
+        return
+
+    for node in order:
+        outs_cts = []
+        any_ct = False
+        for o, (shape, dtype) in zip(node.outputs, node.out_avals):
+            ct = cotangents.get(id(o))
+            if ct is None:
+                ct = jnp.zeros(shape, dtype)
+            else:
+                any_ct = True
+            outs_cts.append(ct)
+        if not any_ct or node.vjp_fn is None:
+            continue
+        # run output hooks before propagating (non-leaf hook semantics)
+        outs_cts = [
+            finalize(o, ct) if id(o) in cotangents else ct
+            for o, ct in zip(node.outputs, outs_cts)
+        ]
+        in_cts = node.vjp_fn(tuple(outs_cts) if len(outs_cts) > 1 else outs_cts[0])
+        for t, g in zip(node.inputs, in_cts):
+            if t.stop_gradient and t._producer is None:
+                continue
+            if g is None:
+                continue
+            prev = cotangents.get(id(t))
+            cotangents[id(t)] = g if prev is None else prev + g
+            keepalive[id(t)] = t
+
+    # finalize leaves (tensors that never appear as a visited node's output)
+    produced = {id(o) for node in order for o in node.outputs}
+    for tid, g in cotangents.items():
+        t = keepalive.get(tid)
+        if t is None or tid == id(loss):
+            continue
+        if tid not in produced:
+            finalize(t, g)
+
+    if not retain_graph:
+        for node in order:
+            node.release()
+            for o in node.outputs:
+                o._producer = None

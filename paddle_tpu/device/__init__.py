@@ -1,0 +1,183 @@
+"""Device API: ``set_device`` / ``get_device`` over jax.devices().
+
+Reference behavior: ``paddle.set_device('gpu:0')`` selects the global default
+device every subsequent op runs on (`python/paddle/device/__init__.py`). Here
+the device axis is JAX's platform ('tpu' | 'cpu' | 'gpu') plus an index into
+``jax.devices(platform)``; ``set_device('tpu')`` is the north-star UX.
+
+Unlike CUDA there are no user-visible streams on TPU — XLA owns scheduling —
+so the stream/event API is provided as no-op-compatible objects for parity
+(`paddle.device.Stream` analogue), documented as such.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Union
+
+import jax
+
+__all__ = [
+    "set_device", "get_device", "get_all_devices", "device_count",
+    "is_compiled_with_tpu", "current_device", "DeviceGuard",
+    "Stream", "Event", "synchronize", "XPUPlace", "TPUPlace", "CPUPlace", "Place",
+]
+
+_state = threading.local()
+
+
+def _parse(device: str):
+    device = device.lower().strip()
+    if ":" in device:
+        platform, _, idx = device.partition(":")
+        return platform, int(idx)
+    return device, 0
+
+
+_PLATFORM_ALIASES = {"gpu": "gpu", "cuda": "gpu", "tpu": "tpu", "cpu": "cpu", "xpu": "tpu"}
+
+
+class Place:
+    """Device handle; analogue of phi::Place. Wraps a jax.Device."""
+
+    def __init__(self, jax_device):
+        self._device = jax_device
+
+    @property
+    def jax_device(self):
+        return self._device
+
+    @property
+    def platform(self) -> str:
+        return self._device.platform
+
+    @property
+    def index(self) -> int:
+        return self._device.id
+
+    def __repr__(self) -> str:
+        return f"Place({self.platform}:{self.index})"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Place) and self._device == other._device
+
+    def __hash__(self) -> int:
+        return hash(self._device)
+
+
+def TPUPlace(idx: int = 0) -> Place:
+    return Place(jax.devices("tpu")[idx])
+
+
+def CPUPlace(idx: int = 0) -> Place:
+    return Place(jax.devices("cpu")[idx])
+
+
+XPUPlace = TPUPlace
+
+
+def set_device(device: Union[str, Place]) -> Place:
+    """Select the default device, e.g. ``set_device('tpu')`` / ``'tpu:0'`` / ``'cpu'``."""
+    if isinstance(device, Place):
+        _state.place = device
+        return device
+    platform, idx = _parse(device)
+    platform = _PLATFORM_ALIASES.get(platform, platform)
+    try:
+        devs = jax.devices(platform)
+    except RuntimeError as e:
+        raise RuntimeError(
+            f"no {platform!r} devices visible to JAX (requested {device!r}): {e}"
+        ) from None
+    if idx >= len(devs):
+        raise ValueError(f"device index {idx} out of range: {len(devs)} {platform} device(s)")
+    place = Place(devs[idx])
+    _state.place = place
+    return place
+
+
+def current_device() -> Place:
+    place = getattr(_state, "place", None)
+    if place is None:
+        place = Place(jax.devices()[0])
+        _state.place = place
+    return place
+
+
+def get_device() -> str:
+    p = current_device()
+    return f"{p.platform}:{p.index}"
+
+
+def get_all_devices() -> List[str]:
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def device_count(platform: Optional[str] = None) -> int:
+    try:
+        return len(jax.devices(platform)) if platform else len(jax.devices())
+    except RuntimeError:
+        return 0
+
+
+def is_compiled_with_tpu() -> bool:
+    return device_count("tpu") > 0
+
+
+class DeviceGuard:
+    """Temporarily switch the default device."""
+
+    def __init__(self, device: Union[str, Place]):
+        self._device = device
+        self._saved: Optional[Place] = None
+
+    def __enter__(self):
+        self._saved = current_device()
+        set_device(self._device)
+        return self
+
+    def __exit__(self, *exc):
+        _state.place = self._saved
+
+
+def synchronize(device: Union[str, Place, None] = None) -> None:
+    """Block until all dispatched work is complete (XLA: no-op barrier via a tiny op)."""
+    import jax.numpy as jnp
+
+    jnp.zeros(()).block_until_ready()
+
+
+class Stream:
+    """Parity object: TPU/XLA has no user-visible streams; kept for API shape."""
+
+    def __init__(self, device: Union[str, Place, None] = None, priority: int = 2):
+        self.device = device if isinstance(device, Place) else current_device()
+        self.priority = priority
+
+    def synchronize(self) -> None:
+        synchronize(self.device)
+
+    def wait_event(self, event: "Event") -> None:  # noqa: D401 - parity no-op
+        pass
+
+    def wait_stream(self, stream: "Stream") -> None:
+        pass
+
+    def record_event(self, event: Optional["Event"] = None) -> "Event":
+        return event or Event()
+
+
+class Event:
+    """Parity object for paddle.device.Event."""
+
+    def __init__(self, *args, **kwargs):
+        pass
+
+    def record(self, stream: Optional[Stream] = None) -> None:
+        pass
+
+    def query(self) -> bool:
+        return True
+
+    def synchronize(self) -> None:
+        synchronize()

@@ -1,0 +1,11 @@
+"""Framework internals: flags, dtype, RNG, io (save/load)."""
+
+from . import dtype, flags, random
+from .flags import flag_guard, get_flags, set_flags
+from .random import Generator, default_generator, get_rng_state, key_scope, seed, set_rng_state
+
+__all__ = [
+    "dtype", "flags", "random",
+    "get_flags", "set_flags", "flag_guard",
+    "seed", "Generator", "default_generator", "get_rng_state", "set_rng_state", "key_scope",
+]

@@ -1,0 +1,94 @@
+"""Dtype system: paddle-shaped dtype names over JAX dtypes.
+
+The reference exposes dtypes as ``paddle.float32`` etc. (phi DataType enum,
+`paddle/phi/common/data_type.h`). Here every dtype IS a numpy/jax dtype, so
+user code can pass either the framework alias, a string like ``'float32'``, or
+a numpy dtype interchangeably.
+
+Note on int64: JAX disables 64-bit types by default (x64 mode). For TPU-first
+behavior we keep JAX's default and canonicalize int64→int32 / float64→float32
+unless jax_enable_x64 is set; this matches how XLA programs are actually run
+on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "bfloat16", "float16", "float32", "float64",
+    "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64",
+    "bool_", "complex64", "complex128",
+    "convert_dtype", "canonical_dtype", "is_floating_point", "is_integer",
+    "default_float_dtype", "finfo", "iinfo",
+]
+
+bfloat16 = jnp.bfloat16
+float16 = jnp.float16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+uint16 = jnp.uint16
+uint32 = jnp.uint32
+uint64 = jnp.uint64
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR_ALIASES = {
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "float16": float16, "fp16": float16, "half": float16,
+    "float32": float32, "fp32": float32, "float": float32,
+    "float64": float64, "fp64": float64, "double": float64,
+    "int8": int8, "int16": int16, "int32": int32, "int64": int64, "int": int32,
+    "uint8": uint8, "uint16": uint16, "uint32": uint32, "uint64": uint64,
+    "bool": bool_, "complex64": complex64, "complex128": complex128,
+}
+
+DTypeLike = Union[str, np.dtype, type, Any]
+
+
+def convert_dtype(dtype: DTypeLike) -> np.dtype:
+    """Normalize any dtype spec to a numpy dtype object."""
+    if dtype is None:
+        raise TypeError("dtype must not be None")
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key in _STR_ALIASES:
+            return np.dtype(_STR_ALIASES[key])
+        return np.dtype(key)
+    return np.dtype(dtype)
+
+
+def canonical_dtype(dtype: DTypeLike) -> np.dtype:
+    """Convert + canonicalize for the active x64 mode (int64→int32 on TPU default)."""
+    return np.dtype(jax.dtypes.canonicalize_dtype(convert_dtype(dtype)))
+
+
+def is_floating_point(dtype: DTypeLike) -> bool:
+    return jnp.issubdtype(convert_dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype: DTypeLike) -> bool:
+    return jnp.issubdtype(convert_dtype(dtype), jnp.integer)
+
+
+def default_float_dtype() -> np.dtype:
+    return np.dtype(jnp.float32)
+
+
+def finfo(dtype: DTypeLike):
+    return jnp.finfo(convert_dtype(dtype))
+
+
+def iinfo(dtype: DTypeLike):
+    return jnp.iinfo(convert_dtype(dtype))

@@ -1,0 +1,171 @@
+"""Runtime flag registry.
+
+TPU-native analogue of the reference's exported-flags system
+(`paddle/common/flags.h:349` ExportedFlagInfoMap, `flags_native.cc`): a
+process-global registry of typed flags, bridged to ``FLAGS_*`` environment
+variables, settable from Python via :func:`set_flags` / readable via
+:func:`get_flags` (same user API shape as ``paddle.set_flags``).
+
+Unlike the reference we have no C++ side to sync with; the registry is the
+single source of truth and is consulted lazily by the framework.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, List, Optional, Union
+
+__all__ = [
+    "define_flag",
+    "get_flags",
+    "set_flags",
+    "flag_guard",
+]
+
+_TRUTHY = {"1", "true", "yes", "on", "y", "t"}
+_FALSY = {"0", "false", "no", "off", "n", "f", ""}
+
+
+def _parse_bool(v: Any) -> bool:
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)):
+        return bool(v)
+    s = str(v).strip().lower()
+    if s in _TRUTHY:
+        return True
+    if s in _FALSY:
+        return False
+    raise ValueError(f"cannot parse boolean flag value: {v!r}")
+
+
+@dataclass
+class _FlagInfo:
+    name: str
+    default: Any
+    caster: Callable[[Any], Any]
+    doc: str
+    value: Any
+    is_writable: bool = True
+
+
+class _FlagRegistry:
+    def __init__(self) -> None:
+        self._flags: Dict[str, _FlagInfo] = {}
+        self._lock = threading.RLock()
+
+    def define(self, name: str, default: Any, caster: Callable[[Any], Any], doc: str = "",
+               writable: bool = True) -> None:
+        with self._lock:
+            if name in self._flags:
+                raise ValueError(f"flag {name!r} already defined")
+            value = default
+            # Environment bridge: FLAGS_<name> overrides the default at define
+            # time, mirroring the reference's env-var bridged FLAGS_*.
+            env = os.environ.get(f"FLAGS_{name}")
+            if env is not None:
+                value = caster(env)
+            self._flags[name] = _FlagInfo(name, default, caster, doc, value, writable)
+
+    def get(self, name: str) -> Any:
+        with self._lock:
+            info = self._flags.get(name)
+            if info is None:
+                raise KeyError(f"unknown flag {name!r}")
+            return info.value
+
+    def set(self, name: str, value: Any) -> None:
+        with self._lock:
+            info = self._flags.get(name)
+            if info is None:
+                raise KeyError(f"unknown flag {name!r}")
+            if not info.is_writable:
+                raise ValueError(f"flag {name!r} is not writable at runtime")
+            info.value = info.caster(value)
+
+    def known(self, name: str) -> bool:
+        with self._lock:
+            return name in self._flags
+
+    def all_flags(self) -> List[str]:
+        with self._lock:
+            return sorted(self._flags)
+
+
+_REGISTRY = _FlagRegistry()
+
+
+def define_flag(name: str, default: Any, doc: str = "", *, type: Optional[Callable] = None,
+                writable: bool = True) -> None:
+    """Define a runtime flag. ``type`` defaults to ``type(default)``."""
+    caster: Callable[[Any], Any]
+    if type is not None:
+        caster = type
+    elif isinstance(default, bool):
+        caster = _parse_bool
+    elif default is None:
+        caster = lambda v: v  # noqa: E731
+    else:
+        caster = default.__class__
+    _REGISTRY.define(name, default, caster, doc, writable)
+
+
+def get_flags(flags: Union[str, Iterable[str], None] = None) -> Dict[str, Any]:
+    """Return a dict of flag values (all flags when ``flags`` is None)."""
+    if flags is None:
+        names = _REGISTRY.all_flags()
+    elif isinstance(flags, str):
+        names = [flags]
+    else:
+        names = list(flags)
+    return {n: _REGISTRY.get(n) for n in names}
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """Set flag values from a dict, e.g. ``set_flags({'check_nan_inf': True})``."""
+    for name, value in flags.items():
+        _REGISTRY.set(name, value)
+
+
+class flag_guard:
+    """Context manager that temporarily overrides flags."""
+
+    def __init__(self, **overrides: Any) -> None:
+        self._overrides = overrides
+        self._saved: Dict[str, Any] = {}
+
+    def __enter__(self) -> "flag_guard":
+        for name, value in self._overrides.items():
+            self._saved[name] = _REGISTRY.get(name)
+            _REGISTRY.set(name, value)
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        for name, value in self._saved.items():
+            _REGISTRY.set(name, value)
+
+
+# ---------------------------------------------------------------------------
+# Core flags (subset of the reference's 135 exported flags that matter on TPU;
+# reference list at paddle/common/flags.cc).
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False,
+            "Scan op outputs for NaN/Inf in eager mode (reference: flags.cc:79). "
+            "On TPU also toggles jax debug_nans for jitted code.")
+define_flag("benchmark", False, "Synchronous eager execution (block_until_ready per op).")
+define_flag("use_bf16_default", True,
+            "Prefer bfloat16 (TPU-native) over float16 in AMP when the user asks "
+            "for generic 'half' precision.")
+define_flag("eager_op_jit_cache", True,
+            "Cache per-op jitted callables keyed by (op, shapes, dtypes) — the "
+            "KernelKey-style dispatch memo.")
+define_flag("tracer_mode", "eager", "eager | jit — default execution mode hint.")
+define_flag("allocator_strategy", "auto_growth",
+            "Kept for API parity; XLA's BFC allocator manages TPU HBM.")
+define_flag("comm_timeout_seconds", 1800.0,
+            "Collective watchdog timeout (reference: CommTaskManager).")
+define_flag("log_level", "INFO", "Framework log level.")
+define_flag("seed_offset_by_rank", True,
+            "Offset the global seed by process rank for per-host RNG streams.")

@@ -1,0 +1,5 @@
+"""Placeholder save/load — populated in the io milestone."""
+def save(obj, path, **kw):
+    raise NotImplementedError
+def load(path, **kw):
+    raise NotImplementedError

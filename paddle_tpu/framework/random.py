@@ -1,0 +1,138 @@
+"""RNG state: Generator-shaped management of JAX PRNG keys.
+
+Reference: phi ``Generator`` (`paddle/phi/core/generator.h`) — a per-device
+stateful RNG seeded by ``paddle.seed``. JAX's PRNG is functional (explicit
+keys), which is the TPU-idiomatic design: inside jitted code, keys must be
+threaded explicitly. This module provides BOTH:
+
+- a stateful default Generator for eager ergonomics (`paddle.seed`,
+  implicit key splitting per op), and
+- :func:`next_key` / :class:`Generator` for functional code to draw explicit
+  keys from.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+import numpy as np
+
+__all__ = ["seed", "Generator", "default_generator", "next_key", "get_rng_state", "set_rng_state"]
+
+_DEFAULT_SEED = 0
+
+
+class Generator:
+    """Stateful wrapper over a JAX PRNG key chain.
+
+    Each :meth:`next_key` splits the internal key; deterministic given the
+    seed and call sequence. Thread-safe.
+    """
+
+    def __init__(self, seed: int = _DEFAULT_SEED):
+        self._lock = threading.Lock()
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int) -> "Generator":
+        with getattr(self, "_lock", threading.Lock()):
+            self._seed = int(seed)
+            self._key = jax.random.PRNGKey(self._seed)
+            self._counter = 0
+        return self
+
+    def next_key(self) -> jax.Array:
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            self._counter += 1
+            return sub
+
+    def split(self, n: int):
+        with self._lock:
+            self._key, *subs = jax.random.split(self._key, n + 1)
+            self._counter += n
+            return list(subs)
+
+    @property
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def get_state(self):
+        with self._lock:
+            return {"seed": self._seed, "key": np.asarray(self._key), "counter": self._counter}
+
+    def set_state(self, state) -> None:
+        with self._lock:
+            self._seed = int(state["seed"])
+            self._key = jax.numpy.asarray(state["key"], dtype=jax.numpy.uint32)
+            self._counter = int(state["counter"])
+
+
+default_generator = Generator()
+
+_trace_state = threading.local()
+
+
+class key_scope:
+    """Provide a (possibly traced) base PRNG key for a region of code.
+
+    Inside a whole-step ``jit``, stateful RNG would be constant-folded; code
+    wrapped in ``key_scope(key)`` instead derives per-call keys via
+    ``fold_in(base, counter)`` so randomness is traced and varies per step.
+    The training loop passes a fresh key each step (functional, TPU-idiomatic).
+    """
+
+    def __init__(self, key):
+        self._key = key
+
+    def __enter__(self):
+        stack = getattr(_trace_state, "stack", None)
+        if stack is None:
+            stack = _trace_state.stack = []
+        stack.append([self._key, 0])
+        return self
+
+    def __exit__(self, *exc):
+        _trace_state.stack.pop()
+
+
+def _scoped_key():
+    stack = getattr(_trace_state, "stack", None)
+    if not stack:
+        return None
+    entry = stack[-1]
+    entry[1] += 1
+    return jax.random.fold_in(entry[0], entry[1])
+
+
+def seed(value: int) -> Generator:
+    """Set the global seed (``paddle.seed`` parity). Optionally offset by rank."""
+    from .flags import get_flags
+
+    offset = 0
+    if get_flags("seed_offset_by_rank")["seed_offset_by_rank"]:
+        try:
+            import jax.distributed  # noqa: F401
+
+            offset = jax.process_index()
+        except Exception:
+            offset = 0
+    return default_generator.manual_seed(int(value) + offset)
+
+
+def next_key(generator: Optional[Generator] = None) -> jax.Array:
+    """Draw a fresh PRNG key: from the active :class:`key_scope` when inside
+    one (trace-safe), else from ``generator`` / the global generator."""
+    scoped = _scoped_key()
+    if scoped is not None and generator is None:
+        return scoped
+    return (generator or default_generator).next_key()
+
+
+def get_rng_state():
+    return default_generator.get_state()
+
+
+def set_rng_state(state) -> None:
+    default_generator.set_state(state)

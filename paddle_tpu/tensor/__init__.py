@@ -1,0 +1,121 @@
+"""paddle_tpu.tensor — the full tensor API surface + Tensor method table.
+
+Mirrors the reference's split (`python/paddle/tensor/__init__.py` attaches
+functions as Tensor methods via a method table); here we attach jnp-backed
+functions and the arithmetic dunders."""
+
+from __future__ import annotations
+
+from . import creation, einsum as _einsum_mod, linalg, logic, manipulation, math, random, search
+from .tensor import Tensor, apply_op, is_tensor, to_tensor, unwrap, wrap
+from ._op_utils import ensure_tensor
+
+# re-export everything public from the op modules
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+
+# ---------------------------------------------------------------------------
+# arithmetic dunders
+# ---------------------------------------------------------------------------
+Tensor.__add__ = lambda self, other: math.add(self, other)
+Tensor.__radd__ = lambda self, other: math.add(other, self)
+Tensor.__sub__ = lambda self, other: math.subtract(self, other)
+Tensor.__rsub__ = lambda self, other: math.subtract(other, self)
+Tensor.__mul__ = lambda self, other: math.multiply(self, other)
+Tensor.__rmul__ = lambda self, other: math.multiply(other, self)
+Tensor.__truediv__ = lambda self, other: math.divide(self, other)
+Tensor.__rtruediv__ = lambda self, other: math.divide(other, self)
+Tensor.__floordiv__ = lambda self, other: math.floor_divide(self, other)
+Tensor.__rfloordiv__ = lambda self, other: math.floor_divide(other, self)
+Tensor.__mod__ = lambda self, other: math.mod(self, other)
+Tensor.__rmod__ = lambda self, other: math.mod(other, self)
+Tensor.__pow__ = lambda self, other: math.pow(self, other)
+Tensor.__rpow__ = lambda self, other: math.pow(other, self)
+Tensor.__neg__ = lambda self: math.neg(self)
+Tensor.__abs__ = lambda self: math.abs(self)
+Tensor.__matmul__ = lambda self, other: math.matmul(self, other)
+Tensor.__rmatmul__ = lambda self, other: math.matmul(other, self)
+Tensor.__eq__ = lambda self, other: logic.equal(self, other)
+Tensor.__ne__ = lambda self, other: logic.not_equal(self, other)
+Tensor.__lt__ = lambda self, other: logic.less_than(self, other)
+Tensor.__le__ = lambda self, other: logic.less_equal(self, other)
+Tensor.__gt__ = lambda self, other: logic.greater_than(self, other)
+Tensor.__ge__ = lambda self, other: logic.greater_equal(self, other)
+Tensor.__and__ = lambda self, other: math.bitwise_and(self, other)
+Tensor.__or__ = lambda self, other: math.bitwise_or(self, other)
+Tensor.__xor__ = lambda self, other: math.bitwise_xor(self, other)
+Tensor.__invert__ = lambda self: math.bitwise_not(self)
+
+# in-place arithmetic: functional rebind keeps autograd correct
+Tensor.__iadd__ = lambda self, other: self._rebind(math.add(self, other))
+Tensor.__isub__ = lambda self, other: self._rebind(math.subtract(self, other))
+Tensor.__imul__ = lambda self, other: self._rebind(math.multiply(self, other))
+Tensor.__itruediv__ = lambda self, other: self._rebind(math.divide(self, other))
+
+# ---------------------------------------------------------------------------
+# method table: every op module function whose first arg is a tensor
+# ---------------------------------------------------------------------------
+_METHODS = {
+    # math
+    "abs", "ceil", "floor", "round", "trunc", "frac", "exp", "expm1", "log", "log2",
+    "log10", "log1p", "sqrt", "rsqrt", "sin", "cos", "tan", "asin", "acos", "atan",
+    "sinh", "cosh", "tanh", "asinh", "acosh", "atanh", "erf", "erfinv", "sigmoid",
+    "reciprocal", "sign", "neg", "square", "digamma", "lgamma", "logit", "deg2rad",
+    "rad2deg", "conj", "angle",
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod", "remainder", "pow",
+    "maximum", "minimum", "fmax", "fmin", "atan2", "logaddexp", "hypot",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "scale", "lerp", "clip", "nan_to_num", "stanh", "increment",
+    "sum", "mean", "prod", "max", "min", "amax", "amin", "nansum", "nanmean", "all",
+    "any", "logsumexp", "count_nonzero", "cumsum", "cumprod", "cummax", "cummin",
+    "trace", "diagonal", "matmul", "mm", "dot", "bmm", "inner", "outer", "kron",
+    "addmm", "isnan", "isinf", "isfinite", "isclose", "allclose", "equal_all",
+    "std", "var", "median", "quantile", "histogram",
+    # manipulation
+    "reshape", "reshape_", "flatten", "transpose", "moveaxis", "swapaxes", "squeeze",
+    "squeeze_", "unsqueeze", "unsqueeze_", "concat", "split", "chunk", "unbind",
+    "unstack", "tile", "expand", "expand_as", "broadcast_to", "flip", "rot90", "roll",
+    "gather", "gather_nd", "scatter", "scatter_", "scatter_nd_add", "index_select",
+    "index_sample", "index_add", "index_put", "take_along_axis", "put_along_axis",
+    "masked_select", "masked_fill", "strided_slice", "repeat_interleave", "pad",
+    "unique", "unique_consecutive", "as_strided", "view", "tensordot", "crop",
+    # logic
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than", "less_equal",
+    "logical_and", "logical_or", "logical_xor", "logical_not", "is_empty",
+    # search
+    "argmax", "argmin", "argsort", "sort", "topk", "kthvalue", "mode", "where",
+    "nonzero", "searchsorted", "bucketize", "index_fill", "masked_scatter", "isin",
+    # linalg
+    "norm", "cholesky", "qr", "svd", "inv", "pinv", "solve", "triangular_solve",
+    "det", "slogdet", "eig", "eigh", "eigvals", "eigvalsh", "matrix_power",
+    "matrix_rank", "cond", "cov", "corrcoef",
+    # creation-ish
+    "tril", "triu", "diag", "diagflat", "diag_embed", "zeros_like", "ones_like",
+    "full_like",
+    # random
+    "uniform_", "normal_", "bernoulli_", "exponential_", "multinomial",
+}
+
+_MODULES = (math, manipulation, logic, search, linalg, creation, random)
+
+
+def _attach_methods() -> None:
+    for name in _METHODS:
+        fn = None
+        for mod in _MODULES:
+            fn = getattr(mod, name, None)
+            if fn is not None:
+                break
+        if fn is None:
+            continue
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
+
+
+_attach_methods()

@@ -1,0 +1,69 @@
+"""Shared helpers for defining eager ops over jnp.
+
+Scalar operands are closed over (not converted to arrays) so JAX weak-typing
+keeps ``bf16_tensor + 2.0`` in bfloat16 — important for TPU AMP correctness.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax.numpy as jnp
+
+from .tensor import Tensor, apply_op
+
+__all__ = ["ensure_tensor", "unary_op", "binary_op", "nondiff"]
+
+
+def ensure_tensor(x) -> Tensor:
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def unary_op(name: str, jfn: Callable, differentiable: bool = True):
+    def op(x, name_: Any = None, **kwargs):
+        x = ensure_tensor(x)
+        fn = (lambda v: jfn(v, **kwargs)) if kwargs else jfn
+        if differentiable:
+            return apply_op(name, fn, (x,))
+        return Tensor(fn(x._value))
+
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = f"Elementwise/unary op `{name}` (jnp-backed)."
+    return op
+
+
+def binary_op(name: str, jfn: Callable, differentiable: bool = True):
+    def op(x, y, name_: Any = None):
+        xs, ys = isinstance(x, Tensor), isinstance(y, Tensor)
+        if xs and ys:
+            fn, tensors = jfn, (x, y)
+        elif xs:
+            fn, tensors = (lambda v, _y=y: jfn(v, _y)), (x,)
+        elif ys:
+            fn, tensors = (lambda w, _x=x: jfn(_x, w)), (y,)
+        else:
+            return Tensor(jfn(jnp.asarray(x), jnp.asarray(y)))
+        if differentiable:
+            return apply_op(name, fn, tensors)
+        vals = [t._value for t in tensors]
+        return Tensor(fn(*vals))
+
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = f"Broadcasting binary op `{name}` (jnp-backed)."
+    return op
+
+
+def nondiff(name: str, jfn: Callable):
+    """Non-differentiable op (integer/bool outputs): never recorded on the tape."""
+
+    def op(*args, **kwargs):
+        vals = [a._value if isinstance(a, Tensor) else a for a in args]
+        out = jfn(*vals, **kwargs)
+        if isinstance(out, tuple):
+            return tuple(Tensor(o) for o in out)
+        return Tensor(out)
+
+    op.__name__ = name
+    return op

@@ -1,0 +1,200 @@
+"""Creation ops (reference surface: `python/paddle/tensor/creation.py`)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.dtype import canonical_dtype, default_float_dtype
+from ._op_utils import ensure_tensor
+from .tensor import Tensor, apply_op, to_tensor  # noqa: F401 re-export to_tensor
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return default
+    return canonical_dtype(dtype)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy().reshape(-1).tolist())
+    if isinstance(shape, (list, tuple)):
+        return tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in shape)
+    return (int(shape),)
+
+
+def zeros(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype, default_float_dtype())))
+
+
+def ones(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype, default_float_dtype())))
+
+
+def full(shape, fill_value, dtype=None, name=None) -> Tensor:
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None) -> Tensor:
+    # XLA has no uninitialized memory; zeros is the honest equivalent.
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.zeros_like(ensure_tensor(x)._value, dtype=_dt(dtype)))
+
+
+def ones_like(x, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.ones_like(ensure_tensor(x)._value, dtype=_dt(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.full_like(ensure_tensor(x)._value, fill_value, dtype=_dt(dtype)))
+
+
+def empty_like(x, dtype=None, name=None) -> Tensor:
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
+    def _scalar(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    start, end, step = _scalar(start), _scalar(end), _scalar(step)
+    if end is None:
+        start, end = 0, start
+    return Tensor(jnp.arange(start, end, step, dtype=_dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None) -> Tensor:
+    s = start.item() if isinstance(start, Tensor) else start
+    e = stop.item() if isinstance(stop, Tensor) else stop
+    n = int(num.item()) if isinstance(num, Tensor) else int(num)
+    return Tensor(jnp.linspace(s, e, n, dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.eye(int(num_rows), None if num_columns is None else int(num_columns),
+                          dtype=_dt(dtype, default_float_dtype())))
+
+
+def diag(x, offset=0, padding_value=0, name=None) -> Tensor:
+    x = ensure_tensor(x)
+
+    def fn(v):
+        if v.ndim == 1 and padding_value != 0:
+            n = v.shape[0] + builtins_abs(offset)
+            out = jnp.full((n, n), padding_value, v.dtype)
+            idx = jnp.arange(v.shape[0])
+            if offset >= 0:
+                return out.at[idx, idx + offset].set(v)
+            return out.at[idx - offset, idx].set(v)
+        return jnp.diag(v, k=offset)
+
+    return apply_op("diag", fn, (x,))
+
+
+builtins_abs = abs
+
+
+def diagflat(x, offset=0, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("diagflat", lambda v: jnp.diagflat(v, k=offset), (x,))
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None) -> Tensor:
+    x = ensure_tensor(x)
+
+    def fn(v):
+        n = v.shape[-1] + builtins_abs(offset)
+        out = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        idx = jnp.arange(v.shape[-1])
+        if offset >= 0:
+            out = out.at[..., idx, idx + offset].set(v)
+        else:
+            out = out.at[..., idx - offset, idx].set(v)
+        if (dim1, dim2) != (-2, -1):
+            out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+        return out
+
+    return apply_op("diag_embed", fn, (x,))
+
+
+def tril(x, diagonal=0, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("tril", lambda v: jnp.tril(v, k=diagonal), (x,))
+
+
+def triu(x, diagonal=0, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("triu", lambda v: jnp.triu(v, k=diagonal), (x,))
+
+
+def tril_indices(row, col, offset=0, dtype="int64", name=None) -> Tensor:
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return Tensor(jnp.stack([r, c]))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None) -> Tensor:
+    r, c = jnp.triu_indices(row, k=offset, m=col or row)
+    return Tensor(jnp.stack([r, c]))
+
+
+def meshgrid(*args, **kwargs):
+    ts = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    ts = [ensure_tensor(t) for t in ts]
+    outs = apply_op("meshgrid", lambda *vs: tuple(jnp.meshgrid(*vs, indexing="ij")), ts,
+                    multi_out=True)
+    return list(outs)
+
+
+def assign(x, output: Optional[Tensor] = None) -> Tensor:
+    x = ensure_tensor(x) if not isinstance(x, Tensor) else x
+    out = apply_op("assign", jnp.copy, (x,))
+    if output is not None:
+        output._rebind(out)
+        return output
+    return out
+
+
+def clone(x, name=None) -> Tensor:
+    return ensure_tensor(x).clone()
+
+
+def numel(x, name=None) -> Tensor:
+    return Tensor(jnp.asarray(ensure_tensor(x)._value.size))
+
+
+def one_hot(x, num_classes, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return Tensor(jax.nn.one_hot(x._value, num_classes, dtype=default_float_dtype()))
+
+
+def complex(real, imag, name=None) -> Tensor:
+    real, imag = ensure_tensor(real), ensure_tensor(imag)
+    return apply_op("complex", jax.lax.complex, (real, imag))
+
+
+def as_complex(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("as_complex", lambda v: jax.lax.complex(v[..., 0], v[..., 1]), (x,))
+
+
+def as_real(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("as_real", lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), (x,))
+
+
+def Parameter(value, stop_gradient=False, name=None) -> Tensor:
+    t = Tensor(value, stop_gradient=stop_gradient, name=name)
+    t.persistable = True
+    return t

@@ -1,0 +1,14 @@
+"""einsum (reference: `python/paddle/tensor/einsum.py`) — jnp.einsum is
+MXU-native under XLA."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._op_utils import ensure_tensor
+from .tensor import apply_op
+
+
+def einsum(equation, *operands, name=None):
+    ts = tuple(ensure_tensor(t) for t in operands)
+    return apply_op("einsum", lambda *vs: jnp.einsum(equation, *vs), ts)

@@ -1,0 +1,209 @@
+"""Linear algebra (reference: `python/paddle/tensor/linalg.py`, phi kernels
+backed by cuSOLVER there; jnp.linalg/lax here — XLA lowers decompositions to
+its own TPU-compatible implementations)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._op_utils import ensure_tensor
+from .tensor import Tensor, apply_op
+from .math import matmul, dot, bmm  # noqa: F401 (re-export, paddle.linalg.matmul)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+
+    def fn(v):
+        if p is None or p == "fro":
+            if axis is None:
+                return jnp.sqrt(jnp.sum(jnp.square(v)))
+            return jnp.linalg.norm(v, ord=None, axis=_ax(axis), keepdims=keepdim)
+        if p == float("inf") or p == "inf":
+            return jnp.max(jnp.abs(v), axis=_ax(axis), keepdims=keepdim)
+        if p == float("-inf") or p == "-inf":
+            return jnp.min(jnp.abs(v), axis=_ax(axis), keepdims=keepdim)
+        if axis is None:
+            return jnp.sum(jnp.abs(v) ** p) ** (1.0 / p)
+        return jnp.linalg.norm(v, ord=p, axis=_ax(axis), keepdims=keepdim)
+
+    return apply_op("norm", fn, (x,))
+
+
+def _ax(axis):
+    if isinstance(axis, (list, tuple)):
+        return tuple(axis)
+    return axis
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None) -> Tensor:
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("matrix_norm",
+                    lambda v: jnp.linalg.norm(v, ord=p, axis=tuple(axis), keepdims=keepdim), (x,))
+
+
+def cholesky(x, upper=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+
+    def fn(v):
+        l = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+
+    return apply_op("cholesky", fn, (x,))
+
+
+def cholesky_solve(x, y, upper=False, name=None) -> Tensor:
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def fn(b, l):
+        lo = jnp.swapaxes(l, -1, -2) if upper else l
+        z = jax.scipy.linalg.solve_triangular(lo, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(jnp.swapaxes(lo, -1, -2), z, lower=False)
+
+    return apply_op("cholesky_solve", fn, (x, y))
+
+
+def qr(x, mode="reduced", name=None):
+    x = ensure_tensor(x)
+    q, r = apply_op("qr", lambda v: jnp.linalg.qr(v, mode=mode), (x,), multi_out=True)
+    return q, r
+
+
+def svd(x, full_matrices=False, name=None):
+    x = ensure_tensor(x)
+    u, s, vh = apply_op("svd", lambda v: jnp.linalg.svd(v, full_matrices=full_matrices), (x,),
+                        multi_out=True)
+    return u, s, vh
+
+
+def svdvals(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("svdvals", lambda v: jnp.linalg.svd(v, compute_uv=False), (x,))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    x = ensure_tensor(x)
+    v = x._value
+    qq = q or min(6, *v.shape[-2:])
+    if center:
+        v = v - jnp.mean(v, axis=-2, keepdims=True)
+    u, s, vh = jnp.linalg.svd(v, full_matrices=False)
+    return Tensor(u[..., :qq]), Tensor(s[..., :qq]), Tensor(jnp.swapaxes(vh, -1, -2)[..., :qq])
+
+
+def inv(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("inv", jnp.linalg.inv, (x,))
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("pinv", lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian), (x,))
+
+
+def solve(x, y, name=None) -> Tensor:
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply_op("solve", jnp.linalg.solve, (x, y))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None) -> Tensor:
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular)
+
+    return apply_op("triangular_solve", fn, (x, y))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    sol, res, rank, sv = jnp.linalg.lstsq(x._value, y._value, rcond=rcond)
+    return Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv)
+
+
+def det(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("det", jnp.linalg.det, (x,))
+
+
+def slogdet(x, name=None):
+    x = ensure_tensor(x)
+    sign, logdet = apply_op("slogdet", lambda v: tuple(jnp.linalg.slogdet(v)), (x,),
+                            multi_out=True)
+    from .manipulation import stack
+
+    return stack([sign, logdet], axis=0)
+
+
+def eig(x, name=None):
+    import numpy as np
+
+    v = np.asarray(ensure_tensor(x)._value)
+    w, vec = np.linalg.eig(v)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(vec))
+
+
+def eigh(x, UPLO="L", name=None):
+    x = ensure_tensor(x)
+    w, v = apply_op("eigh", lambda a: jnp.linalg.eigh(a, UPLO=UPLO), (x,), multi_out=True)
+    return w, v
+
+
+def eigvals(x, name=None) -> Tensor:
+    import numpy as np
+
+    v = np.asarray(ensure_tensor(x)._value)
+    return Tensor(jnp.asarray(np.linalg.eigvals(v)))
+
+
+def eigvalsh(x, UPLO="L", name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("eigvalsh", lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), (x,))
+
+
+def matrix_power(x, n, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("matrix_power", lambda v: jnp.linalg.matrix_power(v, n), (x,))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return Tensor(jnp.linalg.matrix_rank(x._value, rtol=tol))
+
+
+def cond(x, p=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return Tensor(jnp.linalg.cond(x._value, p=p))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    fw = None if fweights is None else ensure_tensor(fweights)._value
+    aw = None if aweights is None else ensure_tensor(aweights)._value
+    return apply_op("cov", lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0,
+                                             fweights=fw, aweights=aw), (x,))
+
+
+def corrcoef(x, rowvar=True, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("corrcoef", lambda v: jnp.corrcoef(v, rowvar=rowvar), (x,))
+
+
+def multi_dot(x, name=None) -> Tensor:
+    ts = [ensure_tensor(t) for t in x]
+    return apply_op("multi_dot", lambda *vs: jnp.linalg.multi_dot(list(vs)), tuple(ts))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    import numpy as np
+
+    v = np.asarray(ensure_tensor(x)._value)
+    h, e = np.histogramdd(v, bins=bins, range=ranges, density=density,
+                          weights=None if weights is None else np.asarray(weights._value))
+    return Tensor(jnp.asarray(h)), [Tensor(jnp.asarray(i)) for i in e]

@@ -1,0 +1,49 @@
+"""Comparison / logical ops (bool outputs, never on the tape)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._op_utils import ensure_tensor
+from .tensor import Tensor
+
+
+def _cmp(name, jfn):
+    def op(x, y, name_=None):
+        xv = x._value if isinstance(x, Tensor) else x
+        yv = y._value if isinstance(y, Tensor) else y
+        return Tensor(jfn(xv, yv))
+
+    op.__name__ = name
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+
+
+def logical_not(x, out=None, name=None) -> Tensor:
+    return Tensor(jnp.logical_not(ensure_tensor(x)._value))
+
+
+def is_empty(x, name=None) -> Tensor:
+    return Tensor(jnp.asarray(ensure_tensor(x)._value.size == 0))
+
+
+def is_complex(x) -> bool:
+    return jnp.issubdtype(ensure_tensor(x)._value.dtype, jnp.complexfloating)
+
+
+def is_floating_point(x) -> bool:
+    return jnp.issubdtype(ensure_tensor(x)._value.dtype, jnp.floating)
+
+
+def is_integer(x) -> bool:
+    return jnp.issubdtype(ensure_tensor(x)._value.dtype, jnp.integer)

@@ -1,0 +1,472 @@
+"""Shape/layout manipulation ops (reference: `python/paddle/tensor/manipulation.py`).
+
+All static-shape friendly: reshape/split sizes are resolved at trace time so
+XLA sees fixed shapes (TPU requirement)."""
+
+from __future__ import annotations
+
+import builtins
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ._op_utils import ensure_tensor, nondiff
+from .tensor import Tensor, apply_op
+
+
+def _shape_arg(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy().reshape(-1).tolist())
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def reshape(x, shape, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    s = _shape_arg(shape)
+    return apply_op("reshape", lambda v: v.reshape(s), (x,))
+
+
+def reshape_(x, shape, name=None) -> Tensor:
+    return x._rebind(reshape(x, shape))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    nd = x.ndim
+
+    def fn(v):
+        sa = start_axis % nd if nd else 0
+        so = stop_axis % nd if nd else 0
+        new_shape = v.shape[:sa] + (-1,) + v.shape[so + 1:]
+        return v.reshape(new_shape)
+
+    return apply_op("flatten", fn, (x,))
+
+
+def transpose(x, perm=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    p = None if perm is None else tuple(int(i) for i in perm)
+    return apply_op("transpose", lambda v: jnp.transpose(v, p), (x,))
+
+
+def moveaxis(x, source, destination, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("moveaxis", lambda v: jnp.moveaxis(v, source, destination), (x,))
+
+
+def swapaxes(x, axis0, axis1, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("swapaxes", lambda v: jnp.swapaxes(v, axis0, axis1), (x,))
+
+
+def squeeze(x, axis=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+
+    def fn(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(a % v.ndim for a in axes if v.shape[a % v.ndim] == 1)
+        return jnp.squeeze(v, axis=axes) if axes else v
+
+    return apply_op("squeeze", fn, (x,))
+
+
+def unsqueeze(x, axis, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = tuple(int(a._value) if isinstance(a, Tensor) else int(a) for a in axes)
+    return apply_op("unsqueeze", lambda v: jnp.expand_dims(v, axes), (x,))
+
+
+squeeze_ = lambda x, axis=None, name=None: x._rebind(squeeze(x, axis))  # noqa: E731
+unsqueeze_ = lambda x, axis, name=None: x._rebind(unsqueeze(x, axis))  # noqa: E731
+
+
+def concat(x: Sequence, axis=0, name=None) -> Tensor:
+    ts = [ensure_tensor(t) for t in x]
+    ax = int(axis._value) if isinstance(axis, Tensor) else int(axis)
+    return apply_op("concat", lambda *vs: jnp.concatenate(vs, axis=ax), tuple(ts))
+
+
+def stack(x: Sequence, axis=0, name=None) -> Tensor:
+    ts = [ensure_tensor(t) for t in x]
+    return apply_op("stack", lambda *vs: jnp.stack(vs, axis=axis), tuple(ts))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = ensure_tensor(x)
+    ax = int(axis._value) if isinstance(axis, Tensor) else int(axis)
+    dim = x.shape[ax]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: axis {ax} size {dim} is not divisible by {num_or_sections}")
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = [int(s) for s in num_or_sections]
+        neg = [i for i, s in enumerate(sections) if s < 0]
+        if neg:
+            sections[neg[0]] = dim - builtins.sum(s for s in sections if s >= 0)
+    offsets = []
+    acc = 0
+    for s in sections[:-1]:
+        acc += s
+        offsets.append(acc)
+    outs = apply_op("split", lambda v: tuple(jnp.split(v, offsets, axis=ax)), (x,),
+                    multi_out=True)
+    return list(outs)
+
+
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    x = ensure_tensor(x)
+    n = x.shape[axis]
+    outs = apply_op(
+        "unbind",
+        lambda v: tuple(jnp.squeeze(p, axis=axis) for p in jnp.split(v, n, axis=axis)),
+        (x,), multi_out=True)
+    return list(outs)
+
+
+unstack = unbind
+
+
+def tile(x, repeat_times, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    reps = _shape_arg(repeat_times)
+    return apply_op("tile", lambda v: jnp.tile(v, reps), (x,))
+
+
+def expand(x, shape, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    s = _shape_arg(shape)
+
+    def fn(v):
+        tgt = tuple(v.shape[i - (len(s) - v.ndim)] if d == -1 else d for i, d in enumerate(s))
+        return jnp.broadcast_to(v, tgt)
+
+    return apply_op("expand", fn, (x,))
+
+
+def expand_as(x, y, name=None) -> Tensor:
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    tgt = tuple(y.shape)
+    return apply_op("expand_as", lambda v: jnp.broadcast_to(v, tgt), (x,))
+
+
+def broadcast_to(x, shape, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    s = _shape_arg(shape)
+    return apply_op("broadcast_to", lambda v: jnp.broadcast_to(v, s), (x,))
+
+
+def broadcast_tensors(inputs, name=None):
+    ts = [ensure_tensor(t) for t in inputs]
+    outs = apply_op("broadcast_tensors", lambda *vs: tuple(jnp.broadcast_arrays(*vs)),
+                    tuple(ts), multi_out=True)
+    return list(outs)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def flip(x, axis, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("flip", lambda v: jnp.flip(v, axis=axis), (x,))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("rot90", lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), (x,))
+
+
+def roll(x, shifts, axis=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("roll", lambda v: jnp.roll(v, shifts, axis=axis), (x,))
+
+
+def gather(x, index, axis=0, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    idx = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+    ax = int(axis._value) if isinstance(axis, Tensor) else int(axis)
+    return apply_op("gather", lambda v: jnp.take(v, idx.reshape(-1) if idx.ndim > 1 else idx,
+                                                 axis=ax), (x,))
+
+
+def gather_nd(x, index, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    idx = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def fn(v):
+        return v[tuple(jnp.moveaxis(idx, -1, 0))]
+
+    return apply_op("gather_nd", fn, (x,))
+
+
+def scatter(x, index, updates, overwrite=True, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    updates = ensure_tensor(updates)
+    idx = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+    idx = idx.reshape(-1)
+
+    def fn(v, u):
+        if overwrite:
+            return v.at[idx].set(u.astype(v.dtype))
+        zeroed = v.at[idx].set(jnp.zeros_like(u, v.dtype))
+        return zeroed.at[idx].add(u.astype(v.dtype))
+
+    return apply_op("scatter", fn, (x, updates))
+
+
+def scatter_(x, index, updates, overwrite=True, name=None) -> Tensor:
+    return x._rebind(scatter(x, index, updates, overwrite))
+
+
+def scatter_nd_add(x, index, updates, name=None) -> Tensor:
+    x, updates = ensure_tensor(x), ensure_tensor(updates)
+    idx = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def fn(v, u):
+        return v.at[tuple(jnp.moveaxis(idx, -1, 0))].add(u.astype(v.dtype))
+
+    return apply_op("scatter_nd_add", fn, (x, updates))
+
+
+def scatter_nd(index, updates, shape, name=None) -> Tensor:
+    updates = ensure_tensor(updates)
+    idx = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+    s = _shape_arg(shape)
+
+    def fn(u):
+        return jnp.zeros(s, u.dtype).at[tuple(jnp.moveaxis(idx, -1, 0))].add(u)
+
+    return apply_op("scatter_nd", fn, (updates,))
+
+
+def index_select(x, index, axis=0, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    idx = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+    return apply_op("index_select", lambda v: jnp.take(v, idx, axis=axis), (x,))
+
+
+def index_sample(x, index) -> Tensor:
+    x = ensure_tensor(x)
+    idx = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+    return apply_op("index_sample",
+                    lambda v: jnp.take_along_axis(v, idx, axis=1), (x,))
+
+
+def index_add(x, index, axis, value, name=None) -> Tensor:
+    x, value = ensure_tensor(x), ensure_tensor(value)
+    idx = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def fn(v, u):
+        vm = jnp.moveaxis(v, axis, 0)
+        um = jnp.moveaxis(u, axis, 0)
+        return jnp.moveaxis(vm.at[idx].add(um.astype(v.dtype)), 0, axis)
+
+    return apply_op("index_add", fn, (x, value))
+
+
+def index_put(x, indices, value, accumulate=False, name=None) -> Tensor:
+    x, value = ensure_tensor(x), ensure_tensor(value)
+    idx = tuple(i._value if isinstance(i, Tensor) else jnp.asarray(i) for i in indices)
+
+    def fn(v, u):
+        if accumulate:
+            return v.at[idx].add(u.astype(v.dtype))
+        return v.at[idx].set(u.astype(v.dtype))
+
+    return apply_op("index_put", fn, (x, value))
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None) -> Tensor:
+    arr = ensure_tensor(arr)
+    idx = indices._value if isinstance(indices, Tensor) else jnp.asarray(indices)
+    return apply_op("take_along_axis", lambda v: jnp.take_along_axis(v, idx, axis=axis), (arr,))
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None, **kw) -> Tensor:
+    arr = ensure_tensor(arr)
+    values = ensure_tensor(values)
+    idx = indices._value if isinstance(indices, Tensor) else jnp.asarray(indices)
+
+    def fn(v, u):
+        u = jnp.broadcast_to(u.astype(v.dtype), idx.shape)
+        vm = jnp.moveaxis(v, axis, -1)
+        im = jnp.moveaxis(idx, axis, -1)
+        um = jnp.moveaxis(u, axis, -1)
+        if im.ndim > 1:
+            batch_idx = jnp.indices(im.shape[:-1] + (1,))[:-1]
+            full_idx = tuple(jnp.broadcast_to(b, im.shape) for b in batch_idx) + (im,)
+        else:
+            full_idx = (im,)
+        if reduce == "add":
+            out = vm.at[full_idx].add(um)
+        elif reduce in ("mul", "multiply"):
+            out = vm.at[full_idx].multiply(um)
+        else:
+            out = vm.at[full_idx].set(um)
+        return jnp.moveaxis(out, -1, axis)
+
+    return apply_op("put_along_axis", fn, (arr, values))
+
+
+def masked_select(x, mask, name=None) -> Tensor:
+    # dynamic output shape: eager-only (not jittable) — paddle parity
+    x = ensure_tensor(x)
+    m = mask._value if isinstance(mask, Tensor) else jnp.asarray(mask)
+    import numpy as np
+
+    sel = np.asarray(x._value)[np.asarray(m)]
+    return Tensor(jnp.asarray(sel))
+
+
+def masked_fill(x, mask, value, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    m = mask._value if isinstance(mask, Tensor) else jnp.asarray(mask)
+    if isinstance(value, Tensor):
+        return apply_op("masked_fill", lambda v, w: jnp.where(m, w.astype(v.dtype), v), (x, ensure_tensor(value)))
+    return apply_op("masked_fill", lambda v: jnp.where(m, value, v), (x,))
+
+
+def slice(input, axes, starts, ends) -> Tensor:
+    input = ensure_tensor(input)
+    idx = [builtins.slice(None)] * input.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        s = int(s._value) if isinstance(s, Tensor) else int(s)
+        e = int(e._value) if isinstance(e, Tensor) else int(e)
+        idx[ax] = builtins.slice(s, e)
+    idx = tuple(idx)
+    return apply_op("slice", lambda v: v[idx], (input,))
+
+
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        idx[ax] = builtins.slice(int(s), int(e), int(st))
+    idx = tuple(idx)
+    return apply_op("strided_slice", lambda v: v[idx], (x,))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    r = repeats._value if isinstance(repeats, Tensor) else repeats
+    return apply_op("repeat_interleave",
+                    lambda v: jnp.repeat(v, r, axis=axis,
+                                         total_repeat_length=None), (x,))
+
+
+def cast(x, dtype) -> Tensor:
+    return ensure_tensor(x).astype(dtype)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None) -> Tensor:
+    """paddle.nn.functional.pad-compatible core: `pad` is per-dim [lo, hi] pairs
+    (flat list, innermost-last paddle convention when len(pad) < 2*ndim)."""
+    x = ensure_tensor(x)
+    if isinstance(pad, Tensor):
+        pad = pad.numpy().reshape(-1).tolist()
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        width = [(int(pad[2 * i]), int(pad[2 * i + 1])) for i in range(nd)]
+    else:
+        # paddle/torch convention for partial flat lists: pairs apply to the
+        # trailing dims LAST-DIM-FIRST — pad[0:2] pads dim -1, pad[2:4] dim -2, …
+        npairs = len(pad) // 2
+        pairs = [(int(pad[2 * i]), int(pad[2 * i + 1])) for i in range(npairs)]
+        width = [(0, 0)] * (nd - npairs) + pairs[::-1]
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+
+    def fn(v):
+        if jmode == "constant":
+            return jnp.pad(v, width, mode=jmode, constant_values=value)
+        return jnp.pad(v, width, mode=jmode)
+
+    return apply_op("pad", fn, (x,))
+
+
+def crop(x, shape=None, offsets=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    s = _shape_arg(shape)
+    offs = [0] * x.ndim if offsets is None else [int(o) for o in offsets]
+    idx = tuple(builtins.slice(o, o + d) for o, d in zip(offs, s))
+    return apply_op("crop", lambda v: v[idx], (x,))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None,
+           dtype="int64", name=None):
+    x = ensure_tensor(x)
+    import numpy as np
+
+    res = np.unique(np.asarray(x._value), return_index=return_index,
+                    return_inverse=return_inverse, return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64",
+                       name=None):
+    import numpy as np
+
+    v = np.asarray(ensure_tensor(x)._value)
+    if axis is None:
+        v = v.reshape(-1)
+    keep = np.concatenate([[True], v[1:] != v[:-1]]) if v.ndim == 1 else None
+    out = v[keep]
+    results = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        results.append(Tensor(jnp.asarray(inv)))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, v.size))
+        results.append(Tensor(jnp.asarray(counts)))
+    return results[0] if len(results) == 1 else tuple(results)
+
+
+def as_strided(x, shape, stride, offset=0, name=None) -> Tensor:
+    import numpy as np
+
+    v = np.asarray(ensure_tensor(x)._value)
+    out = np.lib.stride_tricks.as_strided(
+        v.reshape(-1)[offset:], shape=shape,
+        strides=[s * v.dtype.itemsize for s in stride])
+    return Tensor(jnp.asarray(out))
+
+
+def view(x, shape_or_dtype, name=None) -> Tensor:
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    from ..framework.dtype import canonical_dtype
+
+    x = ensure_tensor(x)
+    dt = canonical_dtype(shape_or_dtype)
+    return apply_op("view_dtype", lambda v: jax.lax.bitcast_convert_type(v, dt), (x,))
+
+
+def tensordot(x, y, axes=2, name=None) -> Tensor:
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    ax = axes
+    if isinstance(axes, Tensor):
+        ax = axes.numpy().tolist()
+
+    def fn(a, b):
+        return jnp.tensordot(a, b, axes=ax if not isinstance(ax, list) else tuple(
+            tuple(t) if isinstance(t, list) else t for t in ax))
+
+    return apply_op("tensordot", fn, (x, y))

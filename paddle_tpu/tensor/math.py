@@ -1,0 +1,400 @@
+"""Math ops: elementwise, binary, reductions, cumulative ops.
+
+Reference surface: `python/paddle/tensor/math.py` (thin `_C_ops` calls over
+phi kernels, `paddle/phi/kernels/cpu|gpu/*`). Here each op is a jnp call
+funneled through `apply_op` for eager autograd; under whole-step jit these
+trace straight into XLA HLO and fuse.
+
+Paddle conventions kept: ``axis`` (not dim), ``keepdim``, scalar `y` allowed.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ._op_utils import binary_op, ensure_tensor, nondiff, unary_op
+from .tensor import Tensor, apply_op
+
+# ---------------------------------------------------------------------------
+# unary elementwise
+# ---------------------------------------------------------------------------
+abs = unary_op("abs", jnp.abs)
+ceil = unary_op("ceil", jnp.ceil)
+floor = unary_op("floor", jnp.floor)
+round = unary_op("round", jnp.round)
+trunc = unary_op("trunc", jnp.trunc)
+frac = unary_op("frac", lambda v: v - jnp.trunc(v))
+exp = unary_op("exp", jnp.exp)
+expm1 = unary_op("expm1", jnp.expm1)
+log = unary_op("log", jnp.log)
+log2 = unary_op("log2", jnp.log2)
+log10 = unary_op("log10", jnp.log10)
+log1p = unary_op("log1p", jnp.log1p)
+sqrt = unary_op("sqrt", jnp.sqrt)
+rsqrt = unary_op("rsqrt", jax.lax.rsqrt)
+sin = unary_op("sin", jnp.sin)
+cos = unary_op("cos", jnp.cos)
+tan = unary_op("tan", jnp.tan)
+asin = unary_op("asin", jnp.arcsin)
+acos = unary_op("acos", jnp.arccos)
+atan = unary_op("atan", jnp.arctan)
+sinh = unary_op("sinh", jnp.sinh)
+cosh = unary_op("cosh", jnp.cosh)
+tanh = unary_op("tanh", jnp.tanh)
+asinh = unary_op("asinh", jnp.arcsinh)
+acosh = unary_op("acosh", jnp.arccosh)
+atanh = unary_op("atanh", jnp.arctanh)
+erf = unary_op("erf", jax.scipy.special.erf)
+erfinv = unary_op("erfinv", jax.scipy.special.erfinv)
+sigmoid = unary_op("sigmoid", jax.nn.sigmoid)
+reciprocal = unary_op("reciprocal", lambda v: 1.0 / v)
+sign = unary_op("sign", jnp.sign)
+neg = unary_op("neg", jnp.negative)
+square = unary_op("square", jnp.square)
+digamma = unary_op("digamma", jax.scipy.special.digamma)
+lgamma = unary_op("lgamma", jax.scipy.special.gammaln)
+angle = unary_op("angle", jnp.angle)
+conj = unary_op("conj", jnp.conj)
+real = unary_op("real", jnp.real)
+imag = unary_op("imag", jnp.imag)
+deg2rad = unary_op("deg2rad", jnp.deg2rad)
+rad2deg = unary_op("rad2deg", jnp.rad2deg)
+
+
+def logit(x, eps: Optional[float] = None, name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        if eps is not None:
+            v = jnp.clip(v, eps, 1.0 - eps)
+        return jnp.log(v / (1.0 - v))
+
+    return apply_op("logit", fn, (x,))
+
+
+# ---------------------------------------------------------------------------
+# binary elementwise
+# ---------------------------------------------------------------------------
+add = binary_op("add", jnp.add)
+subtract = binary_op("subtract", jnp.subtract)
+multiply = binary_op("multiply", jnp.multiply)
+divide = binary_op("divide", jnp.divide)
+floor_divide = binary_op("floor_divide", jnp.floor_divide)
+mod = binary_op("mod", jnp.mod)
+remainder = mod
+floor_mod = mod
+pow = binary_op("pow", jnp.power)
+maximum = binary_op("maximum", jnp.maximum)
+minimum = binary_op("minimum", jnp.minimum)
+fmax = binary_op("fmax", jnp.fmax)
+fmin = binary_op("fmin", jnp.fmin)
+atan2 = binary_op("atan2", jnp.arctan2)
+logaddexp = binary_op("logaddexp", jnp.logaddexp)
+hypot = binary_op("hypot", jnp.hypot)
+copysign = binary_op("copysign", jnp.copysign)
+heaviside = binary_op("heaviside", jnp.heaviside)
+nextafter = binary_op("nextafter", jnp.nextafter, differentiable=False)
+gcd = nondiff("gcd", jnp.gcd)
+lcm = nondiff("lcm", jnp.lcm)
+
+# bitwise / shifts (non-differentiable)
+bitwise_and = nondiff("bitwise_and", jnp.bitwise_and)
+bitwise_or = nondiff("bitwise_or", jnp.bitwise_or)
+bitwise_xor = nondiff("bitwise_xor", jnp.bitwise_xor)
+bitwise_not = nondiff("bitwise_not", jnp.bitwise_not)
+bitwise_left_shift = nondiff("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = nondiff("bitwise_right_shift", jnp.right_shift)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, name=None):
+    """paddle.scale parity (reference schema in ops.yaml)."""
+    x = ensure_tensor(x)
+    s = scale._value if isinstance(scale, Tensor) else scale
+
+    def fn(v):
+        if bias_after_scale:
+            return v * s + bias
+        return (v + bias) * s
+
+    return apply_op("scale", fn, (x,))
+
+
+def increment(x, value=1.0, name=None):
+    x = ensure_tensor(x)
+    new = apply_op("increment", lambda v: v + value, (x,))
+    return x._rebind(new)
+
+
+def lerp(x, y, weight, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if isinstance(weight, Tensor):
+        return apply_op("lerp", lambda a, b, w: a + w * (b - a), (x, y, weight))
+    return apply_op("lerp", lambda a, b: a + weight * (b - a), (x, y))
+
+
+def clip(x, min=None, max=None, name=None):
+    x = ensure_tensor(x)
+    lo = min._value if isinstance(min, Tensor) else min
+    hi = max._value if isinstance(max, Tensor) else max
+    return apply_op("clip", lambda v: jnp.clip(v, lo, hi), (x,))
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    x = ensure_tensor(x)
+    return apply_op("nan_to_num",
+                    lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf), (x,))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    x = ensure_tensor(x)
+    return apply_op("stanh", lambda v: scale_b * jnp.tanh(scale_a * v), (x,))
+
+
+def multiplex(inputs, index, name=None):
+    idx = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+    ts = [ensure_tensor(t) for t in inputs]
+
+    def fn(*vals):
+        stacked = jnp.stack(vals, axis=0)
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx.reshape(-1), rows]
+
+    return apply_op("multiplex", fn, tuple(ts))
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    if isinstance(axis, Tensor):
+        return tuple(int(a) for a in axis.numpy().reshape(-1).tolist())
+    return int(axis)
+
+
+def _reduce(name, jfn, differentiable=True):
+    def op(x, axis=None, keepdim=False, name_=None, dtype=None):
+        x = ensure_tensor(x)
+        ax = _norm_axis(axis)
+
+        def fn(v):
+            out = jfn(v, axis=ax, keepdims=keepdim)
+            if dtype is not None:
+                from ..framework.dtype import canonical_dtype
+
+                out = out.astype(canonical_dtype(dtype))
+            return out
+
+        if differentiable:
+            return apply_op(name, fn, (x,))
+        return Tensor(fn(x._value))
+
+    op.__name__ = name
+    return op
+
+
+sum = _reduce("sum", jnp.sum)
+mean = _reduce("mean", jnp.mean)
+prod = _reduce("prod", jnp.prod)
+max = _reduce("max", jnp.max)
+min = _reduce("min", jnp.min)
+amax = _reduce("amax", jnp.max)
+amin = _reduce("amin", jnp.min)
+nansum = _reduce("nansum", jnp.nansum)
+nanmean = _reduce("nanmean", jnp.nanmean)
+all = _reduce("all", jnp.all, differentiable=False)
+any = _reduce("any", jnp.any, differentiable=False)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _norm_axis(axis)
+    return apply_op("logsumexp",
+                    lambda v: jax.scipy.special.logsumexp(v, axis=ax, keepdims=keepdim), (x,))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.count_nonzero(x._value, axis=_norm_axis(axis), keepdims=keepdim))
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        if axis is None:
+            v = v.reshape(-1)
+            return jnp.cumsum(v)
+        return jnp.cumsum(v, axis=axis)
+
+    return apply_op("cumsum", fn, (x,))
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return apply_op("cumprod", lambda v: jnp.cumprod(v, axis=dim), (x,))
+
+
+def _cum_extreme(x, axis, is_max):
+    """cummax/cummin with indices via an associative scan over (value, index)."""
+    x = ensure_tensor(x)
+    flat = axis is None
+    v = x._value.reshape(-1) if flat else x._value
+    ax = 0 if flat else (axis if axis >= 0 else v.ndim + axis)
+
+    def combine(a, b):
+        va, ia = a
+        vb, ib = b
+        keep_b = (vb >= va) if is_max else (vb <= va)
+        return jnp.where(keep_b, vb, va), jnp.where(keep_b, ib, ia)
+
+    def values_fn(vv):
+        fn = jax.lax.cummax if is_max else jax.lax.cummin
+        return fn(vv.reshape(-1) if flat else vv, axis=ax)
+
+    shape = [1] * v.ndim
+    shape[ax] = v.shape[ax]
+    idx0 = jnp.broadcast_to(jnp.arange(v.shape[ax]).reshape(shape), v.shape)
+    _, indices = jax.lax.associative_scan(combine, (v, idx0), axis=ax)
+    out = apply_op("cummax" if is_max else "cummin", values_fn, (x,))
+    return out, Tensor(indices)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, is_max=True)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, is_max=False)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    x = ensure_tensor(x)
+    return apply_op("trace", lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2), (x,))
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    x = ensure_tensor(x)
+    return apply_op("diagonal",
+                    lambda v: jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2), (x,))
+
+
+# ---------------------------------------------------------------------------
+# matrix products (also exposed via linalg)
+# ---------------------------------------------------------------------------
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """paddle.matmul parity (reference: legacy_ops.yaml:725). MXU-bound op —
+    under jit this is a single dot_general XLA lowers onto the systolic array."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return apply_op("matmul", fn, (x, y))
+
+
+mm = matmul
+
+
+def dot(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply_op("dot", lambda a, b: jnp.sum(a * b, axis=-1), (x, y))
+
+
+def bmm(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply_op("bmm", jnp.matmul, (x, y))
+
+
+def inner(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply_op("inner", jnp.inner, (x, y))
+
+
+def outer(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply_op("outer", lambda a, b: jnp.outer(a.reshape(-1), b.reshape(-1)), (x, y))
+
+
+def kron(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply_op("kron", jnp.kron, (x, y))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    input, x, y = ensure_tensor(input), ensure_tensor(x), ensure_tensor(y)
+    return apply_op("addmm", lambda i, a, b: beta * i + alpha * (a @ b), (input, x, y))
+
+
+def matmul_int8(x, y, **kw):  # placeholder parity for quant path
+    return matmul(x, y, **kw)
+
+
+# ---------------------------------------------------------------------------
+# float checks / comparisons that return bool tensors
+# ---------------------------------------------------------------------------
+isnan = nondiff("isnan", jnp.isnan)
+isinf = nondiff("isinf", jnp.isinf)
+isfinite = nondiff("isfinite", jnp.isfinite)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return Tensor(jnp.isclose(x._value, y._value, rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return Tensor(jnp.allclose(x._value, y._value, rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def equal_all(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return Tensor(jnp.array_equal(x._value, y._value))
+
+
+# ---------------------------------------------------------------------------
+# stats
+# ---------------------------------------------------------------------------
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ddof = 1 if unbiased else 0
+    return apply_op("std", lambda v: jnp.std(v, axis=_norm_axis(axis), ddof=ddof,
+                                             keepdims=keepdim), (x,))
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ddof = 1 if unbiased else 0
+    return apply_op("var", lambda v: jnp.var(v, axis=_norm_axis(axis), ddof=ddof,
+                                             keepdims=keepdim), (x,))
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    x = ensure_tensor(x)
+    return apply_op("median", lambda v: jnp.median(v, axis=_norm_axis(axis), keepdims=keepdim), (x,))
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    x = ensure_tensor(x)
+    qv = q._value if isinstance(q, Tensor) else jnp.asarray(q)
+    return apply_op("quantile", lambda v: jnp.quantile(
+        v, qv, axis=_norm_axis(axis), keepdims=keepdim, method=interpolation), (x,))
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    input = ensure_tensor(input)
+    v = input._value
+    lo, hi = (float(jnp.min(v)), float(jnp.max(v))) if min == 0 and max == 0 else (min, max)
+    hist, _ = jnp.histogram(v, bins=bins, range=(lo, hi))
+    return Tensor(hist)

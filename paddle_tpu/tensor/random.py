@@ -1,0 +1,144 @@
+"""Random sampling ops (reference: `python/paddle/tensor/random.py`).
+
+Keys come from :func:`paddle_tpu.framework.random.next_key`: the stateful
+default generator in eager mode, or the active :class:`key_scope` (traced key)
+inside a jitted step."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.dtype import canonical_dtype, default_float_dtype
+from ..framework.random import next_key
+from ._op_utils import ensure_tensor
+from .tensor import Tensor
+from .creation import _shape
+
+
+def _dt(dtype, default):
+    return default if dtype is None else canonical_dtype(dtype)
+
+
+def rand(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jax.random.uniform(next_key(), _shape(shape),
+                                     dtype=_dt(dtype, default_float_dtype())))
+
+
+def randn(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jax.random.normal(next_key(), _shape(shape),
+                                    dtype=_dt(dtype, default_float_dtype())))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
+    key = jax.random.PRNGKey(seed) if seed else next_key()
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return Tensor(jax.random.uniform(key, _shape(shape), dtype=_dt(dtype, default_float_dtype()),
+                                     minval=lo, maxval=hi))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
+    x._value = uniform(x.shape, x.dtype, min, max, seed)._value
+    x._producer = None
+    return x
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None) -> Tensor:
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        sh = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(jax.random.normal(next_key(), sh) * s + m)
+    return Tensor(jax.random.normal(next_key(), _shape(shape)) * std + mean)
+
+
+def normal_(x, mean=0.0, std=1.0, name=None) -> Tensor:
+    x._value = (jax.random.normal(next_key(), tuple(x.shape), dtype=x._value.dtype) * std + mean)
+    x._producer = None
+    return x
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None) -> Tensor:
+    key = jax.random.PRNGKey(seed) if seed else next_key()
+    return Tensor(jax.random.normal(key, _shape(shape),
+                                    dtype=_dt(dtype, default_float_dtype())) * std + mean)
+
+
+def standard_normal(shape, dtype=None, name=None) -> Tensor:
+    return randn(shape, dtype)
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None) -> Tensor:
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(next_key(), _shape(shape), low, high,
+                                     dtype=_dt(dtype, jnp.int32)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(next_key(), tuple(x.shape), low, high,
+                                     dtype=_dt(dtype, x._value.dtype)))
+
+
+def randperm(n, dtype=None, name=None) -> Tensor:
+    out = jax.random.permutation(next_key(), int(n))
+    return Tensor(out.astype(_dt(dtype, jnp.int32)))
+
+
+def bernoulli(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return Tensor(jax.random.bernoulli(next_key(), x._value).astype(x._value.dtype))
+
+
+def bernoulli_(x, p=0.5, name=None) -> Tensor:
+    x._value = jax.random.bernoulli(next_key(), p, tuple(x.shape)).astype(x._value.dtype)
+    x._producer = None
+    return x
+
+
+def poisson(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return Tensor(jax.random.poisson(next_key(), x._value).astype(x._value.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    v = x._value
+    logits = jnp.log(jnp.maximum(v, 1e-30))
+    if replacement:
+        out = jax.random.categorical(next_key(), logits, axis=-1,
+                                     shape=(num_samples,) + v.shape[:-1])
+        out = jnp.moveaxis(out, 0, -1) if v.ndim > 1 else out
+    else:
+        g = jax.random.gumbel(next_key(), v.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out)
+
+
+def exponential_(x, lam=1.0, name=None) -> Tensor:
+    x._value = (jax.random.exponential(next_key(), tuple(x.shape),
+                                       dtype=x._value.dtype) / lam)
+    x._producer = None
+    return x
+
+
+def binomial(count, prob, name=None) -> Tensor:
+    c = ensure_tensor(count)._value
+    p = ensure_tensor(prob)._value
+    return Tensor(jax.random.binomial(next_key(), c, p))
+
+
+def rand_like(x, dtype=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return Tensor(jax.random.uniform(next_key(), tuple(x.shape),
+                                     dtype=_dt(dtype, x._value.dtype)))
+
+
+def randn_like(x, dtype=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return Tensor(jax.random.normal(next_key(), tuple(x.shape),
+                                    dtype=_dt(dtype, x._value.dtype)))

@@ -1,0 +1,146 @@
+"""Search/sort ops. Indices come back non-differentiable; values stay on the
+tape via take_along_axis so gradients flow (TPU-friendly: no dynamic shapes
+except the eager-only nonzero/masked paths, matching paddle semantics)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._op_utils import ensure_tensor, nondiff
+from .tensor import Tensor, apply_op
+
+argmax = nondiff("argmax", lambda v, axis=None, keepdim=False, dtype=None:
+                 jnp.argmax(v, axis=axis, keepdims=keepdim))
+argmin = nondiff("argmin", lambda v, axis=None, keepdim=False, dtype=None:
+                 jnp.argmin(v, axis=axis, keepdims=keepdim))
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    v = x._value
+    if not descending:
+        idx = jnp.argsort(v, axis=axis, stable=stable)
+    elif jnp.issubdtype(v.dtype, jnp.unsignedinteger) or v.dtype == jnp.bool_:
+        # negation wraps for unsigned/bool; flip an ascending sort instead
+        idx = jnp.flip(jnp.argsort(v, axis=axis, stable=stable), axis=axis)
+    else:
+        idx = jnp.argsort(-v, axis=axis, stable=stable)
+    return Tensor(idx)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    idx = argsort(x, axis=axis, descending=descending, stable=stable)
+    from .manipulation import take_along_axis
+
+    return take_along_axis(x, idx, axis=axis)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    x = ensure_tensor(x)
+    kk = int(k._value) if isinstance(k, Tensor) else int(k)
+    v = x._value
+    ax = axis if axis >= 0 else v.ndim + axis
+    vm = jnp.moveaxis(v, ax, -1)
+    if largest:
+        _, idx = jax.lax.top_k(vm, kk)
+    else:
+        _, idx = jax.lax.top_k(-vm, kk)
+    idx = jnp.moveaxis(idx, -1, ax)
+    from .manipulation import take_along_axis
+
+    values = take_along_axis(x, Tensor(idx), axis=ax)
+    return values, Tensor(idx)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    v = x._value
+    ax = axis if axis >= 0 else v.ndim + axis
+    idx_full = jnp.argsort(v, axis=ax)
+    idx = jnp.take(idx_full, k - 1, axis=ax)
+    from .manipulation import take_along_axis
+
+    values = take_along_axis(x, Tensor(jnp.expand_dims(idx, ax)), axis=ax)
+    if not keepdim:
+        from .manipulation import squeeze
+
+        values = squeeze(values, axis=ax)
+        return values, Tensor(idx)
+    return values, Tensor(jnp.expand_dims(idx, ax))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    v = np.asarray(x._value)
+    from scipy import stats as _stats  # scipy ships with jax deps
+
+    m = _stats.mode(v, axis=axis, keepdims=keepdim)
+    return Tensor(jnp.asarray(m.mode)), Tensor(jnp.asarray(m.count))
+
+
+def where(condition, x=None, y=None, name=None):
+    cond = condition._value if isinstance(condition, Tensor) else jnp.asarray(condition)
+    if x is None and y is None:
+        return nonzero(Tensor(cond), as_tuple=True)
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply_op("where", lambda a, b: jnp.where(cond, a, b), (x, y))
+
+
+def where_(condition, x, y, name=None):
+    out = where(condition, x, y)
+    return x._rebind(out)
+
+
+def nonzero(x, as_tuple=False):
+    # dynamic shape → eager-only host computation (paddle parity)
+    v = np.asarray(ensure_tensor(x)._value)
+    nz = np.nonzero(v)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i)) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1)))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None) -> Tensor:
+    seq = ensure_tensor(sorted_sequence)._value
+    vals = ensure_tensor(values)._value
+    side = "right" if right else "left"
+    if seq.ndim == 1:
+        out = jnp.searchsorted(seq, vals, side=side)
+    else:
+        out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
+            seq.reshape(-1, seq.shape[-1]), vals.reshape(-1, vals.shape[-1]))
+        out = out.reshape(vals.shape)
+    return Tensor(out.astype(jnp.int32) if out_int32 else out)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None) -> Tensor:
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def index_fill(x, index, axis, value, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    idx = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def fn(v):
+        vm = jnp.moveaxis(v, axis, 0)
+        return jnp.moveaxis(vm.at[idx].set(value), 0, axis)
+
+    return apply_op("index_fill", fn, (x,))
+
+
+def masked_scatter(x, mask, value, name=None) -> Tensor:
+    v = np.asarray(ensure_tensor(x)._value).copy()
+    m = np.asarray(mask._value if isinstance(mask, Tensor) else mask)
+    src = np.asarray(ensure_tensor(value)._value).reshape(-1)
+    m_b = np.broadcast_to(m, v.shape)
+    v[m_b] = src[: int(m_b.sum())]
+    return Tensor(jnp.asarray(v))
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    t = ensure_tensor(test_x)
+    return Tensor(jnp.isin(x._value, t._value, assume_unique=assume_unique, invert=invert))

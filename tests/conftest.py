@@ -1,0 +1,27 @@
+"""Test fixtures: force an 8-device virtual CPU platform BEFORE jax backend init.
+
+Mirrors the reference's "fake cluster" test strategy (multi-process on one
+node, SURVEY.md §4): here a single process sees 8 XLA CPU devices, enough to
+exercise every mesh axis (dp/tp/pp/sp) without TPU hardware.
+
+Note: this image boots with an `axon` TPU plugin that pins JAX_PLATFORMS=axon
+from sitecustomize, so we must override via jax.config, not just the env."""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,169 @@
+"""Eager autograd tape tests: analytic grads vs numeric/known references
+(the check_grad half of the OpTest harness, SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+def leaf(a):
+    t = paddle.to_tensor(a)
+    t.stop_gradient = False
+    return t
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = leaf(np.array([2.0, 3.0], "float32"))
+        y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+    def test_branching(self):
+        x = leaf(np.array([1.0, 2.0], "float32"))
+        a = x * 2
+        b = x * 3
+        loss = (a + b).sum()
+        loss.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+
+    def test_matmul_grad(self):
+        a = np.random.default_rng(0).standard_normal((3, 4)).astype("float32")
+        b = np.random.default_rng(1).standard_normal((4, 2)).astype("float32")
+        x, y = leaf(a), leaf(b)
+        loss = paddle.matmul(x, y).sum()
+        loss.backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones((3, 2), "float32") @ b.T, rtol=1e-5)
+        np.testing.assert_allclose(y.grad.numpy(), a.T @ np.ones((3, 2), "float32"), rtol=1e-5)
+
+    def test_grad_accumulation(self):
+        x = leaf(np.array([1.0], "float32"))
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_stop_gradient_blocks(self):
+        x = leaf(np.array([1.0], "float32"))
+        y = paddle.to_tensor(np.array([2.0], "float32"))  # stop_gradient=True
+        loss = (x * y).sum()
+        loss.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+        assert y.grad is None
+
+    def test_detach(self):
+        x = leaf(np.array([3.0], "float32"))
+        y = (x * x).detach()
+        z = y * x
+        z.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [9.0])  # only through z, not y
+
+    def test_non_scalar_needs_grad_tensor(self):
+        x = leaf(np.ones((2, 2), "float32"))
+        y = x * 2
+        with pytest.raises(RuntimeError):
+            y.backward()
+        y.backward(paddle.ones([2, 2]))
+        np.testing.assert_allclose(x.grad.numpy(), 2 * np.ones((2, 2)))
+
+    def test_no_grad_context(self):
+        x = leaf(np.array([1.0], "float32"))
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+
+    def test_hook_fires_and_scales(self):
+        x = leaf(np.array([1.0, 1.0], "float32"))
+        seen = []
+
+        def hook(g):
+            seen.append(g.numpy().copy())
+            return g * 2
+
+        x.register_hook(hook)
+        (x * 3).sum().backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(seen[0], [3.0, 3.0])
+        np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+
+    def test_setitem_grad_flows(self):
+        x = leaf(np.ones((3,), "float32"))
+        y = x * 2
+        y[0] = 0.0
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [0.0, 2.0, 2.0])
+
+    def test_paddle_grad_api(self):
+        x = leaf(np.array([2.0], "float32"))
+        y = x * x * x
+        (g,) = paddle.grad(y, x, retain_graph=True)
+        np.testing.assert_allclose(g.numpy(), [12.0])
+        assert x.grad is None  # paddle.grad must not pollute .grad
+
+    def test_broadcast_grad(self):
+        x = leaf(np.ones((3, 1), "float32"))
+        y = leaf(np.ones((1, 4), "float32"))
+        (x + y).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), 4 * np.ones((3, 1)))
+        np.testing.assert_allclose(y.grad.numpy(), 3 * np.ones((1, 4)))
+
+
+class TestPyLayer:
+    def test_custom_forward_backward(self):
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, grad):
+                (x,) = ctx.saved_tensor()
+                return grad * 2
+
+        x = leaf(np.array([3.0], "float32"))
+        y = Double.apply(x)
+        y.sum().backward()
+        np.testing.assert_allclose(y.numpy(), [6.0])
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+    def test_multi_output(self):
+        class SplitMerge(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return x * 2, x * 3
+
+            @staticmethod
+            def backward(ctx, ga, gb):
+                return ga * 2 + gb * 3
+
+        x = leaf(np.array([1.0], "float32"))
+        a, b = SplitMerge.apply(x)
+        (a * 2 + b * 3).sum().backward()  # d/dx(4x + 9x) = 13
+        np.testing.assert_allclose(x.grad.numpy(), [13.0])
+
+
+class TestJitInterop:
+    def test_tensor_is_pytree(self):
+        import jax
+
+        def f(t):
+            return t * 2
+
+        x = paddle.to_tensor([1.0, 2.0])
+        out = jax.jit(f)(x)
+        assert isinstance(out, paddle.Tensor)
+        np.testing.assert_allclose(out.numpy(), [2.0, 4.0])
+
+    def test_functional_grad_through_ops(self):
+        import jax
+
+        def loss_fn(t):
+            return paddle.sum(t * t).value
+
+        x = paddle.to_tensor([2.0, 3.0])
+        g = jax.grad(lambda v: loss_fn(paddle.Tensor(v)))(x.value)
+        np.testing.assert_allclose(np.asarray(g), [4.0, 6.0])

@@ -1,0 +1,224 @@
+"""Tensor surface tests — the OpTest-style numerics harness seed (SURVEY §4):
+forward results compared against numpy references."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def np_t(shape, seed=0, dtype="float32"):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal(shape).astype(dtype)
+
+
+class TestCreation:
+    def test_to_tensor(self):
+        x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert x.shape == [2, 2]
+        assert x.dtype == np.float32
+        np.testing.assert_allclose(x.numpy(), [[1, 2], [3, 4]])
+
+    def test_zeros_ones_full(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3]).numpy().sum() == 6
+        np.testing.assert_allclose(paddle.full([2], 7.5).numpy(), [7.5, 7.5])
+
+    def test_arange_linspace(self):
+        np.testing.assert_allclose(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(paddle.arange(1, 10, 2).numpy(), np.arange(1, 10, 2))
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5),
+                                   rtol=1e-6)
+
+    def test_eye_tril_triu(self):
+        np.testing.assert_allclose(paddle.eye(3).numpy(), np.eye(3))
+        a = np_t((4, 4))
+        np.testing.assert_allclose(paddle.tril(paddle.to_tensor(a)).numpy(), np.tril(a))
+        np.testing.assert_allclose(paddle.triu(paddle.to_tensor(a), 1).numpy(), np.triu(a, 1))
+
+    def test_like_variants(self):
+        x = paddle.to_tensor(np_t((2, 3)))
+        assert paddle.zeros_like(x).shape == [2, 3]
+        assert paddle.ones_like(x).numpy().sum() == 6
+        np.testing.assert_allclose(paddle.full_like(x, 2).numpy(), np.full((2, 3), 2.0))
+
+
+class TestMath:
+    def test_elementwise_binary(self):
+        a, b = np_t((3, 4), 1), np_t((3, 4), 2)
+        x, y = paddle.to_tensor(a), paddle.to_tensor(b)
+        np.testing.assert_allclose((x + y).numpy(), a + b, rtol=1e-6)
+        np.testing.assert_allclose((x - y).numpy(), a - b, rtol=1e-6)
+        np.testing.assert_allclose((x * y).numpy(), a * b, rtol=1e-6)
+        np.testing.assert_allclose((x / y).numpy(), a / b, rtol=1e-5)
+        np.testing.assert_allclose(paddle.maximum(x, y).numpy(), np.maximum(a, b))
+
+    def test_scalar_ops_preserve_dtype(self):
+        x = paddle.to_tensor(np_t((2, 2)), dtype="bfloat16")
+        assert (x + 1.5).dtype == paddle.to_tensor(0, dtype="bfloat16").dtype
+        assert (2.0 * x).numpy().dtype == x.numpy().dtype
+
+    def test_unary(self):
+        a = np.abs(np_t((3, 3))) + 0.1
+        x = paddle.to_tensor(a)
+        np.testing.assert_allclose(paddle.log(x).numpy(), np.log(a), rtol=1e-5)
+        np.testing.assert_allclose(paddle.sqrt(x).numpy(), np.sqrt(a), rtol=1e-6)
+        np.testing.assert_allclose(paddle.exp(x).numpy(), np.exp(a), rtol=1e-5)
+        np.testing.assert_allclose(x.tanh().numpy(), np.tanh(a), rtol=1e-6)
+
+    def test_reductions(self):
+        a = np_t((2, 3, 4))
+        x = paddle.to_tensor(a)
+        np.testing.assert_allclose(x.sum().numpy(), a.sum(), rtol=1e-5)
+        np.testing.assert_allclose(x.mean(axis=1).numpy(), a.mean(1), rtol=1e-5)
+        np.testing.assert_allclose(x.max(axis=[0, 2]).numpy(), a.max((0, 2)))
+        np.testing.assert_allclose(x.sum(axis=-1, keepdim=True).numpy(),
+                                   a.sum(-1, keepdims=True), rtol=1e-5)
+
+    def test_matmul(self):
+        a, b = np_t((3, 4)), np_t((4, 5))
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+        out_t = paddle.matmul(paddle.to_tensor(a.T), paddle.to_tensor(b), transpose_x=True)
+        np.testing.assert_allclose(out_t.numpy(), a @ b, rtol=1e-5)
+
+    def test_cumsum_clip(self):
+        a = np_t((3, 4))
+        x = paddle.to_tensor(a)
+        np.testing.assert_allclose(x.cumsum(axis=1).numpy(), a.cumsum(1), rtol=1e-5)
+        np.testing.assert_allclose(x.clip(-0.5, 0.5).numpy(), a.clip(-0.5, 0.5))
+
+    def test_einsum(self):
+        a, b = np_t((2, 3)), np_t((3, 4))
+        out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        a = np_t((2, 3, 4))
+        x = paddle.to_tensor(a)
+        assert x.reshape([6, 4]).shape == [6, 4]
+        assert x.reshape([-1]).shape == [24]
+        np.testing.assert_allclose(x.transpose([2, 0, 1]).numpy(), a.transpose(2, 0, 1))
+
+    def test_concat_stack_split(self):
+        a, b = np_t((2, 3)), np_t((2, 3), 1)
+        x, y = paddle.to_tensor(a), paddle.to_tensor(b)
+        np.testing.assert_allclose(paddle.concat([x, y], axis=0).numpy(),
+                                   np.concatenate([a, b], 0))
+        np.testing.assert_allclose(paddle.stack([x, y], axis=1).numpy(), np.stack([a, b], 1))
+        parts = paddle.split(paddle.to_tensor(np_t((6, 2))), 3, axis=0)
+        assert len(parts) == 3 and parts[0].shape == [2, 2]
+        parts = paddle.split(paddle.to_tensor(np_t((7, 2))), [2, 5], axis=0)
+        assert parts[1].shape == [5, 2]
+
+    def test_squeeze_unsqueeze_tile(self):
+        x = paddle.to_tensor(np_t((1, 3, 1)))
+        assert x.squeeze().shape == [3]
+        assert x.squeeze(axis=0).shape == [3, 1]
+        assert x.unsqueeze(0).shape == [1, 1, 3, 1]
+        assert paddle.tile(paddle.ones([2]), [3]).shape == [6]
+
+    def test_gather_scatter(self):
+        a = np_t((5, 3))
+        x = paddle.to_tensor(a)
+        idx = paddle.to_tensor(np.array([0, 2, 4]))
+        np.testing.assert_allclose(paddle.gather(x, idx).numpy(), a[[0, 2, 4]])
+        upd = paddle.to_tensor(np.ones((2, 3), "float32"))
+        out = paddle.scatter(x, paddle.to_tensor(np.array([1, 3])), upd)
+        assert out.numpy()[1].sum() == 3.0
+
+    def test_indexing(self):
+        a = np_t((4, 5))
+        x = paddle.to_tensor(a)
+        np.testing.assert_allclose(x[1].numpy(), a[1])
+        np.testing.assert_allclose(x[:, 2:4].numpy(), a[:, 2:4])
+        np.testing.assert_allclose(x[::2, -1].numpy(), a[::2, -1])
+        x[0] = 0.0
+        assert x.numpy()[0].sum() == 0.0
+
+    def test_where_topk_sort(self):
+        a = np_t((3, 5))
+        x = paddle.to_tensor(a)
+        np.testing.assert_allclose(
+            paddle.where(x > 0, x, paddle.zeros_like(x)).numpy(), np.where(a > 0, a, 0))
+        vals, idx = paddle.topk(x, 2, axis=-1)
+        np.testing.assert_allclose(vals.numpy(), np.sort(a, -1)[:, ::-1][:, :2], rtol=1e-6)
+        np.testing.assert_allclose(paddle.sort(x, axis=-1).numpy(), np.sort(a, -1))
+
+    def test_pad(self):
+        a = np_t((2, 3))
+        out = paddle.to_tensor(a).pad([1, 1, 2, 2], value=0.0)
+        assert out.shape == [4, 7]
+
+
+class TestLogicSearch:
+    def test_comparisons(self):
+        a, b = np_t((3,)), np_t((3,), 1)
+        x, y = paddle.to_tensor(a), paddle.to_tensor(b)
+        np.testing.assert_array_equal((x > y).numpy(), a > b)
+        np.testing.assert_array_equal(paddle.logical_and(x > 0, y > 0).numpy(),
+                                      (a > 0) & (b > 0))
+
+    def test_argmax_nonzero(self):
+        a = np_t((3, 4))
+        x = paddle.to_tensor(a)
+        assert int(x.argmax().numpy()) == int(a.argmax())
+        np.testing.assert_array_equal(x.argmax(axis=1).numpy(), a.argmax(1))
+        nz = paddle.nonzero(paddle.to_tensor(np.array([0, 1, 0, 2])))
+        np.testing.assert_array_equal(nz.numpy().reshape(-1), [1, 3])
+
+
+class TestLinalg:
+    def test_solve_inv_det(self):
+        a = np_t((3, 3)) + 3 * np.eye(3, dtype="float32")
+        b = np_t((3, 2))
+        x = paddle.to_tensor(a)
+        np.testing.assert_allclose(paddle.linalg.inv(x).numpy(), np.linalg.inv(a), rtol=1e-4)
+        np.testing.assert_allclose(paddle.linalg.solve(x, paddle.to_tensor(b)).numpy(),
+                                   np.linalg.solve(a, b), rtol=1e-4)
+        np.testing.assert_allclose(paddle.linalg.det(x).numpy(), np.linalg.det(a), rtol=1e-4)
+
+    def test_norm(self):
+        a = np_t((3, 4))
+        x = paddle.to_tensor(a)
+        np.testing.assert_allclose(paddle.linalg.norm(x).numpy(), np.linalg.norm(a), rtol=1e-5)
+
+
+class TestDeviceDtype:
+    def test_astype(self):
+        x = paddle.to_tensor([1.5, 2.5])
+        assert x.astype("int32").numpy().dtype == np.int32
+        assert x.astype(paddle.bfloat16).astype("float32").numpy()[0] == 1.5
+
+    def test_set_device_cpu(self):
+        paddle.set_device("cpu")
+        assert paddle.get_device().startswith("cpu")
+
+    def test_flags(self):
+        paddle.set_flags({"check_nan_inf": True})
+        assert paddle.get_flags("check_nan_inf")["check_nan_inf"] is True
+        paddle.set_flags({"check_nan_inf": False})
+
+    def test_item_float_len(self):
+        x = paddle.to_tensor([3.0])
+        assert float(x[0]) == 3.0
+        assert len(x) == 1
+
+
+class TestRandom:
+    def test_seed_reproducible(self):
+        paddle.seed(42)
+        a = paddle.rand([4]).numpy()
+        paddle.seed(42)
+        b = paddle.rand([4]).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_shapes_and_ranges(self):
+        u = paddle.uniform([1000], min=0.0, max=1.0)
+        assert u.numpy().min() >= 0.0 and u.numpy().max() <= 1.0
+        r = paddle.randint(0, 10, [100])
+        assert r.numpy().min() >= 0 and r.numpy().max() < 10
+        p = paddle.randperm(16)
+        np.testing.assert_array_equal(np.sort(p.numpy()), np.arange(16))
