@@ -1,1 +1,276 @@
-"""Placeholder — populated in a later milestone of this round."""
+"""AMP: auto_cast / GradScaler / decorate (reference: `python/paddle/amp/`).
+
+TPU-first: bfloat16 is the default half dtype (no loss scaling needed — bf16
+has fp32's exponent range), matching how the reference treats bf16
+(`amp/grad_scaler.py` is only armed for fp16). GradScaler keeps full fp16
+parity: dynamic loss scaling with found_inf tracking, and in hybrid-parallel
+runs found_inf is allreduced across the mesh (see meta_parallel).
+
+Mechanism: ``auto_cast`` sets thread-local state; the compute-heavy entry
+points (linear/conv/matmul/einsum/SDPA — the O1 white list, reference
+`amp/amp_lists.py`) consult :func:`amp_dtype_if_enabled` and cast their
+inputs. Norms/softmax/losses already compute internally in fp32."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.dtype import canonical_dtype
+from ..framework.flags import get_flags
+from ..tensor.tensor import Tensor
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "AmpScaler", "decorate",
+           "is_auto_cast_enabled", "get_amp_dtype", "amp_dtype_if_enabled"]
+
+_state = threading.local()
+
+
+def _amp_state():
+    if not hasattr(_state, "stack"):
+        _state.stack = []
+    return _state.stack
+
+
+def is_auto_cast_enabled() -> bool:
+    stack = _amp_state()
+    return bool(stack) and stack[-1]["enable"]
+
+
+def get_amp_dtype():
+    stack = _amp_state()
+    return stack[-1]["dtype"] if stack else None
+
+
+def get_amp_level() -> str:
+    stack = _amp_state()
+    return stack[-1]["level"] if stack else "O0"
+
+
+def amp_dtype_if_enabled(op_name: str = "") -> Optional[Any]:
+    """The dtype white-listed compute ops should cast to, or None."""
+    stack = _amp_state()
+    if not stack or not stack[-1]["enable"]:
+        return None
+    st = stack[-1]
+    if op_name and op_name in st["custom_black_list"]:
+        return None
+    return st["dtype"]
+
+
+def amp_white_listed(op_name: str) -> Optional[Any]:
+    """Cast dtype for ops only cast when the USER white-lists them (the
+    custom_white_list escape hatch for ops outside the default O1 set)."""
+    stack = _amp_state()
+    if not stack or not stack[-1]["enable"]:
+        return None
+    st = stack[-1]
+    if op_name in st["custom_white_list"] and op_name not in st["custom_black_list"]:
+        return st["dtype"]
+    return None
+
+
+class auto_cast:
+    """Context manager enabling mixed precision (paddle.amp.auto_cast parity)."""
+
+    def __init__(self, enable: bool = True, custom_white_list=None, custom_black_list=None,
+                 level: str = "O1", dtype: str = "bfloat16", use_promote: bool = True):
+        if dtype in ("float16", "fp16", "half") and \
+                get_flags("use_bf16_default")["use_bf16_default"]:
+            # fp16 requested generically: bf16 is the TPU-native half type
+            dtype = "bfloat16"
+        self._cfg = {
+            "enable": enable,
+            "dtype": canonical_dtype(dtype),
+            "level": level,
+            "custom_white_list": set(custom_white_list or ()),
+            "custom_black_list": set(custom_black_list or ()),
+        }
+
+    def __enter__(self):
+        _amp_state().append(self._cfg)
+        return self
+
+    def __exit__(self, *exc):
+        _amp_state().pop()
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with auto_cast(**{k2: (list(v) if isinstance(v, set) else v)
+                              for k2, v in [("enable", self._cfg["enable"]),
+                                            ("custom_white_list", self._cfg["custom_white_list"]),
+                                            ("custom_black_list", self._cfg["custom_black_list"]),
+                                            ("level", self._cfg["level"])]},
+                           dtype=self._cfg["dtype"]):
+                return fn(*a, **k)
+
+        return wrapper
+
+
+amp_guard = auto_cast
+
+
+def maybe_autocast_tensors(op_name: str, *tensors: Tensor):
+    """Cast float tensors to the active amp dtype (used by white-listed ops)."""
+    dt = amp_dtype_if_enabled(op_name)
+    if dt is None:
+        return tensors
+    out = []
+    for t in tensors:
+        if t is not None and jnp.issubdtype(t._value.dtype, jnp.floating) and \
+                t._value.dtype != dt:
+            out.append(t.astype(dt))
+        else:
+            out.append(t)
+    return tuple(out)
+
+
+class AmpScaler:
+    """Dynamic loss scaling (reference: `amp/grad_scaler.py:41` AmpScaler).
+
+    With bf16 (TPU default) scaling is typically disabled; full fp16
+    semantics are kept for parity: scale losses, unscale grads before step,
+    skip the step and shrink the scale when any grad has NaN/Inf."""
+
+    def __init__(self, enable: bool = True, init_loss_scaling: float = 2.0 ** 16,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 2000, decr_every_n_nan_or_inf: int = 1,
+                 use_dynamic_loss_scaling: bool = True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._already_unscaled = False
+
+    def is_enable(self) -> bool:
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self) -> bool:
+        return self._dynamic
+
+    def get_loss_scaling(self) -> float:
+        return self._scale
+
+    def set_init_loss_scaling(self, v: float) -> None:
+        self._scale = float(v)
+
+    def scale(self, var: Tensor) -> Tensor:
+        if not self._enable:
+            return var
+        self._already_unscaled = False
+        return var * self._scale
+
+    def _unscale(self, optimizer) -> None:
+        if not self._enable or getattr(self, "_already_unscaled", False):
+            return
+        self._already_unscaled = True
+        found = False
+        inv = 1.0 / self._scale
+        for p in optimizer._parameter_list:
+            if p._grad is None:
+                continue
+            g = p._grad._value.astype(jnp.float32) * inv
+            finite = bool(jnp.all(jnp.isfinite(g)))
+            if not finite:
+                found = True
+            p._grad = Tensor(g.astype(p._grad._value.dtype))
+        self._found_inf = self._maybe_allreduce_found_inf(found)
+
+    def _maybe_allreduce_found_inf(self, found: bool) -> bool:
+        """Hybrid-parallel hook: subclassed/overridden to allreduce across
+        parallel groups (reference grad_scaler.py:573 minimize path)."""
+        return found
+
+    def step(self, optimizer) -> None:
+        if not self._enable:
+            optimizer.step()
+            return
+        self._unscale(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._already_unscaled = False
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss, *args, **kwargs):
+        scaled_loss.backward()
+        self.step(optimizer)
+        optimizer.clear_grad()
+
+    def update(self) -> None:
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio, "incr_count": self._good_steps,
+                "decr_count": self._bad_steps, "enable": self._enable,
+                "use_dynamic_loss_scaling": self._dynamic}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("incr_count", 0)
+        self._bad_steps = state.get("decr_count", 0)
+
+    set_state_dict = load_state_dict
+
+
+class GradScaler(AmpScaler):
+    """paddle.amp.GradScaler parity (reference grad_scaler.py:573)."""
+
+    def unscale_(self, optimizer) -> None:
+        self._unscale(optimizer)
+
+
+def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16",
+             master_weight=None, save_dtype=None):
+    """AMP O2: cast model params to half dtype, keep norm params fp32, arm
+    master weights on the optimizer (reference `amp/__init__.py` decorate)."""
+    from ..nn.layer.norm import _BatchNormBase, GroupNorm, LayerNorm
+
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    dt = canonical_dtype(dtype)
+    if level == "O2":
+        for model in model_list:
+            for layer in model.sublayers(include_self=True):
+                if isinstance(layer, (_BatchNormBase, LayerNorm, GroupNorm)):
+                    continue
+                for store in (layer._parameters,):
+                    for name, p in store.items():
+                        if p is not None and jnp.issubdtype(p._value.dtype, jnp.floating):
+                            p._value = p._value.astype(dt)
+    if optimizers is not None:
+        single_opt = not isinstance(optimizers, (list, tuple))
+        opt_list = [optimizers] if single_opt else list(optimizers)
+        for opt in opt_list:
+            if master_weight is None or master_weight:
+                opt._multi_precision = True
+        if single_model and single_opt:
+            return model_list[0], opt_list[0]
+        return model_list, opt_list
+    return model_list[0] if single_model else model_list

@@ -1,5 +1,68 @@
-"""Placeholder save/load — populated in the io milestone."""
-def save(obj, path, **kw):
-    raise NotImplementedError
-def load(path, **kw):
-    raise NotImplementedError
+"""paddle.save / paddle.load parity (reference: `python/paddle/framework/io.py:721,960`).
+
+Pickle-based nested state_dict serialization: Tensors are stored as numpy
+arrays + metadata; load rebuilds Tensors (to the default device). Accepts
+nested dicts/lists/tuples of Tensors, LRScheduler state, plain python."""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+__all__ = ["save", "load"]
+
+_PROTOCOL = 4
+
+
+class _TensorPayload:
+    __slots__ = ("array", "stop_gradient", "name")
+
+    def __init__(self, array, stop_gradient, name):
+        self.array = array
+        self.stop_gradient = stop_gradient
+        self.name = name
+
+
+def _pack(obj: Any) -> Any:
+    from ..tensor.tensor import Tensor
+
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._value), obj.stop_gradient, obj.name)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj: Any, return_numpy: bool = False) -> Any:
+    from ..tensor.tensor import Tensor
+
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        t = Tensor(obj.array, stop_gradient=obj.stop_gradient, name=obj.name)
+        t.persistable = True
+        return t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = _PROTOCOL, **configs) -> None:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False, **configs) -> Any:
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

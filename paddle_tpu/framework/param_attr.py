@@ -1,0 +1,37 @@
+"""ParamAttr (reference: `python/paddle/base/param_attr.py`): per-parameter
+configuration — name, initializer, learning-rate multiplier, regularizer,
+trainable flag."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+__all__ = ["ParamAttr"]
+
+
+class ParamAttr:
+    def __init__(self, name: Optional[str] = None, initializer=None,
+                 learning_rate: float = 1.0, regularizer=None, trainable: bool = True,
+                 do_model_average: bool = True, need_clip: bool = True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr) -> Optional["ParamAttr"]:
+        """Normalize weight_attr/bias_attr layer args: ParamAttr | None | False
+        | Initializer | str(name)."""
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if attr is False:
+            return None
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        # an Initializer instance
+        return ParamAttr(initializer=attr)

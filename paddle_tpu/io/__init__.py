@@ -1,1 +1,404 @@
-"""Placeholder — populated in a later milestone of this round."""
+"""Data loading (reference: `python/paddle/io/`).
+
+Host-side pipeline: Dataset/IterableDataset/Sampler/BatchSampler/DataLoader
+with multi-threaded prefetch. TPU-first notes:
+
+- ``DistributedBatchSampler`` shards by *process* (host), matching JAX's
+  per-host data-parallel input convention — each host loads only its shard
+  and `jax.make_array_from_process_local_data`-style feeding assembles the
+  global batch (reference: `io/dataloader/batch_sampler.py` DistributedBatchSampler).
+- Workers are threads, not forked processes: batches are numpy, produced by
+  user code that typically releases the GIL (decode/IO); device transfer is
+  the training loop's whole-step jit. (The reference's shared-memory worker
+  pool exists to feed GPUs from Python pickling — unnecessary here.)
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..framework.random import default_generator
+from ..tensor.tensor import Tensor
+
+__all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset", "ChainDataset",
+           "ConcatDataset", "Subset", "random_split", "Sampler", "SequenceSampler",
+           "RandomSampler", "WeightedRandomSampler", "BatchSampler",
+           "DistributedBatchSampler", "DataLoader", "get_worker_info", "default_collate_fn"]
+
+
+class Dataset:
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset(Dataset):
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        raise RuntimeError("IterableDataset is not subscriptable")
+
+    def __len__(self):
+        raise RuntimeError("IterableDataset has no len()")
+
+
+class TensorDataset(Dataset):
+    def __init__(self, tensors: Sequence):
+        self.tensors = [t if isinstance(t, Tensor) else Tensor(np.asarray(t)) for t in tensors]
+        n = self.tensors[0].shape[0]
+        if any(t.shape[0] != n for t in self.tensors):
+            raise ValueError("all tensors must have the same first dimension")
+
+    def __getitem__(self, idx):
+        return tuple(t[idx] for t in self.tensors)
+
+    def __len__(self):
+        return self.tensors[0].shape[0]
+
+
+class ComposeDataset(Dataset):
+    def __init__(self, datasets: List[Dataset]):
+        self.datasets = datasets
+
+    def __getitem__(self, idx):
+        out = []
+        for ds in self.datasets:
+            item = ds[idx]
+            out.extend(item if isinstance(item, (list, tuple)) else [item])
+        return tuple(out)
+
+    def __len__(self):
+        return min(len(ds) for ds in self.datasets)
+
+
+class ChainDataset(IterableDataset):
+    def __init__(self, datasets: List[IterableDataset]):
+        self.datasets = datasets
+
+    def __iter__(self):
+        for ds in self.datasets:
+            yield from ds
+
+
+class ConcatDataset(Dataset):
+    def __init__(self, datasets: List[Dataset]):
+        self.datasets = list(datasets)
+        self.cumulative_sizes = list(itertools.accumulate(len(d) for d in self.datasets))
+
+    def __len__(self):
+        return self.cumulative_sizes[-1]
+
+    def __getitem__(self, idx):
+        if idx < 0:
+            idx += len(self)
+        import bisect
+
+        ds_idx = bisect.bisect_right(self.cumulative_sizes, idx)
+        prev = 0 if ds_idx == 0 else self.cumulative_sizes[ds_idx - 1]
+        return self.datasets[ds_idx][idx - prev]
+
+
+class Subset(Dataset):
+    def __init__(self, dataset: Dataset, indices: Sequence[int]):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+def random_split(dataset: Dataset, lengths: Sequence, generator=None) -> List[Subset]:
+    if all(isinstance(l, float) for l in lengths):
+        n = len(dataset)
+        counts = [int(np.floor(n * l)) for l in lengths]
+        counts[0] += n - sum(counts)
+        lengths = counts
+    total = sum(lengths)
+    if total != len(dataset):
+        raise ValueError(f"sum of lengths {total} != dataset size {len(dataset)}")
+    rng = _np_rng(generator)
+    perm = rng.permutation(total).tolist()
+    out, offset = [], 0
+    for l in lengths:
+        out.append(Subset(dataset, perm[offset:offset + l]))
+        offset += l
+    return out
+
+
+def _np_rng(generator=None) -> np.random.Generator:
+    """numpy RNG seeded from the framework generator: reproducible after
+    paddle.seed(), and advancing per draw so epochs differ."""
+    gen = generator or default_generator
+    if hasattr(gen, "next_key"):
+        entropy = np.asarray(gen.next_key()).astype(np.uint32)
+        return np.random.default_rng(entropy)
+    return np.random.default_rng(gen)
+
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):
+        raise NotImplementedError
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement: bool = False, num_samples: Optional[int] = None,
+                 generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num_samples = num_samples
+        self.generator = generator
+
+    @property
+    def num_samples(self):
+        return self._num_samples or len(self.data_source)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        rng = _np_rng(self.generator)
+        if self.replacement:
+            return iter(rng.integers(0, n, self.num_samples).tolist())
+        return iter(rng.permutation(n)[: self.num_samples].tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights, num_samples: int, replacement: bool = True):
+        super().__init__(None)
+        self.weights = np.asarray(weights, dtype=np.float64)
+        self.num_samples = num_samples
+        self.replacement = replacement
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        rng = _np_rng()
+        idx = rng.choice(len(self.weights), self.num_samples, replace=self.replacement, p=p)
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    def __init__(self, dataset=None, sampler=None, shuffle: bool = False, batch_size: int = 1,
+                 drop_last: bool = False):
+        super().__init__(dataset)
+        if sampler is not None:
+            self.sampler = sampler
+        elif shuffle:
+            self.sampler = RandomSampler(dataset)
+        else:
+            self.sampler = SequenceSampler(dataset)
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Per-host sharding (reference: `io/dataloader/batch_sampler.py`
+    DistributedBatchSampler): pads to a multiple of num_replicas, subsamples
+    rank's slice, optional epoch-seeded shuffle via set_epoch."""
+
+    def __init__(self, dataset, batch_size: int, num_replicas: Optional[int] = None,
+                 rank: Optional[int] = None, shuffle: bool = False, drop_last: bool = False):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        if num_replicas is None or rank is None:
+            try:
+                import jax
+
+                num_replicas = num_replicas if num_replicas is not None else jax.process_count()
+                rank = rank if rank is not None else jax.process_index()
+            except Exception:
+                num_replicas, rank = 1, 0
+        self.nranks = num_replicas
+        self.local_rank = rank
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.num_samples = int(np.ceil(len(dataset) / num_replicas))
+        self.total_size = self.num_samples * num_replicas
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __iter__(self):
+        n = len(self.dataset)
+        indices = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng(self.epoch)
+            indices = rng.permutation(n)
+        indices = np.concatenate([indices, indices[: self.total_size - n]])
+        indices = indices[self.local_rank: self.total_size: self.nranks].tolist()
+        batch = []
+        for idx in indices:
+            batch.append(int(idx))
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+
+class _WorkerInfo:
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_info = threading.local()
+
+
+def get_worker_info():
+    return getattr(_worker_info, "info", None)
+
+
+def default_collate_fn(batch: List[Any]):
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([np.asarray(b._value) for b in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, float, np.number)):
+        return Tensor(np.asarray(batch))
+    if isinstance(sample, (list, tuple)):
+        transposed = zip(*batch)
+        return type(sample)(default_collate_fn(list(items)) for items in transposed)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    return batch
+
+
+class DataLoader:
+    """reference: `io/dataloader/dataloader_iter.py` — here a thread-pool
+    prefetcher with an ordered output queue."""
+
+    def __init__(self, dataset, feed_list=None, places=None, return_list: bool = True,
+                 batch_sampler=None, batch_size: int = 1, shuffle: bool = False,
+                 drop_last: bool = False, collate_fn=None, num_workers: int = 0,
+                 use_buffer_reader: bool = True, prefetch_factor: int = 2,
+                 use_shared_memory: bool = False, timeout: int = 0, worker_init_fn=None,
+                 persistent_workers: bool = False):
+        self.dataset = dataset
+        self.num_workers = num_workers
+        self.collate_fn = collate_fn or default_collate_fn
+        self.prefetch_factor = prefetch_factor
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size, drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    def __iter__(self) -> Iterator:
+        if self._iterable_mode:
+            return self._iter_iterable()
+        if self.num_workers == 0:
+            return self._iter_sync()
+        return self._iter_threaded()
+
+    def _iter_sync(self):
+        for batch_idx in self.batch_sampler:
+            yield self.collate_fn([self.dataset[i] for i in batch_idx])
+
+    def _iter_iterable(self):
+        batch = []
+        for item in self.dataset:
+            batch.append(item)
+            if len(batch) == self.batch_size:
+                yield self.collate_fn(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield self.collate_fn(batch)
+
+    def _iter_threaded(self):
+        indices = list(self.batch_sampler)
+        results: "queue.Queue" = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
+        done = object()
+
+        def worker(worker_id, my_batches):
+            _worker_info.info = _WorkerInfo(worker_id, self.num_workers, self.dataset)
+            for seq, batch_idx in my_batches:
+                try:
+                    data = self.collate_fn([self.dataset[i] for i in batch_idx])
+                except BaseException as e:  # propagate to the consumer, don't hang it
+                    results.put((seq, e))
+                    return
+                results.put((seq, data))
+
+        threads = []
+        for w in range(self.num_workers):
+            my = [(i, b) for i, b in enumerate(indices) if i % self.num_workers == w]
+            t = threading.Thread(target=worker, args=(w, my), daemon=True)
+            t.start()
+            threads.append(t)
+
+        buffered = {}
+        next_seq = 0
+        total = len(indices)
+        while next_seq < total:
+            while next_seq in buffered:
+                data = buffered.pop(next_seq)
+                if isinstance(data, BaseException):
+                    raise data
+                yield data
+                next_seq += 1
+            if next_seq >= total:
+                break
+            seq, data = results.get()
+            buffered[seq] = data
+        for t in threads:
+            t.join(timeout=1.0)

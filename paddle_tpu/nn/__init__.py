@@ -1,1 +1,29 @@
-"""Placeholder — populated in a later milestone of this round."""
+"""paddle_tpu.nn — Layer system + functional ops (reference: `python/paddle/nn`)."""
+
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer.layers import Layer  # noqa: F401
+from .layer.common import (AlphaDropout, Bilinear, CosineSimilarity, Dropout, Dropout2D,  # noqa: F401
+                           Dropout3D, Embedding, Flatten, Identity, Linear, Pad1D, Pad2D,
+                           Pad3D, PixelShuffle, Unfold, Upsample, UpsamplingBilinear2D,
+                           UpsamplingNearest2D)
+from .layer.conv import (Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose, Conv3D,  # noqa: F401
+                         Conv3DTranspose)
+from .layer.norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm,  # noqa: F401
+                         InstanceNorm1D, InstanceNorm2D, InstanceNorm3D, LayerNorm,
+                         LocalResponseNorm, RMSNorm, SpectralNorm, SyncBatchNorm)
+from .layer.pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,  # noqa: F401
+                            AdaptiveMaxPool1D, AdaptiveMaxPool2D, AvgPool1D, AvgPool2D,
+                            AvgPool3D, MaxPool1D, MaxPool2D, MaxPool3D)
+from .layer.activation import (CELU, ELU, GELU, GLU, Hardshrink, Hardsigmoid, Hardswish,  # noqa: F401
+                               Hardtanh, LeakyReLU, LogSoftmax, Maxout, Mish, PReLU, ReLU,
+                               ReLU6, SELU, Sigmoid, SiLU, Softmax, Softplus, Softshrink,
+                               Softsign, Swish, Tanh, Tanhshrink, ThresholdedReLU)
+from .layer.container import LayerDict, LayerList, ParameterList, Sequential  # noqa: F401
+from .layer.loss import (BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss, CrossEntropyLoss,  # noqa: F401
+                         KLDivLoss, L1Loss, MarginRankingLoss, MSELoss, NLLLoss, SmoothL1Loss)
+from .layer.transformer import (MultiHeadAttention, Transformer, TransformerDecoder,  # noqa: F401
+                                TransformerDecoderLayer, TransformerEncoder,
+                                TransformerEncoderLayer)
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+from ..framework.param_attr import ParamAttr  # noqa: F401

@@ -1,0 +1,949 @@
+"""nn.functional (reference: `python/paddle/nn/functional/`).
+
+Paddle-shaped signatures over jnp/lax. Layout convention is NCHW/NCL like the
+reference (XLA transposes to TPU-preferred layouts internally; the jit'ed
+whole-step graph fuses these away). Conv weights are [out, in/groups, *k]."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.random import next_key
+from ...tensor._op_utils import ensure_tensor
+from ...tensor.tensor import Tensor, apply_op
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+def _unary(name, jfn):
+    def op(x, name_=None, **kw):
+        x = ensure_tensor(x)
+        fn = (lambda v: jfn(v, **kw)) if kw else jfn
+        return apply_op(name, fn, (x,))
+
+    op.__name__ = name
+    return op
+
+
+relu = _unary("relu", jax.nn.relu)
+relu6 = _unary("relu6", jax.nn.relu6)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+tanh = _unary("tanh", jnp.tanh)
+softplus = _unary("softplus", jax.nn.softplus)
+softsign = _unary("softsign", jax.nn.soft_sign)
+silu = _unary("silu", jax.nn.silu)
+swish = silu
+mish = _unary("mish", lambda v: v * jnp.tanh(jax.nn.softplus(v)))
+hardswish = _unary("hardswish", jax.nn.hard_swish)
+hardsigmoid = _unary("hardsigmoid", lambda v: jnp.clip(v / 6.0 + 0.5, 0.0, 1.0))
+hardtanh = _unary("hardtanh", lambda v, min=-1.0, max=1.0: jnp.clip(v, min, max))
+elu = _unary("elu", lambda v, alpha=1.0: jax.nn.elu(v, alpha))
+selu = _unary("selu", jax.nn.selu)
+celu = _unary("celu", lambda v, alpha=1.0: jax.nn.celu(v, alpha))
+tanhshrink = _unary("tanhshrink", lambda v: v - jnp.tanh(v))
+
+
+def gelu(x, approximate: bool = False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("gelu", lambda v: jax.nn.gelu(v, approximate=approximate), (x,))
+
+
+def leaky_relu(x, negative_slope: float = 0.01, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("leaky_relu", lambda v: jax.nn.leaky_relu(v, negative_slope), (x,))
+
+
+def prelu(x, weight, data_format="NCHW", name=None) -> Tensor:
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+
+    def fn(v, w):
+        if w.size > 1 and v.ndim > 1:
+            shape = [1] * v.ndim
+            ch_axis = 1 if data_format[1] == "C" else v.ndim - 1
+            shape[ch_axis] = w.size
+            w = w.reshape(shape)
+        return jnp.where(v >= 0, v, w * v)
+
+    return apply_op("prelu", fn, (x, weight))
+
+
+def hardshrink(x, threshold: float = 0.5, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("hardshrink",
+                    lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), (x,))
+
+
+def softshrink(x, threshold: float = 0.5, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("softshrink", lambda v: jnp.where(
+        v > threshold, v - threshold, jnp.where(v < -threshold, v + threshold, 0.0)), (x,))
+
+
+def thresholded_relu(x, threshold: float = 1.0, value: float = 0.0, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("thresholded_relu", lambda v: jnp.where(v > threshold, v, value), (x,))
+
+
+def softmax(x, axis: int = -1, dtype=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("softmax", lambda v: jax.nn.softmax(v, axis=axis), (x,))
+
+
+def log_softmax(x, axis: int = -1, dtype=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("log_softmax", lambda v: jax.nn.log_softmax(v, axis=axis), (x,))
+
+
+def gumbel_softmax(x, temperature: float = 1.0, hard: bool = False, axis: int = -1, name=None):
+    x = ensure_tensor(x)
+    g = jax.random.gumbel(next_key(), tuple(x.shape), x._value.dtype)
+
+    def fn(v):
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            y_hard = jax.nn.one_hot(jnp.argmax(y, axis=axis), v.shape[axis], axis=axis,
+                                    dtype=v.dtype)
+            y = y_hard + y - jax.lax.stop_gradient(y)
+        return y
+
+    return apply_op("gumbel_softmax", fn, (x,))
+
+
+def glu(x, axis: int = -1, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("glu", lambda v: jax.nn.glu(v, axis=axis), (x,))
+
+
+def maxout(x, groups: int, axis: int = 1, name=None) -> Tensor:
+    x = ensure_tensor(x)
+
+    def fn(v):
+        ax = axis if axis >= 0 else v.ndim + axis
+        c = v.shape[ax]
+        new_shape = v.shape[:ax] + (c // groups, groups) + v.shape[ax + 1:]
+        return jnp.max(v.reshape(new_shape), axis=ax + 1)
+
+    return apply_op("maxout", fn, (x,))
+
+
+def swiglu(x, y=None, name=None) -> Tensor:
+    """SwiGLU (reference: `python/paddle/incubate/nn/functional/swiglu.py`)."""
+    x = ensure_tensor(x)
+    if y is not None:
+        y = ensure_tensor(y)
+        return apply_op("swiglu", lambda a, b: jax.nn.silu(a) * b, (x, y))
+    return apply_op("swiglu", lambda v: jax.nn.silu(v[..., : v.shape[-1] // 2]) *
+                    v[..., v.shape[-1] // 2:], (x,))
+
+
+# ---------------------------------------------------------------------------
+# linear / embedding / dropout
+# ---------------------------------------------------------------------------
+def linear(x, weight, bias=None, name=None) -> Tensor:
+    """x [..., in] @ weight [in, out] + bias [out] (paddle weight layout)."""
+    from ...amp import maybe_autocast_tensors
+
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    x, weight = maybe_autocast_tensors("linear", x, weight)
+    if bias is not None:
+        (bias,) = maybe_autocast_tensors("linear", ensure_tensor(bias))
+    if bias is not None:
+        bias = ensure_tensor(bias)
+        return apply_op("linear", lambda v, w, b: jnp.matmul(v, w) + b, (x, weight, bias))
+    return apply_op("linear", jnp.matmul, (x, weight))
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None) -> Tensor:
+    x_idx = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    weight = ensure_tensor(weight)
+
+    def fn(w):
+        out = jnp.take(w, x_idx, axis=0)
+        if padding_idx is not None:
+            mask = (x_idx == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return apply_op("embedding", fn, (weight,))
+
+
+def one_hot(x, num_classes, name=None) -> Tensor:
+    x_idx = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.nn.one_hot(x_idx, num_classes))
+
+
+def dropout(x, p: float = 0.5, axis=None, training: bool = True, mode: str =
+            "upscale_in_train", name=None) -> Tensor:
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply_op("dropout_infer", lambda v: v * (1.0 - p), (x,))
+        return x
+    if p == 1.0:
+        return apply_op("dropout", lambda v: jnp.zeros_like(v), (x,))
+    shape = tuple(x.shape)
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        mask_shape = tuple(s if i in axes else 1 for i, s in enumerate(shape))
+    else:
+        mask_shape = shape
+    keep = jax.random.bernoulli(next_key(), 1.0 - p, mask_shape)
+
+    def fn(v):
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+        return jnp.where(keep, v, 0.0).astype(v.dtype)
+
+    return apply_op("dropout", fn, (x,))
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None) -> Tensor:
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None) -> Tensor:
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = jax.random.bernoulli(next_key(), 1.0 - p, tuple(x.shape))
+    a = (1.0 / np.sqrt((1 - p) * (1 + p * alpha_p ** 2)))
+    b = -a * alpha_p * p
+
+    def fn(v):
+        return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)
+
+    return apply_op("alpha_dropout", fn, (x,))
+
+
+# ---------------------------------------------------------------------------
+# convs
+# ---------------------------------------------------------------------------
+def _tuple_n(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(i) for i in v)
+    return (int(v),) * n
+
+
+def _conv_padding(padding, nd):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * nd
+    padding = list(padding)
+    if len(padding) == nd and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * nd:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(nd)]
+    return [tuple(p) for p in padding]
+
+
+def _convnd(x, weight, bias, stride, padding, dilation, groups, nd, data_format, name):
+    from ...amp import maybe_autocast_tensors
+
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    x, weight = maybe_autocast_tensors("conv", x, weight)
+    strides = _tuple_n(stride, nd)
+    dil = _tuple_n(dilation, nd)
+    pad = _conv_padding(padding, nd)
+    spatial = "DHW"[-nd:]
+    if data_format.startswith("NC"):
+        lhs_spec = "NC" + spatial
+    else:
+        lhs_spec = "N" + spatial + "C"
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x.shape), tuple(weight.shape), (lhs_spec, "OI" + spatial, lhs_spec))
+
+    def fn(v, w, *b):
+        out = jax.lax.conv_general_dilated(
+            v, w.astype(v.dtype), strides, pad, rhs_dilation=dil, dimension_numbers=dn,
+            feature_group_count=groups)
+        if b:
+            bias_shape = [1] * out.ndim
+            c_axis = 1 if lhs_spec.startswith("NC") else out.ndim - 1
+            bias_shape[c_axis] = b[0].size
+            out = out + b[0].astype(v.dtype).reshape(bias_shape)
+        return out
+
+    args = (x, weight) + ((ensure_tensor(bias),) if bias is not None else ())
+    return apply_op(name, fn, args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None) -> Tensor:
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, 1, data_format, "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None) -> Tensor:
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, 2, data_format, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None) -> Tensor:
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, 3, data_format, "conv3d")
+
+
+def _convnd_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, nd,
+                      data_format, name):
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    strides = _tuple_n(stride, nd)
+    dil = _tuple_n(dilation, nd)
+    opad = _tuple_n(output_padding, nd)
+    pad = _conv_padding(padding, nd)
+    spatial = "DHW"[-nd:]
+    lhs_spec = ("NC" + spatial) if data_format.startswith("NC") else ("N" + spatial + "C")
+    # weight layout for paddle conv_transpose: [in, out/groups, *k]
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x.shape), tuple(weight.shape), (lhs_spec, "IO" + spatial, lhs_spec))
+
+    if isinstance(pad, str):
+        pad_cfg = pad
+    else:
+        # conv_transpose effective padding: k-1-p (+ output_padding on the high side)
+        ks = weight.shape[2:]
+        pad_cfg = [
+            (dil[i] * (ks[i] - 1) - pad[i][0], dil[i] * (ks[i] - 1) - pad[i][1] + opad[i])
+            for i in range(nd)]
+
+    def fn(v, w, *b):
+        out = jax.lax.conv_general_dilated(
+            v, w.astype(v.dtype), window_strides=(1,) * nd, padding=pad_cfg,
+            lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn,
+            feature_group_count=groups, transpose_kernel=True)
+        if b:
+            bias_shape = [1] * out.ndim
+            c_axis = 1 if lhs_spec.startswith("NC") else out.ndim - 1
+            bias_shape[c_axis] = b[0].size
+            out = out + b[0].astype(v.dtype).reshape(bias_shape)
+        return out
+
+    args = (x, weight) + ((ensure_tensor(bias),) if bias is not None else ())
+    return apply_op(name, fn, args)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    return _convnd_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                             groups, 1, data_format, "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _convnd_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                             groups, 2, data_format, "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _convnd_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                             groups, 3, data_format, "conv3d_transpose")
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+def _pool(x, kernel, stride, padding, nd, reducer, init, data_format, ceil_mode=False,
+          count_include_pad=True, average=False):
+    x = ensure_tensor(x)
+    ks = _tuple_n(kernel, nd)
+    st = _tuple_n(stride if stride is not None else kernel, nd)
+    pad = _conv_padding(padding, nd)
+    channel_first = data_format.startswith("NC")
+    if channel_first:
+        window = (1, 1) + ks
+        strides = (1, 1) + st
+        pads = [(0, 0), (0, 0)] + (pad if not isinstance(pad, str) else pad)
+    else:
+        window = (1,) + ks + (1,)
+        strides = (1,) + st + (1,)
+        pads = [(0, 0)] + (pad if not isinstance(pad, str) else pad) + [(0, 0)]
+    if isinstance(pad, str):
+        pads = pad
+
+    def fn(v):
+        out = jax.lax.reduce_window(v, init(v.dtype), reducer, window, strides,
+                                    pads if not isinstance(pads, str) else pads)
+        if average:
+            if count_include_pad or (not isinstance(pads, str) and
+                                     all(p == (0, 0) for p in pads)):
+                out = out / np.prod(ks)
+            else:
+                ones = jnp.ones_like(v)
+                counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+                out = out / counts
+        return out
+
+    return apply_op("pool", fn, (x,))
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False,
+               data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, jax.lax.max,
+                 lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating) else jnp.iinfo(dt).min,
+                 data_format)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False,
+               data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, jax.lax.max,
+                 lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating) else jnp.iinfo(dt).min,
+                 data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False,
+               data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, jax.lax.max,
+                 lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating) else jnp.iinfo(dt).min,
+                 data_format)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False,
+               data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, jax.lax.add, lambda dt: 0.0,
+                 data_format, average=True, count_include_pad=not exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, jax.lax.add, lambda dt: 0.0,
+                 data_format, average=True, count_include_pad=not exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, jax.lax.add, lambda dt: 0.0,
+                 data_format, average=True, count_include_pad=not exclusive)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None) -> Tensor:
+    return _adaptive_pool(x, output_size, 1, "avg", "NCL")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None) -> Tensor:
+    return _adaptive_pool(x, output_size, 2, "avg", data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None) -> Tensor:
+    return _adaptive_pool(x, output_size, 3, "avg", data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None) -> Tensor:
+    return _adaptive_pool(x, output_size, 1, "max", "NCL")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None) -> Tensor:
+    return _adaptive_pool(x, output_size, 2, "max", "NCHW")
+
+
+def _adaptive_pool(x, output_size, nd, mode, data_format):
+    x = ensure_tensor(x)
+    out_sz = _tuple_n(output_size, nd)
+    channel_first = data_format.startswith("NC")
+    spatial = tuple(x.shape[2:]) if channel_first else tuple(x.shape[1:-1])
+    if any(s % o != 0 for s, o in zip(spatial, out_sz)):
+        raise NotImplementedError(
+            f"adaptive pool requires divisible spatial dims on TPU (static windows): "
+            f"{spatial} -> {out_sz}")
+    ks = tuple(s // o for s, o in zip(spatial, out_sz))
+    if mode == "avg":
+        if nd == 1:
+            return avg_pool1d(x, ks, ks, 0, data_format=data_format)
+        if nd == 2:
+            return avg_pool2d(x, ks, ks, 0, data_format=data_format)
+        return avg_pool3d(x, ks, ks, 0, data_format=data_format)
+    if nd == 1:
+        return max_pool1d(x, ks, ks, 0, data_format=data_format)
+    return max_pool2d(x, ks, ks, 0, data_format=data_format)
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon: float = 1e-5,
+               name=None) -> Tensor:
+    x = ensure_tensor(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    naxes = tuple(range(-len(tuple(normalized_shape)), 0))
+
+    tensors = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        tensors.append(ensure_tensor(weight))
+    if has_b:
+        tensors.append(ensure_tensor(bias))
+
+    def fn(v, *wb):
+        # compute in fp32 for bf16 stability (TPU norm-in-f32 idiom)
+        vf = v.astype(jnp.float32)
+        mean = jnp.mean(vf, axis=naxes, keepdims=True)
+        var = jnp.mean(jnp.square(vf - mean), axis=naxes, keepdims=True)
+        out = (vf - mean) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if has_w:
+            out = out * wb[i].astype(jnp.float32)
+            i += 1
+        if has_b:
+            out = out + wb[i].astype(jnp.float32)
+        return out.astype(v.dtype)
+
+    return apply_op("layer_norm", fn, tuple(tensors))
+
+
+def rms_norm(x, weight=None, epsilon: float = 1e-6, name=None) -> Tensor:
+    """RMSNorm (reference: `python/paddle/incubate/nn/functional/fused_rms_norm.py`)."""
+    x = ensure_tensor(x)
+    tensors = (x, ensure_tensor(weight)) if weight is not None else (x,)
+
+    def fn(v, *w):
+        vf = v.astype(jnp.float32)
+        ms = jnp.mean(jnp.square(vf), axis=-1, keepdims=True)
+        out = vf * jax.lax.rsqrt(ms + epsilon)
+        if w:
+            out = out * w[0].astype(jnp.float32)
+        return out.astype(v.dtype)
+
+    return apply_op("rms_norm", fn, tensors)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training: bool = False,
+               momentum: float = 0.9, epsilon: float = 1e-5, data_format: str = "NCHW",
+               use_global_stats=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    c_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    bshape = [1] * x.ndim
+    bshape[c_axis] = x.shape[c_axis]
+
+    use_batch_stats = training and not use_global_stats
+    if use_batch_stats:
+        vf = x._value.astype(jnp.float32)
+        batch_mean = jnp.mean(vf, axis=reduce_axes)
+        batch_var = jnp.var(vf, axis=reduce_axes)
+        # update running stats in place (paddle: r = m*r + (1-m)*batch)
+        if running_mean is not None:
+            running_mean._value = (momentum * running_mean._value +
+                                   (1 - momentum) * batch_mean.astype(running_mean._value.dtype))
+            running_var._value = (momentum * running_var._value +
+                                  (1 - momentum) * batch_var.astype(running_var._value.dtype))
+        mean_c, var_c = batch_mean, batch_var
+    else:
+        mean_c = running_mean._value.astype(jnp.float32)
+        var_c = running_var._value.astype(jnp.float32)
+
+    tensors = [x]
+    has_w, has_b = weight is not None, bias is not None
+    if has_w:
+        tensors.append(ensure_tensor(weight))
+    if has_b:
+        tensors.append(ensure_tensor(bias))
+
+    def fn(v, *wb):
+        vf = v.astype(jnp.float32)
+        out = (vf - mean_c.reshape(bshape)) * jax.lax.rsqrt(var_c.reshape(bshape) + epsilon)
+        i = 0
+        if has_w:
+            out = out * wb[i].astype(jnp.float32).reshape(bshape)
+            i += 1
+        if has_b:
+            out = out + wb[i].astype(jnp.float32).reshape(bshape)
+        return out.astype(v.dtype)
+
+    return apply_op("batch_norm", fn, tuple(tensors))
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format="NCHW",
+               name=None) -> Tensor:
+    x = ensure_tensor(x)
+    if not data_format.startswith("NC"):
+        raise NotImplementedError("group_norm: NHWC not yet supported")
+    tensors = [x]
+    has_w, has_b = weight is not None, bias is not None
+    if has_w:
+        tensors.append(ensure_tensor(weight))
+    if has_b:
+        tensors.append(ensure_tensor(bias))
+
+    def fn(v, *wb):
+        n, c = v.shape[0], v.shape[1]
+        vf = v.astype(jnp.float32).reshape((n, num_groups, c // num_groups) + v.shape[2:])
+        axes = tuple(range(2, vf.ndim))
+        mean = jnp.mean(vf, axis=axes, keepdims=True)
+        var = jnp.var(vf, axis=axes, keepdims=True)
+        out = ((vf - mean) * jax.lax.rsqrt(var + epsilon)).reshape(v.shape)
+        bshape = [1] * v.ndim
+        bshape[1] = c
+        i = 0
+        if has_w:
+            out = out * wb[i].astype(jnp.float32).reshape(bshape)
+            i += 1
+        if has_b:
+            out = out + wb[i].astype(jnp.float32).reshape(bshape)
+        return out.astype(v.dtype)
+
+    return apply_op("group_norm", fn, tuple(tensors))
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW",
+                  name=None) -> Tensor:
+    x = ensure_tensor(x)
+    tensors = [x]
+    has_w, has_b = weight is not None, bias is not None
+    if has_w:
+        tensors.append(ensure_tensor(weight))
+    if has_b:
+        tensors.append(ensure_tensor(bias))
+
+    def fn(v, *wb):
+        axes = tuple(range(2, v.ndim))
+        vf = v.astype(jnp.float32)
+        mean = jnp.mean(vf, axis=axes, keepdims=True)
+        var = jnp.var(vf, axis=axes, keepdims=True)
+        out = (vf - mean) * jax.lax.rsqrt(var + eps)
+        bshape = [1] * v.ndim
+        bshape[1] = v.shape[1]
+        i = 0
+        if has_w:
+            out = out * wb[i].astype(jnp.float32).reshape(bshape)
+            i += 1
+        if has_b:
+            out = out + wb[i].astype(jnp.float32).reshape(bshape)
+        return out.astype(v.dtype)
+
+    return apply_op("instance_norm", fn, tuple(tensors))
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None) -> Tensor:
+    x = ensure_tensor(x)
+
+    def fn(v):
+        n = jnp.linalg.norm(v, ord=p, axis=axis, keepdims=True)
+        return v / jnp.maximum(n, epsilon)
+
+    return apply_op("normalize", fn, (x,))
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        sq = jnp.square(v)
+        half = size // 2
+        c = v.shape[1]
+        pads = [(0, 0)] * v.ndim
+        pads[1] = (half, size - half - 1)
+        sq = jnp.pad(sq, pads)
+        acc = sum(sq[:, i:i + c] for i in range(size))
+        return v / jnp.power(k + alpha * acc / size, beta)
+
+    return apply_op("lrn", fn, (x,))
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index: int = -100,
+                  reduction: str = "mean", soft_label: bool = False, axis: int = -1,
+                  use_softmax: bool = True, label_smoothing: float = 0.0, name=None) -> Tensor:
+    input = ensure_tensor(input)
+    lbl = label._value if isinstance(label, Tensor) else jnp.asarray(label)
+    w = None if weight is None else ensure_tensor(weight)._value
+
+    def fn(logits):
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis) if use_softmax \
+            else jnp.log(jnp.maximum(logits.astype(jnp.float32), 1e-30))
+        nclass = logits.shape[axis]
+        if soft_label:
+            soft = lbl.astype(jnp.float32)
+        else:
+            hard = lbl
+            if hard.ndim == lp.ndim:  # [..., 1] labels (paddle convention)
+                hard = jnp.squeeze(hard, axis=axis)
+            soft = jax.nn.one_hot(hard, nclass, axis=axis)
+        if label_smoothing > 0.0:
+            soft = soft * (1 - label_smoothing) + label_smoothing / nclass
+        loss = -jnp.sum(soft * lp, axis=axis)
+        if not soft_label:
+            hard = lbl
+            if hard.ndim == lp.ndim:
+                hard = jnp.squeeze(hard, axis=axis)
+            valid = hard != ignore_index
+            loss = jnp.where(valid, loss, 0.0)
+            if w is not None:
+                loss = loss * jnp.take(w, jnp.clip(hard, 0, nclass - 1))
+            if reduction == "mean":
+                denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0) if w is None \
+                    else jnp.maximum(jnp.sum(jnp.where(
+                        valid, jnp.take(w, jnp.clip(hard, 0, nclass - 1)), 0.0)), 1e-12)
+                return jnp.sum(loss) / denom
+        return _reduce_loss(loss, reduction)
+
+    return apply_op("cross_entropy", fn, (input,))
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index,
+                         reduction="none", axis=axis)
+    from ...tensor.manipulation import unsqueeze
+
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    input = ensure_tensor(input)
+    lbl = label._value if isinstance(label, Tensor) else jnp.asarray(label)
+    w = None if weight is None else ensure_tensor(weight)._value
+
+    def fn(lp):
+        nclass = lp.shape[1]
+        picked = jnp.take_along_axis(
+            lp, jnp.expand_dims(jnp.clip(lbl, 0, nclass - 1), 1), axis=1).squeeze(1)
+        loss = -picked
+        valid = lbl != ignore_index
+        loss = jnp.where(valid, loss, 0.0)
+        if w is not None:
+            wt = jnp.take(w, jnp.clip(lbl, 0, nclass - 1))
+            loss = loss * wt
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(jnp.where(valid, wt, 0.0)), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+        return _reduce_loss(loss, reduction)
+
+    return apply_op("nll_loss", fn, (input,))
+
+
+def mse_loss(input, label, reduction="mean", name=None) -> Tensor:
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return apply_op("mse_loss",
+                    lambda a, b: _reduce_loss(jnp.square(a - b), reduction), (input, label))
+
+
+def l1_loss(input, label, reduction="mean", name=None) -> Tensor:
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return apply_op("l1_loss",
+                    lambda a, b: _reduce_loss(jnp.abs(a - b), reduction), (input, label))
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None) -> Tensor:
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def fn(a, b):
+        d = a - b
+        loss = jnp.where(jnp.abs(d) < delta, 0.5 * d * d / delta, jnp.abs(d) - 0.5 * delta)
+        return _reduce_loss(loss, reduction)
+
+    return apply_op("smooth_l1_loss", fn, (input, label))
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None) -> Tensor:
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def fn(p, t):
+        p = jnp.clip(p.astype(jnp.float32), 1e-12, 1 - 1e-12)
+        loss = -(t * jnp.log(p) + (1 - t) * jnp.log1p(-p))
+        if weight is not None:
+            loss = loss * (weight._value if isinstance(weight, Tensor) else weight)
+        return _reduce_loss(loss, reduction)
+
+    return apply_op("bce", fn, (input, label))
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None) -> Tensor:
+    logit, label = ensure_tensor(logit), ensure_tensor(label)
+
+    def fn(z, t):
+        zf = z.astype(jnp.float32)
+        base = jnp.maximum(zf, 0) - zf * t + jnp.log1p(jnp.exp(-jnp.abs(zf)))
+        if pos_weight is not None:
+            pw = pos_weight._value if isinstance(pos_weight, Tensor) else jnp.asarray(pos_weight)
+            log_w = (pw - 1) * t + 1
+            base = base * log_w
+        if weight is not None:
+            base = base * (weight._value if isinstance(weight, Tensor) else weight)
+        return _reduce_loss(base, reduction)
+
+    return apply_op("bce_logits", fn, (logit, label))
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None) -> Tensor:
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def fn(lp, t):
+        if log_target:
+            loss = jnp.exp(t) * (t - lp)
+        else:
+            loss = t * (jnp.log(jnp.maximum(t, 1e-30)) - lp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / lp.shape[0]
+        return _reduce_loss(loss, reduction)
+
+    return apply_op("kl_div", fn, (input, label))
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8) -> Tensor:
+    x1, x2 = ensure_tensor(x1), ensure_tensor(x2)
+
+    def fn(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, eps)
+
+    return apply_op("cosine_similarity", fn, (x1, x2))
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    sim = cosine_similarity(input1, input2, axis=1)
+    label = ensure_tensor(label)
+
+    def fn(s, t):
+        loss = jnp.where(t > 0, 1 - s, jnp.maximum(0.0, s - margin))
+        return _reduce_loss(loss, reduction)
+
+    return apply_op("cosine_embedding_loss", fn, (sim, label))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    input, other, label = ensure_tensor(input), ensure_tensor(other), ensure_tensor(label)
+
+    def fn(a, b, t):
+        return _reduce_loss(jnp.maximum(0.0, -t * (a - b) + margin), reduction)
+
+    return apply_op("margin_ranking_loss", fn, (input, other, label))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None) -> Tensor:
+    label = ensure_tensor(label)
+
+    def fn(t):
+        n = t.shape[-1]
+        if prior_dist is not None:
+            pd = prior_dist._value if isinstance(prior_dist, Tensor) else jnp.asarray(prior_dist)
+            return (1 - epsilon) * t + epsilon * pd
+        return (1 - epsilon) * t + epsilon / n
+
+    return apply_op("label_smooth", fn, (label,))
+
+
+def square_error_cost(input, label) -> Tensor:
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return apply_op("square_error_cost", lambda a, b: jnp.square(a - b), (input, label))
+
+
+# ---------------------------------------------------------------------------
+# attention
+# ---------------------------------------------------------------------------
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None) -> Tensor:
+    """SDPA (reference: `nn/functional/flash_attention.py:442`). Inputs
+    [batch, seq, heads, head_dim] (paddle flash-attn layout). Dispatches to
+    the Pallas flash kernel on TPU when shapes allow, else the XLA path."""
+    from ...ops.attention import sdpa_reference
+
+    from ...amp import maybe_autocast_tensors
+
+    query, key, value = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    query, key, value = maybe_autocast_tensors("sdpa", query, key, value)
+    mask_val = attn_mask._value if isinstance(attn_mask, Tensor) else attn_mask
+    tensors = (query, key, value)
+    p = dropout_p if training else 0.0
+    dkey = next_key() if p > 0.0 else None
+
+    def fn(q, k, v):
+        return sdpa_reference(q, k, v, mask=mask_val, is_causal=is_causal,
+                              dropout_p=p, dropout_key=dkey)
+
+    return apply_op("sdpa", fn, tensors)
+
+
+# ---------------------------------------------------------------------------
+# vision / misc
+# ---------------------------------------------------------------------------
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format="NCHW", name=None) -> Tensor:
+    x = ensure_tensor(x)
+    channel_first = data_format.startswith("NC")
+    spatial = tuple(x.shape[2:]) if channel_first else tuple(x.shape[1:-1])
+    if size is None:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * len(spatial)
+        size = tuple(int(s * f) for s, f in zip(spatial, scale_factor))
+    else:
+        size = _tuple_n(size, len(spatial))
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "bicubic": "cubic", "trilinear": "linear", "area": "linear"}[mode]
+
+    def fn(v):
+        if channel_first:
+            tgt = v.shape[:2] + size
+        else:
+            tgt = (v.shape[0],) + size + (v.shape[-1],)
+        return jax.image.resize(v, tgt, method=jmode).astype(v.dtype)
+
+    return apply_op("interpolate", fn, (x,))
+
+
+upsample = interpolate
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None) -> Tensor:
+    x = ensure_tensor(x)
+    r = upscale_factor
+
+    def fn(v):
+        n, c, h, w = v.shape
+        v = v.reshape(n, c // (r * r), r, r, h, w)
+        v = v.transpose(0, 1, 4, 2, 5, 3)
+        return v.reshape(n, c // (r * r), h * r, w * r)
+
+    return apply_op("pixel_shuffle", fn, (x,))
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    ks = _tuple_n(kernel_sizes, 2)
+    st = _tuple_n(strides, 2)
+    pd = _tuple_n(paddings, 2)
+    dl = _tuple_n(dilations, 2)
+
+    def fn(v):
+        n, c, h, w = v.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            v, ks, st, [(pd[0], pd[0]), (pd[1], pd[1])], rhs_dilation=dl,
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                v.shape, (1, 1) + ks, ("NCHW", "OIHW", "NCHW")))
+        return patches.reshape(n, patches.shape[1], -1)
+
+    return apply_op("unfold", fn, (x,))
+
+
+from ...tensor.manipulation import pad  # noqa: E402,F401 (paddle exposes F.pad)
+from ...tensor.creation import Parameter  # noqa: E402,F401

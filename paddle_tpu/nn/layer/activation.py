@@ -1,0 +1,84 @@
+"""Activation layers (reference: `python/paddle/nn/layer/activation.py`)."""
+
+from __future__ import annotations
+
+from ...framework.param_attr import ParamAttr
+from .. import functional as F
+from .. import initializer as I
+from .layers import Layer
+
+__all__ = ["ReLU", "ReLU6", "GELU", "SiLU", "Swish", "Mish", "Sigmoid", "Tanh", "Softmax",
+           "LogSoftmax", "LeakyReLU", "PReLU", "ELU", "SELU", "CELU", "Hardswish",
+           "Hardsigmoid", "Hardtanh", "Hardshrink", "Softshrink", "Softplus", "Softsign",
+           "Tanhshrink", "ThresholdedReLU", "Maxout", "GLU"]
+
+
+def _act(name, fname, **fixed):
+    def __init__(self, *args, **kw):
+        Layer.__init__(self)
+        self._kw = {**fixed}
+        sig = _SIGS.get(name, [])
+        for i, a in enumerate(args):
+            self._kw[sig[i]] = a
+        for k, v in kw.items():
+            if k != "name":
+                self._kw[k] = v
+
+    def forward(self, x):
+        return getattr(F, fname)(x, **self._kw)
+
+    return type(name, (Layer,), {"__init__": __init__, "forward": forward})
+
+
+_SIGS = {
+    "Softmax": ["axis"],
+    "LogSoftmax": ["axis"],
+    "LeakyReLU": ["negative_slope"],
+    "ELU": ["alpha"],
+    "CELU": ["alpha"],
+    "Hardtanh": ["min", "max"],
+    "Hardshrink": ["threshold"],
+    "Softshrink": ["threshold"],
+    "ThresholdedReLU": ["threshold", "value"],
+    "Maxout": ["groups", "axis"],
+    "GLU": ["axis"],
+    "GELU": ["approximate"],
+}
+
+ReLU = _act("ReLU", "relu")
+ReLU6 = _act("ReLU6", "relu6")
+GELU = _act("GELU", "gelu")
+SiLU = _act("SiLU", "silu")
+Swish = _act("Swish", "swish")
+Mish = _act("Mish", "mish")
+Sigmoid = _act("Sigmoid", "sigmoid")
+Tanh = _act("Tanh", "tanh")
+Softmax = _act("Softmax", "softmax")
+LogSoftmax = _act("LogSoftmax", "log_softmax")
+LeakyReLU = _act("LeakyReLU", "leaky_relu")
+ELU = _act("ELU", "elu")
+SELU = _act("SELU", "selu")
+CELU = _act("CELU", "celu")
+Hardswish = _act("Hardswish", "hardswish")
+Hardsigmoid = _act("Hardsigmoid", "hardsigmoid")
+Hardtanh = _act("Hardtanh", "hardtanh")
+Hardshrink = _act("Hardshrink", "hardshrink")
+Softshrink = _act("Softshrink", "softshrink")
+Softplus = _act("Softplus", "softplus")
+Softsign = _act("Softsign", "softsign")
+Tanhshrink = _act("Tanhshrink", "tanhshrink")
+ThresholdedReLU = _act("ThresholdedReLU", "thresholded_relu")
+Maxout = _act("Maxout", "maxout")
+GLU = _act("GLU", "glu")
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter([num_parameters], attr=weight_attr,
+                                            default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, data_format=self._data_format)

@@ -1,0 +1,361 @@
+"""Layer: the module system (reference: `python/paddle/nn/layer/layers.py`).
+
+Design: a Layer owns Parameters (Tensors with stop_gradient=False,
+persistable=True) and buffers, registered via ``__setattr__`` like the
+reference. The whole state is a pytree (dicts of Tensors), so a jitted train
+step extracts ``state_dict()``, transforms it functionally, and writes back —
+eager mode mutates the same Tensors in place. Forward hooks match the
+reference's contract (the ZeRO-3 implementation hangs param gather/release
+on them, `group_sharded_stage3.py:577,589`)."""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework import dtype as _dtype_mod
+from ...framework.param_attr import ParamAttr
+from ...framework.random import next_key
+from ...tensor.tensor import Tensor
+from .. import initializer as I
+
+__all__ = ["Layer"]
+
+_layer_name_counters: Dict[str, int] = collections.defaultdict(int)
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks: dict, hook_id: int):
+        self._hooks = hooks
+        self._hook_id = hook_id
+
+    def remove(self) -> None:
+        self._hooks.pop(self._hook_id, None)
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype: Any = "float32"):
+        cls = self.__class__.__name__.lower()
+        name_scope = name_scope or cls
+        _layer_name_counters[name_scope] += 1
+        self._full_name = f"{name_scope}_{_layer_name_counters[name_scope] - 1}"
+        self._dtype = _dtype_mod.canonical_dtype(dtype)
+        self.training = True
+        self._parameters: "collections.OrderedDict[str, Tensor]" = collections.OrderedDict()
+        self._buffers: "collections.OrderedDict[str, Tensor]" = collections.OrderedDict()
+        self._non_persistable_buffer_names: set = set()
+        self._sub_layers: "collections.OrderedDict[str, Layer]" = collections.OrderedDict()
+        self._forward_pre_hooks: "collections.OrderedDict[int, Callable]" = collections.OrderedDict()
+        self._forward_post_hooks: "collections.OrderedDict[int, Callable]" = collections.OrderedDict()
+        self._hook_id = 0
+        self._casted_dtype = None
+
+    # ------------------------------------------------------------------
+    # parameter / buffer / sublayer registration
+    # ------------------------------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias: bool = False,
+                         default_initializer=None) -> Tensor:
+        attr = ParamAttr._to_attr(attr)
+        if attr is None:
+            raise ValueError("attr=False is handled by the calling layer (means: no parameter)")
+        dtype = _dtype_mod.canonical_dtype(dtype or self._dtype)
+        init = attr.initializer or default_initializer or (
+            I.Constant(0.0) if is_bias else I.XavierNormal())
+        value = init(tuple(int(s) for s in shape), dtype, next_key())
+        p = Tensor(value, stop_gradient=not attr.trainable, name=attr.name)
+        p.persistable = True
+        # optimizer reads these attrs for lr-scaling / clip exemption
+        p.optimize_attr = {"learning_rate": attr.learning_rate}
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+        p.is_distributed = False
+        return p
+
+    def add_parameter(self, name: str, parameter: Optional[Tensor]) -> Optional[Tensor]:
+        if parameter is None:
+            self._parameters[name] = None
+        else:
+            if not isinstance(parameter, Tensor):
+                raise TypeError(f"parameter must be a Tensor, got {type(parameter)}")
+            parameter.persistable = True
+            self._parameters[name] = parameter
+        return parameter
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor],
+                        persistable: bool = True) -> Optional[Tensor]:
+        if tensor is not None and not isinstance(tensor, Tensor):
+            tensor = Tensor(tensor)
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        else:
+            self._non_persistable_buffer_names.discard(name)
+        return tensor
+
+    def add_sublayer(self, name: str, sublayer: "Layer") -> "Layer":
+        if not isinstance(sublayer, Layer):
+            raise TypeError(f"sublayer must be a Layer, got {type(sublayer)}")
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        params = self.__dict__.get("_parameters")
+        if params is not None and name in params and not isinstance(value, Tensor):
+            if value is None:
+                params[name] = None
+                return
+        if isinstance(value, Layer):
+            layers = self.__dict__.get("_sub_layers")
+            if layers is not None:
+                layers[name] = value
+                self.__dict__.pop(name, None)
+                return
+        elif isinstance(value, Tensor):
+            if params is not None:
+                if value.persistable and not value.stop_gradient:
+                    params[name] = value
+                    self.__dict__.pop(name, None)
+                    if self.__dict__.get("_buffers", {}) and name in self._buffers:
+                        del self._buffers[name]
+                    return
+                buffers = self.__dict__.get("_buffers")
+                if buffers is not None:
+                    if name in params:
+                        params[name] = value  # re-assignment of an existing param slot
+                        return
+                    buffers[name] = value
+                    self._non_persistable_buffer_names.add(name)
+                    self.__dict__.pop(name, None)
+                    return
+        object.__setattr__(self, name, value)
+
+    def __getattr__(self, name: str) -> Any:
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    def __delattr__(self, name: str) -> None:
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        extra = list(self._parameters) + list(self._buffers) + list(self._sub_layers)
+        return sorted(set(list(super().__dir__()) + extra))
+
+    # ------------------------------------------------------------------
+    # traversal
+    # ------------------------------------------------------------------
+    def named_parameters(self, prefix: str = "", include_sublayers: bool = True
+                         ) -> Iterator[Tuple[str, Tensor]]:
+        seen = set()
+        for name, layer in ([("", self)] + (list(self.named_sublayers(prefix="")) if
+                                            include_sublayers else [])):
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                full = ".".join(x for x in (prefix, name, pname) if x)
+                yield full, p
+
+    def parameters(self, include_sublayers: bool = True) -> List[Tensor]:
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix: str = "", include_sublayers: bool = True
+                      ) -> Iterator[Tuple[str, Tensor]]:
+        seen = set()
+        for name, layer in ([("", self)] + (list(self.named_sublayers(prefix="")) if
+                                            include_sublayers else [])):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                full = ".".join(x for x in (prefix, name, bname) if x)
+                yield full, b
+
+    def buffers(self, include_sublayers: bool = True) -> List[Tensor]:
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False
+                        ) -> Iterator[Tuple[str, "Layer"]]:
+        if include_self:
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            full = ".".join(x for x in (prefix, name) if x)
+            yield full, sub
+            yield from sub.named_sublayers(prefix=full)
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self) -> Iterator["Layer"]:
+        for _, sub in self.named_children():
+            yield sub
+
+    def named_children(self) -> Iterator[Tuple[str, "Layer"]]:
+        for name, sub in self._sub_layers.items():
+            if sub is not None:
+                yield name, sub
+
+    def apply(self, fn: Callable[["Layer"], None]) -> "Layer":
+        for sub in self.children():
+            sub.apply(fn)
+        fn(self)
+        return self
+
+    # ------------------------------------------------------------------
+    # state dict
+    # ------------------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers: bool = True,
+                   structured_name_prefix: str = "", use_hook: bool = True
+                   ) -> "collections.OrderedDict[str, Tensor]":
+        out = destination if destination is not None else collections.OrderedDict()
+        layers = [(structured_name_prefix, self)]
+        if include_sublayers:
+            layers += [(".".join(x for x in (structured_name_prefix, n) if x), l)
+                       for n, l in self.named_sublayers()]
+        for lname, layer in layers:
+            for pname, p in layer._parameters.items():
+                if p is not None:
+                    out[".".join(x for x in (lname, pname) if x)] = p
+            for bname, b in layer._buffers.items():
+                if b is not None and bname not in layer._non_persistable_buffer_names:
+                    out[".".join(x for x in (lname, bname) if x)] = b
+        return out
+
+    def set_state_dict(self, state_dict: Dict[str, Any], use_structured_name: bool = True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, t in own.items():
+            if name in state_dict:
+                v = state_dict[name]
+                arr = v._value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+                if tuple(arr.shape) != tuple(t._value.shape):
+                    raise ValueError(
+                        f"shape mismatch for {name!r}: checkpoint {tuple(arr.shape)} vs "
+                        f"model {tuple(t._value.shape)}")
+                t._value = arr.astype(t._value.dtype)
+                t._producer = None
+            else:
+                missing.append(name)
+        for k in state_dict:
+            if k not in own:
+                unexpected.append(k)
+        return missing, unexpected
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    # ------------------------------------------------------------------
+    # modes / dtype / device
+    # ------------------------------------------------------------------
+    def train(self) -> "Layer":
+        for layer in self.sublayers(include_self=True):
+            layer.training = True
+        return self
+
+    def eval(self) -> "Layer":
+        for layer in self.sublayers(include_self=True):
+            layer.training = False
+        return self
+
+    def to(self, device=None, dtype=None, blocking=None) -> "Layer":
+        from ...device import DeviceGuard, Place, current_device
+        import jax
+
+        place = None
+        if device is not None:
+            if isinstance(device, str):
+                with DeviceGuard(device):
+                    place = current_device()
+            elif isinstance(device, Place):
+                place = device
+        dt = None if dtype is None else _dtype_mod.canonical_dtype(dtype)
+        for t in list(self.parameters()) + list(self.buffers()):
+            v = t._value
+            if dt is not None and jnp.issubdtype(v.dtype, jnp.floating):
+                v = v.astype(dt)
+            if place is not None:
+                v = jax.device_put(v, place.jax_device)
+            t._value = v
+            t._producer = None
+        if dt is not None:
+            for layer in self.sublayers(include_self=True):
+                layer._dtype = dt
+        return self
+
+    def astype(self, dtype) -> "Layer":
+        return self.to(dtype=dtype)
+
+    def float(self) -> "Layer":
+        return self.to(dtype="float32")
+
+    def half(self) -> "Layer":
+        return self.to(dtype="float16")
+
+    def bfloat16(self) -> "Layer":
+        return self.to(dtype="bfloat16")
+
+    # ------------------------------------------------------------------
+    # hooks + call
+    # ------------------------------------------------------------------
+    def register_forward_pre_hook(self, hook: Callable) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook: Callable) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, inputs, outputs)
+            if result is not None:
+                outputs = result
+        return outputs
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError(
+            f"Layer {type(self).__name__} does not implement forward()")
+
+    # ------------------------------------------------------------------
+    # misc
+    # ------------------------------------------------------------------
+    def full_name(self) -> str:
+        return self._full_name
+
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self) -> str:
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            sub_repr = [sub_repr[0]] + ["  " + l for l in sub_repr[1:]]
+            lines.append(f"  ({name}): " + "\n".join(sub_repr))
+        main = f"{type(self).__name__}({extra}"
+        if lines:
+            return main + "\n" + "\n".join(lines) + "\n)"
+        return main + ")"
+
+    def clear_gradients(self) -> None:
+        for p in self.parameters():
+            p.clear_grad()

@@ -1,0 +1,1 @@
+"""Low-level op implementations: XLA reference paths + Pallas TPU kernels."""

@@ -1,0 +1,48 @@
+"""Attention compute paths.
+
+``sdpa_reference``: pure-XLA scaled dot-product attention in the paddle
+flash-attn layout [batch, seq, heads, head_dim] (reference:
+`paddle/phi/kernels/gpu/flash_attn_kernel.cu` exposed at
+`nn/functional/flash_attention.py`). Supports GQA (kv heads dividing q
+heads), causal masking, additive masks. XLA fuses this well on TPU for
+moderate sequence lengths; `ops/pallas/flash_attention.py` provides the
+long-sequence tiled kernel and is dispatched by the functional wrapper when
+available."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def sdpa_reference(q: jax.Array, k: jax.Array, v: jax.Array, mask=None,
+                   is_causal: bool = False, dropout_p: float = 0.0,
+                   scale: Optional[float] = None, dropout_key=None) -> jax.Array:
+    """q [b, sq, hq, d]; k/v [b, sk, hkv, d]; returns [b, sq, hq, d]."""
+    b, sq, hq, d = q.shape
+    _, sk, hkv, _ = k.shape
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    if hkv != hq:
+        if hq % hkv != 0:
+            raise ValueError(f"GQA requires kv heads ({hkv}) to divide q heads ({hq})")
+        rep = hq // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    # [b, h, sq, sk] — accumulate logits in f32 for bf16 inputs
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        logits = logits + mask.astype(logits.dtype)
+    if is_causal:
+        causal = jnp.tril(jnp.ones((sq, sk), jnp.bool_), k=sk - sq)
+        logits = jnp.where(causal[None, None], logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    probs = probs.astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

@@ -1,1 +1,395 @@
-"""Placeholder — populated in a later milestone of this round."""
+"""Optimizers (reference: `python/paddle/optimizer/`).
+
+Paddle-shaped API (parameters list, per-param accumulators, grad_clip,
+LRScheduler integration) with pure-functional update rules: each optimizer
+implements ``_update_rule(p, g, state, lr) -> (new_p, new_state)`` over raw
+jax arrays. Eager ``step()`` loops the rule over params; the jitted train
+path (`paddle_tpu.jit.TrainStep`) calls the same rule inside the compiled
+step so eager and compiled training share one numerical implementation.
+
+``multi_precision`` keeps fp32 master weights for bf16/fp16 params (reference
+AMP O2 semantics)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd import no_grad
+from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
+from ..tensor.tensor import Tensor
+from . import lr as lr_module
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
+           "Adadelta", "RMSProp", "Lamb", "lr", "L1Decay", "L2Decay"]
+
+lr = lr_module
+
+
+class L2Decay:
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+
+
+class L1Decay:
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+
+
+class Optimizer:
+    """Base optimizer.
+
+    state layout: ``self._accumulators[param_id][slot_name] -> jax array``;
+    exposed via state_dict() using parameter names for checkpoint parity."""
+
+    _slot_names: Tuple[str, ...] = ()
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision: bool = False, name=None):
+        if parameters is None:
+            raise ValueError("paddle_tpu optimizers require an explicit parameters= list "
+                             "(dygraph-style), e.g. parameters=model.parameters()")
+        self._parameter_list = list(parameters)
+        self._learning_rate = learning_rate
+        if isinstance(weight_decay, (L2Decay, L1Decay)):
+            self._weight_decay = weight_decay.coeff
+            self._decay_mode = "l1" if isinstance(weight_decay, L1Decay) else "l2"
+        else:
+            self._weight_decay = float(weight_decay) if weight_decay else 0.0
+            self._decay_mode = "l2"
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._accumulators: Dict[int, Dict[str, jax.Array]] = {}
+        self._master_weights: Dict[int, jax.Array] = {}
+        self._step_count = 0
+
+    # -- lr ------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float) -> None:
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler; call "
+                               "scheduler.step() instead")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler: LRScheduler) -> None:
+        self._learning_rate = scheduler
+
+    # -- state ----------------------------------------------------------
+    def _state_for(self, p: Tensor) -> Dict[str, jax.Array]:
+        st = self._accumulators.get(id(p))
+        if st is None:
+            st = self._init_state(p)
+            self._accumulators[id(p)] = st
+        return st
+
+    def _init_state(self, p: Tensor) -> Dict[str, jax.Array]:
+        return {name: jnp.zeros_like(self._master(p)) for name in self._slot_names}
+
+    def _master(self, p: Tensor) -> jax.Array:
+        """fp32 view of the parameter (master weight when multi_precision)."""
+        if self._multi_precision and p._value.dtype in (jnp.bfloat16, jnp.float16):
+            mw = self._master_weights.get(id(p))
+            if mw is None:
+                mw = p._value.astype(jnp.float32)
+                self._master_weights[id(p)] = mw
+            return mw
+        return p._value
+
+    # -- core step --------------------------------------------------------
+    def _update_rule(self, p: jax.Array, g: jax.Array, state: Dict[str, jax.Array],
+                     lr: float, param_meta=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        raise NotImplementedError
+
+    @no_grad()
+    def step(self) -> None:
+        params_grads = [(p, p._grad) for p in self._parameter_list
+                        if not p.stop_gradient and p._grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        base_lr = self.get_lr()
+        for p, g in params_grads:
+            if g is None:
+                continue
+            lr_mult = getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
+            pv = self._master(p)
+            gv = g._value.astype(pv.dtype)
+            new_p, new_state = self._update_rule(pv, gv, self._state_for(p),
+                                                 base_lr * lr_mult, param_meta=p)
+            if self._multi_precision and p._value.dtype in (jnp.bfloat16, jnp.float16):
+                self._master_weights[id(p)] = new_p
+                p._value = new_p.astype(p._value.dtype)
+            else:
+                p._value = new_p
+            p._producer = None
+            self._accumulators[id(p)] = new_state
+        self._step_count += 1
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    @no_grad()
+    def clear_grad(self, set_to_zero: bool = False) -> None:
+        for p in self._parameter_list:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    # -- checkpointing ------------------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for i, p in enumerate(self._parameter_list):
+            key = p.name or f"param_{i}"
+            st = self._accumulators.get(id(p))
+            if st:
+                for slot, v in st.items():
+                    out[f"{key}.{slot}"] = Tensor(v) if not isinstance(v, int) else v
+            mw = self._master_weights.get(id(p))
+            if mw is not None:
+                out[f"{key}.master_weight"] = Tensor(mw)
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        out["@step"] = self._step_count
+        return out
+
+    def set_state_dict(self, state: Dict[str, Any]) -> None:
+        for i, p in enumerate(self._parameter_list):
+            key = p.name or f"param_{i}"
+            st = {}
+            for slot in self._slot_names + ("@t",):
+                v = state.get(f"{key}.{slot}")
+                if v is None:
+                    continue
+                if isinstance(v, Tensor):
+                    st[slot] = v._value
+                elif isinstance(v, (int, float)):
+                    st[slot] = v
+                else:
+                    st[slot] = jnp.asarray(np.asarray(v))
+            if st:
+                self._accumulators[id(p)] = st
+            mw = state.get(f"{key}.master_weight")
+            if mw is not None:
+                self._master_weights[id(p)] = (
+                    mw._value if isinstance(mw, Tensor) else jnp.asarray(np.asarray(mw)))
+        if "LR_Scheduler" in state and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state["LR_Scheduler"])
+        self._step_count = int(state.get("@step", 0))
+
+    # applied l2 decay (coupled) for SGD-family rules
+    def _coupled_decay(self, p, g, param_meta):
+        if self._weight_decay and getattr(param_meta, "regularizer", None) is None:
+            if self._decay_mode == "l2":
+                return g + self._weight_decay * p
+            return g + self._weight_decay * jnp.sign(p)
+        return g
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _update_rule(self, p, g, state, lr, param_meta=None):
+        g = self._coupled_decay(p, g, param_meta)
+        return p - lr * g, state
+
+
+class Momentum(Optimizer):
+    _slot_names = ("velocity",)
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _update_rule(self, p, g, state, lr, param_meta=None):
+        g = self._coupled_decay(p, g, param_meta)
+        v = self._momentum * state["velocity"] + g
+        if self._use_nesterov:
+            new_p = p - lr * (g + self._momentum * v)
+        else:
+            new_p = p - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    _slot_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, use_multi_tensor=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._amsgrad = amsgrad
+        if amsgrad:
+            self._slot_names = ("moment1", "moment2", "moment2_max")
+
+    def _decoupled(self):
+        return False
+
+    def _update_rule(self, p, g, state, lr, param_meta=None):
+        if not self._decoupled():
+            g = self._coupled_decay(p, g, param_meta)
+        t = state.get("@t", 0) + 1
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g)
+        mhat = m / (1 - self._beta1 ** t)
+        if self._amsgrad:
+            vmax = jnp.maximum(state.get("moment2_max", jnp.zeros_like(v)), v)
+            vhat = vmax / (1 - self._beta2 ** t)
+        else:
+            vhat = v / (1 - self._beta2 ** t)
+        new_p = p - lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        if self._decoupled() and self._should_decay(param_meta):
+            new_p = new_p - lr * self._weight_decay * p
+        out = {"moment1": m, "moment2": v, "@t": t}
+        if self._amsgrad:
+            out["moment2_max"] = vmax
+        return new_p, out
+
+    def _should_decay(self, param_meta):
+        return bool(self._weight_decay)
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: `python/paddle/optimizer/adamw.py`).
+    ``apply_decay_param_fun(name)->bool`` exempts params (e.g. biases/norms)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         amsgrad=amsgrad, name=name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _decoupled(self):
+        return True
+
+    def _should_decay(self, param_meta):
+        if not self._weight_decay:
+            return False
+        if self._apply_decay_param_fun is not None and param_meta is not None:
+            return self._apply_decay_param_fun(param_meta.name or "")
+        return True
+
+
+class Adamax(Optimizer):
+    _slot_names = ("moment", "inf_norm")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _update_rule(self, p, g, state, lr, param_meta=None):
+        g = self._coupled_decay(p, g, param_meta)
+        t = state.get("@t", 0) + 1
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g))
+        new_p = p - (lr / (1 - self._beta1 ** t)) * m / (u + self._epsilon)
+        return new_p, {"moment": m, "inf_norm": u, "@t": t}
+
+
+class Adagrad(Optimizer):
+    _slot_names = ("moment",)
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None,
+                 grad_clip=None, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
+        self._epsilon = epsilon
+        self._init_value = initial_accumulator_value
+
+    def _init_state(self, p):
+        return {"moment": jnp.full_like(self._master(p), self._init_value)}
+
+    def _update_rule(self, p, g, state, lr, param_meta=None):
+        g = self._coupled_decay(p, g, param_meta)
+        mom = state["moment"] + jnp.square(g)
+        return p - lr * g / (jnp.sqrt(mom) + self._epsilon), {"moment": mom}
+
+
+class Adadelta(Optimizer):
+    _slot_names = ("avg_sq_grad", "avg_sq_update")
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _update_rule(self, p, g, state, lr, param_meta=None):
+        g = self._coupled_decay(p, g, param_meta)
+        asg = self._rho * state["avg_sq_grad"] + (1 - self._rho) * jnp.square(g)
+        update = g * jnp.sqrt(state["avg_sq_update"] + self._epsilon) / \
+            jnp.sqrt(asg + self._epsilon)
+        asu = self._rho * state["avg_sq_update"] + (1 - self._rho) * jnp.square(update)
+        return p - lr * update, {"avg_sq_grad": asg, "avg_sq_update": asu}
+
+
+class RMSProp(Optimizer):
+    _slot_names = ("mean_square", "mean_grad", "momentum")
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _update_rule(self, p, g, state, lr, param_meta=None):
+        g = self._coupled_decay(p, g, param_meta)
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * jnp.square(g)
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._epsilon)
+        else:
+            mg = state["mean_grad"]
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * state["momentum"] + lr * g / denom
+        return p - mom, {"mean_square": ms, "mean_grad": mg, "momentum": mom}
+
+
+class Lamb(Optimizer):
+    _slot_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _update_rule(self, p, g, state, lr, param_meta=None):
+        t = state.get("@t", 0) + 1
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g)
+        mhat = m / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon)
+        decay = self._weight_decay
+        if self._exclude_fn is not None and param_meta is not None and \
+                self._exclude_fn(param_meta):
+            decay = 0.0
+        update = r + decay * p
+        w_norm = jnp.linalg.norm(p)
+        u_norm = jnp.linalg.norm(update)
+        trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        return p - lr * trust * update, {"moment1": m, "moment2": v, "@t": t}

@@ -292,6 +292,9 @@ def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
     """paddle.matmul parity (reference: legacy_ops.yaml:725). MXU-bound op —
     under jit this is a single dot_general XLA lowers onto the systolic array."""
     x, y = ensure_tensor(x), ensure_tensor(y)
+    from ..amp import maybe_autocast_tensors
+
+    x, y = maybe_autocast_tensors("matmul", x, y)
 
     def fn(a, b):
         if transpose_x:

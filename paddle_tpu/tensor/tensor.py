@@ -47,7 +47,8 @@ class Tensor:
     data tensors; Parameters flip it to False."""
 
     __slots__ = ("_value", "stop_gradient", "_grad", "_producer", "_hooks", "name",
-                 "persistable", "__weakref__")
+                 "persistable", "optimize_attr", "regularizer", "need_clip",
+                 "is_distributed", "split_axis", "__weakref__")
 
     def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None):
         if isinstance(value, Tensor):
@@ -61,6 +62,11 @@ class Tensor:
         self._hooks: list = []
         self.name = name
         self.persistable = False
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.is_distributed = False
+        self.split_axis = None
 
     # -- payload access ----------------------------------------------------
     @property
@@ -347,6 +353,14 @@ def apply_op(name: str, fn: Callable, tensor_inputs: Sequence[Tensor], multi_out
     params). This is the analogue of the generated ``<op>_ad_func`` wrappers
     (`eager_gen.py`): forward + conditional GradNode creation, in ~20 lines.
     """
+    from ..amp import amp_white_listed
+
+    wl_dtype = amp_white_listed(name)
+    if wl_dtype is not None:
+        tensor_inputs = [
+            t.astype(wl_dtype) if jnp.issubdtype(t._value.dtype, jnp.floating) and
+            t._value.dtype != wl_dtype else t
+            for t in tensor_inputs]
     vals = [t._value for t in tensor_inputs]
     record = _tape.is_grad_enabled() and any(not t.stop_gradient for t in tensor_inputs)
     if record:

@@ -1,0 +1,188 @@
+"""AMP, io (DataLoader/save-load), and jit (to_static/TrainStep) tests."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def t(a, sg=True):
+    x = paddle.to_tensor(np.asarray(a, "float32"))
+    x.stop_gradient = sg
+    return x
+
+
+class TestAmp:
+    def test_autocast_casts_matmul(self):
+        x = t(np.ones((2, 2)))
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            out = paddle.matmul(x, x)
+        assert out.numpy().dtype.name == "bfloat16"
+        out2 = paddle.matmul(x, x)
+        assert out2.dtype == np.float32
+
+    def test_autocast_black_list(self):
+        x = t(np.ones((2, 2)))
+        with paddle.amp.auto_cast(dtype="bfloat16", custom_black_list=["matmul"]):
+            out = paddle.matmul(x, x)
+        assert out.dtype == np.float32
+
+    def test_grad_scaler_skips_on_inf(self):
+        p = paddle.to_tensor(np.ones((2,), "float32"), stop_gradient=False)
+        opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=2.0)
+        p._grad = paddle.to_tensor(np.array([np.inf, 1.0], "float32"))
+        scaler.step(opt)
+        np.testing.assert_allclose(p.numpy(), 1.0)  # step skipped
+        assert scaler.get_loss_scaling() == 1.0  # halved then floored
+
+    def test_grad_scaler_scale_unscale(self):
+        p = paddle.to_tensor(np.ones((2,), "float32"), stop_gradient=False)
+        opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+        loss = (p * 2).sum()
+        scaler.scale(loss).backward()
+        np.testing.assert_allclose(p.grad.numpy(), 8.0)  # scaled grads
+        scaler.step(opt)  # unscale(2.0 each) then sgd
+        np.testing.assert_allclose(p.numpy(), -1.0)
+
+    def test_decorate_o2(self):
+        model = nn.Sequential(nn.Linear(2, 4), nn.LayerNorm(4))
+        opt = paddle.optimizer.AdamW(parameters=model.parameters())
+        model, opt = paddle.amp.decorate(model, opt, level="O2", dtype="bfloat16")
+        assert model[0].weight.numpy().dtype.name == "bfloat16"
+        assert model[1].weight.dtype == np.float32  # norms stay fp32
+        assert opt._multi_precision
+
+
+class TestSaveLoad:
+    def test_nested_state_roundtrip(self, tmp_path):
+        obj = {"model": {"w": t(np.arange(6).reshape(2, 3))},
+               "meta": {"epoch": 3, "name": "x"}, "lst": [t([1.0]), 2]}
+        path = str(tmp_path / "ckpt.pdparams")
+        paddle.save(obj, path)
+        loaded = paddle.load(path)
+        np.testing.assert_allclose(loaded["model"]["w"].numpy(), obj["model"]["w"].numpy())
+        assert loaded["meta"] == {"epoch": 3, "name": "x"}
+        assert loaded["lst"][1] == 2
+
+    def test_model_and_opt_checkpoint(self, tmp_path):
+        model = nn.Linear(3, 2)
+        opt = paddle.optimizer.Adam(parameters=model.parameters())
+        x = t(np.ones((4, 3)))
+        model(x).sum().backward()
+        opt.step(); opt.clear_grad()
+        paddle.save(model.state_dict(), str(tmp_path / "m.pdparams"))
+        paddle.save(opt.state_dict(), str(tmp_path / "o.pdopt"))
+        model2 = nn.Linear(3, 2)
+        model2.set_state_dict(paddle.load(str(tmp_path / "m.pdparams")))
+        np.testing.assert_allclose(model2.weight.numpy(), model.weight.numpy())
+        opt2 = paddle.optimizer.Adam(parameters=model2.parameters())
+        opt2.set_state_dict(paddle.load(str(tmp_path / "o.pdopt")))
+        assert opt2._step_count == 1
+
+
+class TestDataLoader:
+    def test_batching_and_order(self):
+        from paddle_tpu.io import DataLoader, TensorDataset
+
+        X = np.arange(20, dtype="float32").reshape(10, 2)
+        ds = TensorDataset([X])
+        dl = DataLoader(ds, batch_size=3)
+        batches = list(dl)
+        assert len(batches) == 4
+        np.testing.assert_allclose(batches[0][0].numpy(), X[:3])
+
+    def test_threaded_matches_sync(self):
+        from paddle_tpu.io import DataLoader, TensorDataset
+
+        X = np.arange(40, dtype="float32").reshape(20, 2)
+        ds = TensorDataset([X])
+        sync = [b[0].numpy() for b in DataLoader(ds, batch_size=4)]
+        thr = [b[0].numpy() for b in DataLoader(ds, batch_size=4, num_workers=3)]
+        for a, b in zip(sync, thr):
+            np.testing.assert_allclose(a, b)
+
+    def test_distributed_sampler_partition(self):
+        from paddle_tpu.io import DistributedBatchSampler, TensorDataset
+
+        ds = TensorDataset([np.arange(16, dtype="float32").reshape(16, 1)])
+        seen = []
+        for rank in range(4):
+            s = DistributedBatchSampler(ds, batch_size=2, num_replicas=4, rank=rank)
+            for batch in s:
+                seen.extend(batch)
+        assert sorted(seen) == list(range(16))
+
+    def test_iterable_dataset(self):
+        from paddle_tpu.io import DataLoader, IterableDataset
+
+        class Gen(IterableDataset):
+            def __iter__(self):
+                yield from (np.float32(i) for i in range(7))
+
+        dl = DataLoader(Gen(), batch_size=3)
+        batches = list(dl)
+        assert len(batches) == 3 and batches[-1].shape == [1]
+
+
+class TestJit:
+    def test_to_static_matches_eager(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+        x = t(np.random.default_rng(0).standard_normal((3, 4)))
+        eager = m(x).numpy()
+        st = paddle.jit.to_static(lambda v: m(v))
+        np.testing.assert_allclose(st(x).numpy(), eager, rtol=1e-5)
+
+    def test_to_static_backward(self):
+        lin = nn.Linear(3, 2)
+        st = paddle.jit.to_static(lin)
+        x = t(np.ones((4, 3)))
+        out = st(x)
+        out.sum().backward()
+        np.testing.assert_allclose(lin.weight.grad.numpy(), np.full((3, 2), 4.0), rtol=1e-6)
+
+    def test_to_static_buffer_update(self):
+        bn = nn.BatchNorm1D(2)
+        st = paddle.jit.to_static(lambda v: bn(v))
+        x = t(np.random.default_rng(0).standard_normal((8, 2)) + 5.0)
+        st(x)
+        assert bn._mean.numpy().mean() > 0.1  # running stats updated through jit
+
+    def test_train_step_matches_eager(self):
+        def build():
+            paddle.seed(3)
+            m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+            o = paddle.optimizer.AdamW(1e-2, parameters=m.parameters(),
+                                       grad_clip=nn.ClipGradByGlobalNorm(1.0))
+            return m, o
+
+        paddle.seed(0)
+        X = paddle.rand([16, 4]); Y = X.sum(axis=1, keepdim=True)
+        m1, o1 = build()
+        for _ in range(10):
+            loss = F.mse_loss(m1(X), Y)
+            loss.backward(); o1.step(); o1.clear_grad()
+        m2, o2 = build()
+        step = paddle.jit.TrainStep(m2, lambda m, x, y: F.mse_loss(m(x), y), o2)
+        for _ in range(10):
+            fused_loss = step(X, Y)
+        np.testing.assert_allclose(float(loss), float(fused_loss), rtol=1e-4)
+        np.testing.assert_allclose(m1[0].weight.numpy(), m2[0].weight.numpy(), rtol=1e-4,
+                                   atol=1e-6)
+
+    def test_train_step_lr_schedule(self):
+        m = nn.Linear(2, 1)
+        sched = paddle.optimizer.lr.StepDecay(0.1, step_size=1, gamma=0.5)
+        o = paddle.optimizer.SGD(learning_rate=sched, parameters=m.parameters())
+        step = paddle.jit.TrainStep(m, lambda mm, x: mm(x).sum(), o)
+        x = t(np.ones((2, 2)))
+        step(x)
+        sched.step()
+        step(x)  # different lr — same compiled fn (lr is a traced arg)
+        assert o._step_count == 2

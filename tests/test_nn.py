@@ -1,0 +1,219 @@
+"""nn.Layer system + functional + layers tests (vs numpy/torch-convention refs)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def t(a, sg=True):
+    x = paddle.to_tensor(np.asarray(a, dtype="float32"))
+    x.stop_gradient = sg
+    return x
+
+
+class TestLayerBase:
+    def test_parameter_registration(self):
+        l = nn.Linear(3, 4)
+        names = [n for n, _ in l.named_parameters()]
+        assert names == ["weight", "bias"]
+        assert l.weight.shape == [3, 4]
+        assert not l.weight.stop_gradient
+
+    def test_sublayer_traversal_and_state_dict(self):
+        m = nn.Sequential(nn.Linear(2, 3), nn.ReLU(), nn.Linear(3, 1))
+        assert len(m.sublayers()) == 3
+        sd = m.state_dict()
+        assert set(sd) == {"0.weight", "0.bias", "2.weight", "2.bias"}
+        missing, unexpected = m.set_state_dict({k: v.numpy() for k, v in sd.items()})
+        assert not missing and not unexpected
+
+    def test_state_dict_shape_mismatch_raises(self):
+        l = nn.Linear(2, 2)
+        with pytest.raises(ValueError):
+            l.set_state_dict({"weight": np.zeros((3, 3), "float32")})
+
+    def test_buffers(self):
+        bn = nn.BatchNorm1D(4)
+        sd = bn.state_dict()
+        assert "_mean" in sd and "_variance" in sd
+
+    def test_train_eval_mode(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        m.eval()
+        assert all(not l.training for l in m.sublayers(include_self=True))
+        x = t(np.ones((4, 2)))
+        np.testing.assert_allclose(m(x).numpy(), m(x).numpy())  # dropout off
+
+    def test_forward_hooks(self):
+        l = nn.Linear(2, 2)
+        calls = []
+        h1 = l.register_forward_pre_hook(lambda layer, inp: calls.append("pre"))
+        h2 = l.register_forward_post_hook(lambda layer, inp, out: calls.append("post"))
+        l(t(np.ones((1, 2))))
+        assert calls == ["pre", "post"]
+        h1.remove(); h2.remove()
+        calls.clear()
+        l(t(np.ones((1, 2))))
+        assert calls == []
+
+    def test_apply_and_to_dtype(self):
+        m = nn.Linear(2, 2)
+        m.to(dtype="bfloat16")
+        assert m.weight.numpy().dtype.name == "bfloat16"
+        m.float()
+        assert m.weight.dtype == np.float32
+
+    def test_layerlist_parameterlist(self):
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(ll) == 3 and len(ll.parameters()) == 6
+        pl = nn.ParameterList([paddle.Parameter(np.zeros((2,), "float32"))])
+        assert len(pl.parameters()) == 1
+
+
+class TestFunctional:
+    def test_activations_match_numpy(self):
+        a = np.linspace(-3, 3, 13).astype("float32")
+        x = t(a)
+        np.testing.assert_allclose(F.relu(x).numpy(), np.maximum(a, 0))
+        np.testing.assert_allclose(F.sigmoid(x).numpy(), 1 / (1 + np.exp(-a)), rtol=1e-6)
+        np.testing.assert_allclose(F.softmax(x).numpy(),
+                                   np.exp(a) / np.exp(a).sum(), rtol=1e-5)
+        import math
+
+        np.testing.assert_allclose(F.gelu(x).numpy(),
+                                   a * 0.5 * (1 + np.vectorize(math.erf)(a / np.sqrt(2))),
+                                   rtol=1e-4)
+
+    def test_linear(self):
+        x, w, b = np.ones((2, 3), "float32"), np.ones((3, 4), "float32"), np.ones(4, "float32")
+        out = F.linear(t(x), t(w), t(b))
+        np.testing.assert_allclose(out.numpy(), x @ w + b)
+
+    def test_conv2d_identity_kernel(self):
+        x = np.random.default_rng(0).standard_normal((1, 1, 5, 5)).astype("float32")
+        w = np.zeros((1, 1, 3, 3), "float32"); w[0, 0, 1, 1] = 1.0
+        out = F.conv2d(t(x), t(w), padding=1)
+        np.testing.assert_allclose(out.numpy(), x, rtol=1e-6)
+
+    def test_conv2d_vs_manual(self):
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((2, 3, 8, 8)).astype("float32")
+        w = rng.standard_normal((4, 3, 3, 3)).astype("float32")
+        out = F.conv2d(t(x), t(w), stride=2, padding=1)
+        assert out.shape == [2, 4, 4, 4]
+
+    def test_pooling(self):
+        x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+        mp = F.max_pool2d(t(x), 2)
+        np.testing.assert_allclose(mp.numpy().reshape(-1), [5, 7, 13, 15])
+        ap = F.avg_pool2d(t(x), 2)
+        np.testing.assert_allclose(ap.numpy().reshape(-1), [2.5, 4.5, 10.5, 12.5])
+        ad = F.adaptive_avg_pool2d(t(x), 1)
+        np.testing.assert_allclose(ad.numpy().reshape(-1), [7.5])
+
+    def test_layer_norm_and_rms_norm(self):
+        a = np.random.default_rng(0).standard_normal((4, 8)).astype("float32")
+        out = F.layer_norm(t(a), 8)
+        np.testing.assert_allclose(out.numpy().mean(-1), 0, atol=1e-5)
+        np.testing.assert_allclose(out.numpy().std(-1), 1, atol=1e-2)
+        rms = F.rms_norm(t(a), t(np.ones(8, "float32")))
+        manual = a / np.sqrt((a ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(rms.numpy(), manual, rtol=1e-5)
+
+    def test_batch_norm_train_updates_stats(self):
+        bn = nn.BatchNorm1D(3)
+        x = t(np.random.default_rng(0).standard_normal((16, 3)).astype("float32") * 2 + 1)
+        bn.train()
+        bn(x)
+        assert not np.allclose(bn._mean.numpy(), 0)
+        bn.eval()
+        y1 = bn(x).numpy()
+        y2 = bn(x).numpy()
+        np.testing.assert_allclose(y1, y2)
+
+    def test_dropout_train_vs_eval(self):
+        x = t(np.ones((1000,), "float32"))
+        paddle.seed(7)
+        out = F.dropout(x, 0.5, training=True)
+        kept = out.numpy() != 0
+        assert 0.35 < kept.mean() < 0.65
+        np.testing.assert_allclose(out.numpy()[kept], 2.0)  # upscale_in_train
+        np.testing.assert_allclose(F.dropout(x, 0.5, training=False).numpy(), 1.0)
+
+    def test_cross_entropy(self):
+        logits = np.array([[2.0, 1.0, 0.1], [0.5, 2.5, 0.3]], "float32")
+        labels = np.array([0, 1])
+        loss = F.cross_entropy(t(logits), paddle.to_tensor(labels))
+        lp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        expect = -(lp[0, 0] + lp[1, 1]) / 2
+        np.testing.assert_allclose(float(loss), expect, rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = np.random.default_rng(0).standard_normal((4, 5)).astype("float32")
+        labels = np.array([1, -100, 2, -100])
+        loss = F.cross_entropy(t(logits), paddle.to_tensor(labels), ignore_index=-100)
+        lp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        expect = -(lp[0, 1] + lp[2, 2]) / 2
+        np.testing.assert_allclose(float(loss), expect, rtol=1e-4)
+
+    def test_embedding_and_padding_idx(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        idx = paddle.to_tensor(np.array([[0, 1], [2, 0]]))
+        out = emb(idx)
+        assert out.shape == [2, 2, 4]
+        np.testing.assert_allclose(out.numpy()[0, 0], 0)
+
+    def test_sdpa_matches_reference(self):
+        rng = np.random.default_rng(0)
+        q = rng.standard_normal((2, 5, 4, 8)).astype("float32")
+        k = rng.standard_normal((2, 5, 4, 8)).astype("float32")
+        v = rng.standard_normal((2, 5, 4, 8)).astype("float32")
+        out = F.scaled_dot_product_attention(t(q), t(k), t(v))
+        # manual
+        logits = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(8)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        expect = np.einsum("bhqk,bkhd->bqhd", p, v)
+        np.testing.assert_allclose(out.numpy(), expect, rtol=1e-4, atol=1e-5)
+
+    def test_sdpa_causal(self):
+        rng = np.random.default_rng(1)
+        q = rng.standard_normal((1, 4, 2, 8)).astype("float32")
+        out = F.scaled_dot_product_attention(t(q), t(q), t(q), is_causal=True)
+        # first position attends only to itself → output == v[0]
+        np.testing.assert_allclose(out.numpy()[:, 0], q[:, 0], rtol=1e-5)
+
+    def test_sdpa_gqa(self):
+        rng = np.random.default_rng(2)
+        q = rng.standard_normal((1, 3, 8, 4)).astype("float32")
+        k = rng.standard_normal((1, 3, 2, 4)).astype("float32")
+        v = rng.standard_normal((1, 3, 2, 4)).astype("float32")
+        out = F.scaled_dot_product_attention(t(q), t(k), t(v))
+        assert out.shape == [1, 3, 8, 4]
+
+
+class TestGradThroughLayers:
+    def test_mlp_grads(self):
+        m = nn.Sequential(nn.Linear(3, 4), nn.Tanh(), nn.Linear(4, 1))
+        x = t(np.random.default_rng(0).standard_normal((8, 3)))
+        loss = m(x).sum()
+        loss.backward()
+        for p in m.parameters():
+            assert p.grad is not None
+            assert p.grad.shape == p.shape
+
+    def test_conv_bn_grads(self):
+        m = nn.Sequential(nn.Conv2D(1, 2, 3, padding=1), nn.BatchNorm2D(2), nn.ReLU())
+        x = t(np.random.default_rng(0).standard_normal((2, 1, 6, 6)))
+        m(x).sum().backward()
+        assert all(p.grad is not None for p in m.parameters())
+
+    def test_transformer_encoder_grads(self):
+        enc = nn.TransformerEncoder(nn.TransformerEncoderLayer(16, 2, 32, dropout=0.0), 2)
+        x = t(np.random.default_rng(0).standard_normal((2, 5, 16)))
+        enc(x).sum().backward()
+        grads = [p.grad for p in enc.parameters()]
+        assert all(g is not None for g in grads)

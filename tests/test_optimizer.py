@@ -1,0 +1,156 @@
+"""Optimizer + LR schedule + clip tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.optimizer import lr as lr_sched
+
+
+def make_problem(seed=0):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+    X = paddle.rand([32, 4])
+    Y = X.sum(axis=1, keepdim=True)
+    return net, X, Y
+
+
+def train(net, opt, X, Y, steps=60):
+    loss = None
+    for _ in range(steps):
+        loss = F.mse_loss(net(X), Y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return float(loss)
+
+
+class TestOptimizers:
+    @pytest.mark.parametrize("cls,kw", [
+        ("SGD", dict(learning_rate=0.1)),
+        ("Momentum", dict(learning_rate=0.05, momentum=0.9)),
+        ("Adam", dict(learning_rate=0.01)),
+        ("AdamW", dict(learning_rate=0.01, weight_decay=0.01)),
+        ("RMSProp", dict(learning_rate=0.005)),
+        ("Adagrad", dict(learning_rate=0.1)),
+        ("Adamax", dict(learning_rate=0.01)),
+        ("Adadelta", dict(learning_rate=1.0)),
+        ("Lamb", dict(learning_rate=0.01)),
+    ])
+    def test_convergence(self, cls, kw):
+        net, X, Y = make_problem()
+        initial = float(F.mse_loss(net(X), Y))
+        opt = getattr(paddle.optimizer, cls)(parameters=net.parameters(), **kw)
+        final = train(net, opt, X, Y)
+        assert final < initial * 0.5, f"{cls}: {initial} -> {final}"
+
+    def test_adamw_decoupled_decay_shrinks_weights(self):
+        p = paddle.to_tensor(np.ones((4,), "float32"), stop_gradient=False)
+        opt = paddle.optimizer.AdamW(learning_rate=0.1, weight_decay=0.5, parameters=[p])
+        p._grad = paddle.zeros([4])  # zero grad: only decay acts
+        opt.step()
+        assert p.numpy().max() < 1.0
+
+    def test_apply_decay_param_fun(self):
+        p1 = paddle.to_tensor(np.ones((2,), "float32"), stop_gradient=False)
+        p1.name = "w"
+        p2 = paddle.to_tensor(np.ones((2,), "float32"), stop_gradient=False)
+        p2.name = "b"
+        opt = paddle.optimizer.AdamW(
+            learning_rate=0.1, weight_decay=0.5, parameters=[p1, p2],
+            apply_decay_param_fun=lambda n: n == "w")
+        p1._grad = paddle.zeros([2]); p2._grad = paddle.zeros([2])
+        opt.step()
+        assert p1.numpy()[0] < 1.0 and p2.numpy()[0] == 1.0
+
+    def test_state_dict_roundtrip(self):
+        net, X, Y = make_problem()
+        opt = paddle.optimizer.Adam(parameters=net.parameters())
+        train(net, opt, X, Y, steps=3)
+        sd = opt.state_dict()
+        net2, _, _ = make_problem()
+        opt2 = paddle.optimizer.Adam(parameters=net2.parameters())
+        opt2.set_state_dict(sd)
+        p0 = net.parameters()[0]
+        np.testing.assert_allclose(
+            np.asarray(opt2._accumulators[id(net2.parameters()[0])]["moment1"]),
+            np.asarray(opt._accumulators[id(p0)]["moment1"]))
+
+    def test_grad_clip_global_norm(self):
+        p = paddle.to_tensor(np.zeros((4,), "float32"), stop_gradient=False)
+        opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p],
+                                   grad_clip=nn.ClipGradByGlobalNorm(1.0))
+        p._grad = paddle.to_tensor(np.full((4,), 10.0, "float32"))
+        opt.step()
+        np.testing.assert_allclose(np.linalg.norm(p.numpy()), 1.0, rtol=1e-5)
+
+    def test_lr_scheduler_integration(self):
+        net, X, Y = make_problem()
+        sched = lr_sched.StepDecay(learning_rate=0.1, step_size=2, gamma=0.1)
+        opt = paddle.optimizer.SGD(learning_rate=sched, parameters=net.parameters())
+        assert opt.get_lr() == pytest.approx(0.1)
+        sched.step(); sched.step()
+        assert opt.get_lr() == pytest.approx(0.01)
+
+    def test_multi_precision_master_weights(self):
+        p = paddle.to_tensor(np.ones((4,), "float32"), stop_gradient=False)
+        p._value = p._value.astype("bfloat16")
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=[p],
+                                     multi_precision=True)
+        for _ in range(5):
+            p._grad = paddle.to_tensor(np.full((4,), 0.1, "float32"))
+            opt.step()
+        # master accumulates small updates that bf16 alone would lose
+        assert id(p) in opt._master_weights
+
+
+class TestLRSchedules:
+    def test_warmup(self):
+        s = lr_sched.LinearWarmup(learning_rate=1.0, warmup_steps=10, start_lr=0.0, end_lr=1.0)
+        vals = []
+        for _ in range(12):
+            vals.append(s())
+            s.step()
+        assert vals[0] == pytest.approx(0.0)
+        assert vals[5] == pytest.approx(0.5)
+        assert vals[11] == pytest.approx(1.0)
+
+    def test_cosine(self):
+        s = lr_sched.CosineAnnealingDecay(learning_rate=1.0, T_max=10)
+        assert s() == pytest.approx(1.0)
+        for _ in range(10):
+            s.step()
+        assert s() == pytest.approx(0.0, abs=1e-6)
+
+    def test_piecewise(self):
+        s = lr_sched.PiecewiseDecay([3, 6], [0.1, 0.01, 0.001])
+        seen = []
+        for _ in range(8):
+            seen.append(s())
+            s.step()
+        assert seen[0] == 0.1 and seen[4] == 0.01 and seen[7] == 0.001
+
+    def test_noam(self):
+        s = lr_sched.NoamDecay(d_model=512, warmup_steps=4000, learning_rate=1.0)
+        s.step(4000)
+        peak = s()
+        s.step(8000)
+        assert s() < peak
+
+    def test_reduce_on_plateau(self):
+        s = lr_sched.ReduceOnPlateau(learning_rate=1.0, patience=1, factor=0.5)
+        for loss in [1.0, 1.0, 1.0, 1.0]:
+            s.step(loss)
+        assert s() < 1.0
+
+    def test_one_cycle(self):
+        s = lr_sched.OneCycleLR(max_learning_rate=1.0, total_steps=100)
+        first = s()
+        for _ in range(30):
+            s.step()
+        assert s() == pytest.approx(1.0, rel=1e-2)
+        for _ in range(70):
+            s.step()
+        assert s() < first
