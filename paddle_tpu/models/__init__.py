@@ -1,0 +1,8 @@
+"""Model families: Llama (flagship), GPT, ERNIE. Vision models live in
+paddle_tpu.vision.models."""
+
+from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel, llama2_7b, llama2_13b,  # noqa: F401
+                    llama2_70b, llama_tiny)
+from .gpt import GPTConfig, GPTForCausalLM, GPTModel, gpt2_small, gpt3_1p3b, gpt_tiny  # noqa: F401
+from .ernie import (ErnieConfig, ErnieForMaskedLM, ErnieForSequenceClassification,  # noqa: F401
+                    ErnieModel, ernie3_base, ernie_tiny)

@@ -1,0 +1,135 @@
+"""ERNIE-style bidirectional encoder (BASELINE.md config #2: ERNIE-3.0 base
+fine-tune under DP; reference capability: the ERNIE encoders served by
+paddle's transformer stack).
+
+BERT-family architecture: token+position+segment embeddings → post-norm
+transformer encoder → pooler; heads for sequence classification and masked
+LM."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import nn
+from ..nn import functional as F
+from ..tensor.manipulation import reshape
+from ..tensor.tensor import Tensor
+
+__all__ = ["ErnieConfig", "ErnieModel", "ErnieForSequenceClassification",
+           "ErnieForMaskedLM", "ernie_tiny", "ernie3_base"]
+
+
+@dataclass
+class ErnieConfig:
+    vocab_size: int = 40000
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 2048
+    type_vocab_size: int = 4
+    hidden_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+
+
+def ernie_tiny(**kw) -> ErnieConfig:
+    base = dict(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                num_attention_heads=4, intermediate_size=128,
+                max_position_embeddings=128, type_vocab_size=2)
+    base.update(kw)
+    return ErnieConfig(**base)
+
+
+def ernie3_base(**kw) -> ErnieConfig:
+    return ErnieConfig(**kw)
+
+
+class ErnieEmbeddings(nn.Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        init = nn.initializer.Normal(0.0, config.initializer_range)
+        self.word_embeddings = nn.Embedding(config.vocab_size, config.hidden_size,
+                                            weight_attr=init)
+        self.position_embeddings = nn.Embedding(config.max_position_embeddings,
+                                                config.hidden_size, weight_attr=init)
+        self.token_type_embeddings = nn.Embedding(config.type_vocab_size,
+                                                  config.hidden_size, weight_attr=init)
+        self.layer_norm = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        import jax.numpy as jnp
+
+        s = input_ids.shape[1]
+        if s > self.position_embeddings._num_embeddings:
+            raise ValueError(
+                f"sequence length {s} exceeds max_position_embeddings "
+                f"{self.position_embeddings._num_embeddings}")
+        pos = Tensor(jnp.arange(s))
+        emb = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            emb = emb + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(emb))
+
+
+class ErnieModel(nn.Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = ErnieEmbeddings(config)
+        enc_layer = nn.TransformerEncoderLayer(
+            config.hidden_size, config.num_attention_heads, config.intermediate_size,
+            dropout=config.hidden_dropout_prob, activation="gelu",
+            layer_norm_eps=config.layer_norm_eps)
+        self.encoder = nn.TransformerEncoder(enc_layer, config.num_hidden_layers)
+        self.pooler = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        if attention_mask is not None:
+            # [b, s] 1/0 mask -> additive [b, 1, 1, s]
+            import jax.numpy as jnp
+
+            m = attention_mask._value if isinstance(attention_mask, Tensor) else attention_mask
+            additive = (1.0 - m.astype(jnp.float32))[:, None, None, :] * jnp.finfo(
+                jnp.float32).min
+            attention_mask = Tensor(additive)
+        seq_out = self.encoder(x, attention_mask)
+        pooled = F.tanh(self.pooler(seq_out[:, 0]))
+        return seq_out, pooled
+
+
+class ErnieForSequenceClassification(nn.Layer):
+    def __init__(self, config: ErnieConfig, num_classes: int = 2):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+        self.num_classes = num_classes
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None, labels=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            return F.cross_entropy(logits, labels), logits
+        return logits
+
+
+class ErnieForMaskedLM(nn.Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.config = config
+        self.ernie = ErnieModel(config)
+        self.transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None, labels=None):
+        seq_out, _ = self.ernie(input_ids, token_type_ids, attention_mask)
+        h = self.layer_norm(F.gelu(self.transform(seq_out)))
+        logits = F.linear(h, self.ernie.embeddings.word_embeddings.weight.T)
+        if labels is not None:
+            loss = F.cross_entropy(reshape(logits, [-1, self.config.vocab_size]),
+                                   reshape(labels, [-1]), ignore_index=-100)
+            return loss, logits
+        return logits

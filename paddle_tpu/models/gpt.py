@@ -1,0 +1,130 @@
+"""GPT model family (BASELINE.md config #3: GPT-3 1.3B TP×PP; reference
+capability: the fleet GPT used across `test/auto_parallel/get_gpt_model.py`).
+
+Pre-norm GPT: learned positions, LayerNorm, GELU MLP, causal SDPA in flash
+layout."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+from ..tensor.manipulation import reshape
+from ..tensor.tensor import Tensor
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt_tiny", "gpt3_1p3b", "gpt2_small"]
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 2048
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    intermediate_size: int = 8192
+    max_position_embeddings: int = 2048
+    layer_norm_eps: float = 1e-5
+    dropout: float = 0.0
+    initializer_range: float = 0.02
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def gpt_tiny(**kw) -> GPTConfig:
+    base = dict(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                num_attention_heads=4, intermediate_size=128, max_position_embeddings=128)
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+def gpt2_small(**kw) -> GPTConfig:
+    base = dict(vocab_size=50304, hidden_size=768, num_hidden_layers=12,
+                num_attention_heads=12, intermediate_size=3072,
+                max_position_embeddings=1024)
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+def gpt3_1p3b(**kw) -> GPTConfig:
+    base = dict(vocab_size=50304, hidden_size=2048, num_hidden_layers=24,
+                num_attention_heads=16, intermediate_size=8192,
+                max_position_embeddings=2048)
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        init = nn.initializer.Normal(0.0, config.initializer_range)
+        h, d = config.num_attention_heads, config.head_dim
+        self.ln_1 = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
+        self.qkv_proj = nn.Linear(config.hidden_size, 3 * h * d, weight_attr=init)
+        self.out_proj = nn.Linear(h * d, config.hidden_size, weight_attr=init)
+        self.ln_2 = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
+        self.fc_in = nn.Linear(config.hidden_size, config.intermediate_size, weight_attr=init)
+        self.fc_out = nn.Linear(config.intermediate_size, config.hidden_size, weight_attr=init)
+        self.dropout = nn.Dropout(config.dropout)
+        self.config = config
+
+    def forward(self, x):
+        cfg = self.config
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv_proj(self.ln_1(x))
+        qkv = reshape(qkv, [b, s, 3, cfg.num_attention_heads, cfg.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                              dropout_p=cfg.dropout, training=self.training)
+        x = x + self.dropout(self.out_proj(reshape(attn, [b, s, cfg.hidden_size])))
+        x = x + self.dropout(self.fc_out(F.gelu(self.fc_in(self.ln_2(x)))))
+        return x
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        init = nn.initializer.Normal(0.0, config.initializer_range)
+        self.wte = nn.Embedding(config.vocab_size, config.hidden_size, weight_attr=init)
+        self.wpe = nn.Embedding(config.max_position_embeddings, config.hidden_size,
+                                weight_attr=init)
+        self.drop = nn.Dropout(config.dropout)
+        self.h = nn.LayerList([GPTBlock(config) for _ in range(config.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
+
+    def forward(self, input_ids):
+        import jax.numpy as jnp
+
+        s = input_ids.shape[1]
+        if s > self.config.max_position_embeddings:
+            raise ValueError(
+                f"sequence length {s} exceeds max_position_embeddings "
+                f"{self.config.max_position_embeddings}")
+        pos = Tensor(jnp.arange(s))
+        x = self.drop(self.wte(input_ids) + self.wpe(pos))
+        for block in self.h:
+            x = block(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    """Weight-tied LM head (GPT convention)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+
+    def forward(self, input_ids, labels=None):
+        hidden = self.gpt(input_ids)
+        logits = F.linear(hidden, self.gpt.wte.weight.T)
+        if labels is not None:
+            loss = F.cross_entropy(reshape(logits, [-1, self.config.vocab_size]),
+                                   reshape(labels, [-1]))
+            return loss, logits
+        return logits

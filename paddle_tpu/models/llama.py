@@ -1,0 +1,233 @@
+"""Llama model family — the flagship pretrain target (BASELINE.md configs
+#4/#5; reference capability: PaddleNLP llama on the reference's fused kernel
+set `incubate/nn/functional/fused_rms_norm.py`, `fused_rotary_position_embedding.py`,
+`nn/functional/flash_attention.py`).
+
+TPU-first choices:
+- weights created in bf16-friendly fp32 and castable via amp.decorate O2
+- attention in flash layout [batch, seq, heads, head_dim] through
+  F.scaled_dot_product_attention (Pallas flash kernel on TPU)
+- rotary embeddings precomputed once per max_seq and sliced (static shapes)
+- GQA: num_key_value_heads < num_attention_heads
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+from ..tensor.manipulation import reshape
+from ..tensor.tensor import Tensor, apply_op
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama_tiny", "llama2_7b",
+           "llama2_13b", "llama2_70b"]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    initializer_range: float = 0.02
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+def llama_tiny(**kw) -> LlamaConfig:
+    """Test-scale config (shapes stay MXU-aligned: multiples of 128 where it
+    matters is waived at this scale)."""
+    base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+                max_position_embeddings=128)
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+def llama2_7b(**kw) -> LlamaConfig:
+    base = dict(vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+                num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=32,
+                max_position_embeddings=4096)
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+def llama2_13b(**kw) -> LlamaConfig:
+    base = dict(hidden_size=5120, intermediate_size=13824, num_hidden_layers=40,
+                num_attention_heads=40, num_key_value_heads=40)
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+def llama2_70b(**kw) -> LlamaConfig:
+    base = dict(hidden_size=8192, intermediate_size=28672, num_hidden_layers=80,
+                num_attention_heads=64, num_key_value_heads=8)
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+def _normalize_mask(attn_mask):
+    """bool/int keep-mask ([b, s] or broadcastable) → additive float mask;
+    float masks pass through (assumed already additive)."""
+    if attn_mask is None:
+        return None
+    m = attn_mask._value if isinstance(attn_mask, Tensor) else jnp.asarray(attn_mask)
+    if jnp.issubdtype(m.dtype, jnp.bool_) or jnp.issubdtype(m.dtype, jnp.integer):
+        keep = m.astype(jnp.float32)
+        if keep.ndim == 2:  # [b, s] padding mask → [b, 1, 1, s]
+            keep = keep[:, None, None, :]
+        return Tensor((1.0 - keep) * jnp.finfo(jnp.float32).min)
+    return attn_mask if isinstance(attn_mask, Tensor) else Tensor(m)
+
+
+def _rope_tables(head_dim: int, max_pos: int, theta: float):
+    inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float32) / head_dim))
+    t = np.arange(max_pos, dtype=np.float32)
+    freqs = np.outer(t, inv_freq)                      # [max_pos, head_dim/2]
+    emb = np.concatenate([freqs, freqs], axis=-1)      # [max_pos, head_dim]
+    return jnp.asarray(np.cos(emb)), jnp.asarray(np.sin(emb))
+
+
+def apply_rotary_pos_emb(q: Tensor, k: Tensor, cos, sin, position_offset: int = 0):
+    """q/k: [b, s, h, d]; cos/sin: [max_pos, d] jax arrays (fused path:
+    ops/pallas; reference `fused_rotary_position_embedding.py`)."""
+    s = q.shape[1]
+    cos_s = cos[position_offset:position_offset + s][None, :, None, :]
+    sin_s = sin[position_offset:position_offset + s][None, :, None, :]
+
+    def rot(v):
+        half = v.shape[-1] // 2
+        return jnp.concatenate([-v[..., half:], v[..., :half]], axis=-1)
+
+    def fn(qv, kv):
+        c = cos_s.astype(jnp.float32)
+        si = sin_s.astype(jnp.float32)
+        qf, kf = qv.astype(jnp.float32), kv.astype(jnp.float32)
+        return ((qf * c + rot(qf) * si).astype(qv.dtype),
+                (kf * c + rot(kf) * si).astype(kv.dtype))
+
+    return apply_op("rope", fn, (q, k), multi_out=True)
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        h, kv, d = config.num_attention_heads, config.num_key_value_heads, config.head_dim
+        init = nn.initializer.Normal(0.0, config.initializer_range)
+        self.q_proj = nn.Linear(config.hidden_size, h * d, weight_attr=init, bias_attr=False)
+        self.k_proj = nn.Linear(config.hidden_size, kv * d, weight_attr=init, bias_attr=False)
+        self.v_proj = nn.Linear(config.hidden_size, kv * d, weight_attr=init, bias_attr=False)
+        self.o_proj = nn.Linear(h * d, config.hidden_size, weight_attr=init, bias_attr=False)
+
+    def forward(self, x, cos, sin, attn_mask=None, position_offset: int = 0):
+        b, s = x.shape[0], x.shape[1]
+        cfg = self.config
+        q = reshape(self.q_proj(x), [b, s, cfg.num_attention_heads, cfg.head_dim])
+        k = reshape(self.k_proj(x), [b, s, cfg.num_key_value_heads, cfg.head_dim])
+        v = reshape(self.v_proj(x), [b, s, cfg.num_key_value_heads, cfg.head_dim])
+        q, k = apply_rotary_pos_emb(q, k, cos, sin, position_offset)
+        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask, is_causal=True)
+        return self.o_proj(reshape(out, [b, s, cfg.num_attention_heads * cfg.head_dim]))
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        init = nn.initializer.Normal(0.0, config.initializer_range)
+        self.gate_proj = nn.Linear(config.hidden_size, config.intermediate_size,
+                                   weight_attr=init, bias_attr=False)
+        self.up_proj = nn.Linear(config.hidden_size, config.intermediate_size,
+                                 weight_attr=init, bias_attr=False)
+        self.down_proj = nn.Linear(config.intermediate_size, config.hidden_size,
+                                   weight_attr=init, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.input_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+        self.post_attention_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+
+    def forward(self, x, cos, sin, attn_mask=None, position_offset: int = 0):
+        x = x + self.self_attn(self.input_layernorm(x), cos, sin, attn_mask, position_offset)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = nn.Embedding(
+            config.vocab_size, config.hidden_size,
+            weight_attr=nn.initializer.Normal(0.0, config.initializer_range))
+        self.layers = nn.LayerList([LlamaDecoderLayer(config)
+                                    for _ in range(config.num_hidden_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+        cos, sin = _rope_tables(config.head_dim, config.max_position_embeddings,
+                                config.rope_theta)
+        self.register_buffer("rope_cos", Tensor(cos), persistable=False)
+        self.register_buffer("rope_sin", Tensor(sin), persistable=False)
+
+    def forward(self, input_ids, attn_mask=None, position_offset: int = 0):
+        """``attn_mask``: either an additive float mask (0 to keep, large
+        negative to drop) or a bool/int keep-mask (True/1 = attend), which is
+        converted to additive form; causal masking is always applied."""
+        if input_ids.shape[1] + position_offset > self.config.max_position_embeddings:
+            raise ValueError(
+                f"sequence length {input_ids.shape[1]} (+offset {position_offset}) exceeds "
+                f"max_position_embeddings {self.config.max_position_embeddings}")
+        attn_mask = _normalize_mask(attn_mask)
+        x = self.embed_tokens(input_ids)
+        cos, sin = self.rope_cos._value, self.rope_sin._value
+        for layer in self.layers:
+            x = layer(x, cos, sin, attn_mask, position_offset)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     weight_attr=nn.initializer.Normal(
+                                         0.0, config.initializer_range),
+                                     bias_attr=False)
+
+    def forward(self, input_ids, labels=None, attn_mask=None):
+        hidden = self.llama(input_ids, attn_mask)
+        if self.lm_head is not None:
+            logits = self.lm_head(hidden)
+        else:
+            logits = F.linear(hidden, self.llama.embed_tokens.weight.T)
+        if labels is not None:
+            loss = F.cross_entropy(
+                reshape(logits, [-1, self.config.vocab_size]),
+                reshape(labels, [-1]))
+            return loss, logits
+        return logits
+
+    def num_params(self) -> int:
+        return sum(p.size for p in self.parameters())

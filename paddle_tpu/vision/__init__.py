@@ -1,1 +1,3 @@
-"""Placeholder — populated in a later milestone of this round."""
+"""paddle_tpu.vision (reference: `python/paddle/vision`)."""
+
+from . import models  # noqa: F401

@@ -1,0 +1,4 @@
+"""Vision model zoo (reference: `python/paddle/vision/models`)."""
+
+from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,  # noqa: F401
+                     resnext50_32x4d, resnext101_64x4d, wide_resnet50_2, wide_resnet101_2)

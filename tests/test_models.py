@@ -1,0 +1,146 @@
+"""Model family tests: forward shapes, loss decrease under training, jit parity."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.models import (GPTForCausalLM, LlamaForCausalLM, ErnieForSequenceClassification,
+                               ernie_tiny, gpt_tiny, llama_tiny)
+
+
+def tokens(b, s, vocab, seed=0):
+    rng = np.random.default_rng(seed)
+    return paddle.to_tensor(rng.integers(0, vocab, (b, s)).astype("int32"))
+
+
+class TestLlama:
+    def test_forward_shapes(self):
+        cfg = llama_tiny()
+        m = LlamaForCausalLM(cfg)
+        ids = tokens(2, 16, cfg.vocab_size)
+        logits = m(ids)
+        assert logits.shape == [2, 16, cfg.vocab_size]
+
+    def test_gqa_heads(self):
+        cfg = llama_tiny(num_attention_heads=4, num_key_value_heads=2)
+        m = LlamaForCausalLM(cfg)
+        assert m(tokens(1, 8, cfg.vocab_size)).shape == [1, 8, cfg.vocab_size]
+
+    def test_causality(self):
+        """Changing a future token must not affect earlier logits."""
+        cfg = llama_tiny()
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        ids = tokens(1, 8, cfg.vocab_size).numpy()
+        base = m(paddle.to_tensor(ids)).numpy()
+        ids2 = ids.copy()
+        ids2[0, -1] = (ids2[0, -1] + 1) % cfg.vocab_size
+        pert = m(paddle.to_tensor(ids2)).numpy()
+        np.testing.assert_allclose(base[0, :-1], pert[0, :-1], atol=1e-5)
+        assert not np.allclose(base[0, -1], pert[0, -1])
+
+    def test_training_reduces_loss(self):
+        paddle.seed(0)
+        cfg = llama_tiny()
+        m = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+        step = paddle.jit.TrainStep(m, lambda mm, x, y: mm(x, labels=y)[0], opt)
+        ids = tokens(4, 16, cfg.vocab_size)
+        labels = tokens(4, 16, cfg.vocab_size, seed=1)
+        losses = [float(step(ids, labels)) for _ in range(30)]
+        assert losses[-1] < losses[0] * 0.9
+
+    def test_tied_embeddings(self):
+        cfg = llama_tiny(tie_word_embeddings=True)
+        m = LlamaForCausalLM(cfg)
+        assert m.lm_head is None
+        ids = tokens(1, 8, cfg.vocab_size)
+        loss, _ = m(ids, labels=ids)
+        loss.backward()
+        assert m.llama.embed_tokens.weight.grad is not None
+
+    def test_rope_rotation_position_dependence(self):
+        from paddle_tpu.models.llama import _rope_tables, apply_rotary_pos_emb
+
+        cos, sin = _rope_tables(8, 32, 10000.0)
+        q = paddle.ones([1, 4, 2, 8])
+        k = paddle.ones([1, 4, 2, 8])
+        q1, k1 = apply_rotary_pos_emb(q, k, cos, sin, 0)
+        q2, _ = apply_rotary_pos_emb(q, k, cos, sin, 4)
+        assert not np.allclose(q1.numpy(), q2.numpy())  # offset changes rotation
+        np.testing.assert_allclose(q1.numpy()[0, 0], q.numpy()[0, 0], atol=1e-6)  # pos0 = identity
+
+
+class TestGPT:
+    def test_forward_and_loss(self):
+        cfg = gpt_tiny()
+        m = GPTForCausalLM(cfg)
+        ids = tokens(2, 12, cfg.vocab_size)
+        loss, logits = m(ids, labels=ids)
+        assert logits.shape == [2, 12, cfg.vocab_size]
+        assert float(loss) > 0
+
+    def test_training_reduces_loss(self):
+        paddle.seed(0)
+        cfg = gpt_tiny()
+        m = GPTForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+        step = paddle.jit.TrainStep(m, lambda mm, x, y: mm(x, labels=y)[0], opt)
+        ids = tokens(4, 12, cfg.vocab_size)
+        losses = [float(step(ids, ids)) for _ in range(30)]
+        assert losses[-1] < losses[0] * 0.9
+
+
+class TestErnie:
+    def test_classification(self):
+        cfg = ernie_tiny()
+        m = ErnieForSequenceClassification(cfg, num_classes=3)
+        ids = tokens(2, 10, cfg.vocab_size)
+        mask = paddle.ones([2, 10])
+        logits = m(ids, attention_mask=mask)
+        assert logits.shape == [2, 3]
+
+    def test_finetune_step(self):
+        paddle.seed(0)
+        cfg = ernie_tiny()
+        m = ErnieForSequenceClassification(cfg, num_classes=2)
+        opt = paddle.optimizer.AdamW(1e-4, parameters=m.parameters())
+        ids = tokens(4, 10, cfg.vocab_size)
+        y = paddle.to_tensor(np.array([0, 1, 0, 1]))
+        loss, _ = m(ids, labels=y)
+        loss.backward()
+        opt.step(); opt.clear_grad()
+        assert all(p.grad is None for p in m.parameters())
+
+
+class TestResNet:
+    def test_resnet18_forward(self):
+        from paddle_tpu.vision.models import resnet18
+
+        m = resnet18(num_classes=10)
+        x = paddle.rand([2, 3, 32, 32])
+        out = m(x)
+        assert out.shape == [2, 10]
+
+    def test_resnet50_forward_and_grad(self):
+        from paddle_tpu.vision.models import resnet50
+
+        m = resnet50(num_classes=4)
+        x = paddle.rand([1, 3, 64, 64])
+        y = paddle.to_tensor(np.array([2]))
+        loss = F.cross_entropy(m(x), y)
+        loss.backward()
+        assert m.conv1.weight.grad is not None
+
+    def test_resnet_train_step(self):
+        paddle.seed(0)
+        from paddle_tpu.vision.models import resnet18
+
+        m = resnet18(num_classes=4)
+        opt = paddle.optimizer.Momentum(0.01, parameters=m.parameters())
+        step = paddle.jit.TrainStep(m, lambda mm, x, y: F.cross_entropy(mm(x), y), opt)
+        x = paddle.rand([4, 3, 32, 32])
+        y = paddle.to_tensor(np.array([0, 1, 2, 3]))
+        losses = [float(step(x, y)) for _ in range(10)]
+        assert losses[-1] < losses[0]
