@@ -1,0 +1,104 @@
+"""Benchmark entry (driver contract): ONE JSON line
+{"metric", "value", "unit", "vs_baseline"}.
+
+Measures fused-train-step throughput (tokens/sec/chip) for a ~350M-param
+Llama in bf16 (AMP O2, fp32 master weights, AdamW, global-norm clip) on the
+visible accelerator — the single-chip slice of BASELINE.md's Llama ladder.
+
+``vs_baseline``: BASELINE.md publishes no in-tree reference numbers (the
+reference repo has none); we normalize against the north-star target of 50%
+MFU on this chip (peak bf16 FLOPs read from the device kind), i.e.
+vs_baseline = achieved_MFU / 0.50. >1.0 beats the target.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+# chip kind → peak bf16 TFLOP/s (public specs)
+_PEAK_TFLOPS = {
+    "v5 lite": 197.0, "v5e": 197.0, "v5litepod": 197.0,
+    "v5p": 459.0, "v4": 275.0, "v6e": 918.0, "v6": 918.0,
+    "cpu": 0.5,  # nominal, so the script still reports on CPU
+}
+
+
+def _peak_tflops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for key, val in _PEAK_TFLOPS.items():
+        if key in kind:
+            return val
+    return _PEAK_TFLOPS["cpu"]
+
+
+def main() -> None:
+    import numpy as np
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    dev = jax.devices()[0]
+    on_accel = dev.platform != "cpu"
+
+    if on_accel:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=4096,
+                          num_hidden_layers=24, num_attention_heads=16,
+                          num_key_value_heads=16, max_position_embeddings=2048,
+                          recompute=True)
+        batch, seq, steps, warmup = 4, 2048, 10, 3
+    else:  # CPU smoke: tiny shapes, same code path
+        cfg = LlamaConfig(vocab_size=1024, hidden_size=128, intermediate_size=512,
+                          num_hidden_layers=4, num_attention_heads=8,
+                          num_key_value_heads=8, max_position_embeddings=512)
+        batch, seq, steps, warmup = 2, 256, 4, 1
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    n_params = model.num_params()
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters(),
+                                 grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    model, opt = paddle.amp.decorate(model, opt, level="O2", dtype="bfloat16")
+    step = paddle.jit.TrainStep(model, lambda m, x, y: m(x, labels=y)[0], opt)
+
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (batch, seq)).astype("int32"))
+    labels = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (batch, seq)).astype("int32"))
+
+    for _ in range(warmup):
+        loss = step(ids, labels)
+    float(loss)  # host read: the only reliable sync through the axon relay
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids, labels)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    flops_per_token = 6 * n_params
+    achieved_tflops = tokens_per_sec * flops_per_token / 1e12
+    peak = _peak_tflops(dev)
+    mfu = achieved_tflops / peak
+    vs_baseline = mfu / 0.50  # north-star: 50% MFU
+
+    print(json.dumps({
+        "metric": "llama_350m_train_tokens_per_sec_per_chip" if on_accel
+                  else "llama_tiny_cpu_smoke_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(vs_baseline, 4),
+        "detail": {
+            "params": n_params, "batch": batch, "seq": seq,
+            "final_loss": float(loss), "mfu": round(mfu, 4),
+            "achieved_tflops": round(achieved_tflops, 2),
+            "device": getattr(dev, "device_kind", str(dev)),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,1 +1,21 @@
-"""Placeholder — populated in a later milestone of this round."""
+"""paddle_tpu.distributed — Fleet-grade hybrid parallel, TPU-native.
+
+Reference surface: `python/paddle/distributed/`. Collectives ride XLA over
+ICI/DCN via mesh axes instead of NCCL process groups; the semi-auto API
+(auto_parallel) over NamedSharding is the recommended path."""
+
+from . import auto_parallel  # noqa: F401
+from . import fleet  # noqa: F401
+from . import meta_parallel  # noqa: F401
+from .auto_parallel import (Partial, Placement, ProcessMesh, Replicate, Shard,  # noqa: F401
+                            dtensor_from_fn, get_mesh, reshard, set_mesh, shard_layer,
+                            shard_optimizer, shard_tensor)
+from .communication import (ReduceOp, all_gather, all_reduce, all_to_all, barrier,  # noqa: F401
+                            broadcast, get_group, new_group, ppermute, reduce,
+                            reduce_scatter, scatter, scatter_stack, stream, wait)
+from .engine import DistributedTrainStep, ScannedLayers  # noqa: F401
+from .parallel import (DataParallel, ParallelEnv, get_rank, get_world_size,  # noqa: F401
+                       init_parallel_env, is_initialized)
+from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
+from .topology import (CommGroup, HybridCommunicateGroup, build_mesh,  # noqa: F401
+                       get_hybrid_communicate_group, set_hybrid_communicate_group)

@@ -1,0 +1,282 @@
+"""Eager collective API (reference: `python/paddle/distributed/communication/`
+per-primitive modules + `ProcessGroup` semantics `process_group.h:47`).
+
+TPU-native semantics — read this before using:
+
+The reference is multi-process SPMD: each rank holds a *local* tensor and
+calls the collective. On TPU under JAX, the same program sees *global*
+arrays laid out over a Mesh. This API keeps paddle's call shapes with the
+convention that a "per-rank local tensor" is a slice along the LEADING axis
+of a global array sharded over the group's mesh axes:
+
+    x = dist.scatter_stack(big, group)        # [g, ...] sharded on axis 0
+    dist.all_reduce(x)                        # every slice := sum of slices
+    ys = dist.all_gather(x, group)            # every slice sees the stack
+
+Each collective is one jitted ``shard_map`` program over the mesh — i.e. a
+single XLA collective over ICI, matching how the reference's NCCL calls map
+to hardware. The recommended high-level path (auto_parallel / pjit) rarely
+needs these; they exist for API parity, custom algorithms and tests."""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..tensor.tensor import Tensor, apply_op
+from .topology import CommGroup, build_mesh, get_hybrid_communicate_group
+
+__all__ = ["ReduceOp", "all_reduce", "all_gather", "reduce_scatter", "all_to_all",
+           "broadcast", "reduce", "scatter", "barrier", "new_group", "get_group",
+           "scatter_stack", "ppermute", "wait", "stream"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: jax.lax.psum,
+    ReduceOp.MAX: jax.lax.pmax,
+    ReduceOp.MIN: jax.lax.pmin,
+}
+
+_default_group: Optional[CommGroup] = None
+_groups: dict = {}
+_next_group_id = 1
+
+
+def _world_mesh() -> Mesh:
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None:
+        return hcg.mesh
+    global _default_group
+    if _default_group is None:
+        n = len(jax.devices())
+        mesh = build_mesh(dp=n)
+        _default_group = CommGroup(mesh, ("data",), group_id=0)
+    return _default_group.mesh
+
+
+def _resolve_group(group: Optional[CommGroup]) -> CommGroup:
+    if group is not None:
+        return group
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None:
+        return hcg.get_global_group()
+    _world_mesh()
+    return _default_group
+
+
+def new_group(ranks: Optional[List[int]] = None, backend: Optional[str] = None,
+              timeout=None, axes: Optional[Sequence[str]] = None) -> CommGroup:
+    """Create a logical group. TPU-native: a group is a set of MESH AXES
+    (``axes=...``). Arbitrary rank lists (reference `collective.py:180`) are
+    supported only when they correspond to a full axis of the current mesh."""
+    global _next_group_id
+    mesh = _world_mesh()
+    if axes is not None:
+        g = CommGroup(mesh, tuple(axes), _next_group_id)
+    elif ranks is None or len(ranks) == sum(mesh.shape.values()) - len(mesh.shape) + 1 \
+            or len(ranks) == mesh.size:
+        g = CommGroup(mesh, tuple(mesh.axis_names), _next_group_id)
+    else:
+        raise ValueError(
+            "arbitrary rank-list groups are not mesh-expressible; pass axes=('data',) "
+            "etc. to select mesh axes (TPU groups are mesh axes, see module docstring)")
+    _next_group_id += 1
+    _groups[g.id] = g
+    return g
+
+
+def get_group(gid: int) -> Optional[CommGroup]:
+    return _groups.get(gid)
+
+
+@functools.lru_cache(maxsize=None)
+def _collective_fn(kind: str, mesh: Mesh, axes, op: str, extra=None):
+    """Build + cache one jitted shard_map collective program."""
+    ax = axes if len(axes) > 1 else axes[0]
+    spec = P(axes)
+
+    if kind == "all_reduce":
+        def body(x):
+            red = _REDUCERS.get(op)
+            if red is not None:
+                return red(x, ax)
+            if op == ReduceOp.AVG:
+                size = int(np.prod([mesh.shape[a] for a in axes]))
+                return jax.lax.psum(x, ax) / size
+            if op == ReduceOp.PROD:
+                return jnp.exp(jax.lax.psum(jnp.log(x), ax))
+            raise ValueError(f"unsupported reduce op {op}")
+
+        out_spec = P(axes)
+    elif kind == "all_gather":
+        def body(x):
+            return jax.lax.all_gather(x, ax, axis=0, tiled=True)
+
+        out_spec = P(axes)
+    elif kind == "reduce_scatter":
+        def body(x):
+            return jax.lax.psum_scatter(x, ax, scatter_dimension=0, tiled=True)
+
+        out_spec = P(axes)
+    elif kind == "all_to_all":
+        # stacked convention: each member's local block [g, ...] holds one
+        # slice per destination; received slices concatenate back on dim 0
+        def body(x):
+            return jax.lax.all_to_all(x, ax, split_axis=0, concat_axis=0, tiled=True)
+
+        out_spec = P(axes)
+    elif kind == "broadcast":
+        src = extra
+
+        def body(x):
+            full = jax.lax.all_gather(x, ax, axis=0, tiled=True)
+            per = x.shape[0]
+            return jax.lax.dynamic_slice_in_dim(full, src * per, per, 0)
+
+        out_spec = P(axes)
+    elif kind == "ppermute":
+        perm = extra
+
+        def body(x):
+            return jax.lax.ppermute(x, ax, perm=list(perm))
+
+        out_spec = P(axes)
+    else:
+        raise ValueError(kind)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=spec, out_specs=out_spec,
+                       check_vma=False)
+    return jax.jit(fn)
+
+
+def _run(kind, tensor, group, op=ReduceOp.SUM, extra=None, differentiable=True):
+    g = _resolve_group(group)
+    fn = _collective_fn(kind, g.mesh, g.axes, op, extra)
+    return apply_op(kind, fn, (tensor,))
+
+
+def all_reduce(tensor: Tensor, op: str = ReduceOp.SUM, group: Optional[CommGroup] = None,
+               sync_op: bool = True) -> Tensor:
+    """Every group slice := reduction over slices. In-place on the Tensor
+    (paddle semantics) and also returned."""
+    out = _run("all_reduce", tensor, group, op)
+    return tensor._rebind(out)
+
+
+def all_gather(tensor_or_list, tensor: Optional[Tensor] = None,
+               group: Optional[CommGroup] = None, sync_op: bool = True):
+    """paddle signature: all_gather(out_list, x, group). Also callable
+    functionally: ``stacked = all_gather(x, group=g)``."""
+    if isinstance(tensor_or_list, list):
+        out_list, x = tensor_or_list, tensor
+    else:
+        out_list, x = None, tensor_or_list
+        if tensor is not None and group is None and isinstance(tensor, CommGroup):
+            group = tensor
+    g = _resolve_group(group)
+    gathered = _run("all_gather", x, group)
+    if out_list is not None:
+        n = g.nranks
+        per = gathered.shape[0] // n
+        # stacked view replicated to every slice; split back to a python list
+        from ..tensor.manipulation import split
+
+        parts = split(Tensor(gathered._value[:gathered.shape[0] // n * n]), n, axis=0)
+        out_list.clear()
+        out_list.extend(parts)
+        return out_list
+    return gathered
+
+
+def reduce_scatter(tensor: Tensor, tensor_list=None, op: str = ReduceOp.SUM,
+                   group: Optional[CommGroup] = None, sync_op: bool = True) -> Tensor:
+    return _run("reduce_scatter", tensor if tensor_list is None else tensor_list,
+                group, op)
+
+
+def all_to_all(out_tensor_list, in_tensor_list=None, group: Optional[CommGroup] = None,
+               sync_op: bool = True):
+    """Functional form: ``y = all_to_all(x, group=g)`` where x's leading axis
+    is the per-destination split."""
+    if isinstance(out_tensor_list, Tensor):
+        return _run("all_to_all", out_tensor_list, group)
+    from ..tensor.manipulation import concat, split
+
+    x = concat(in_tensor_list, axis=0)
+    y = _run("all_to_all", x, group)
+    parts = split(y, len(in_tensor_list), axis=0)
+    out_tensor_list.clear()
+    out_tensor_list.extend(parts)
+    return out_tensor_list
+
+
+def broadcast(tensor: Tensor, src: int = 0, group: Optional[CommGroup] = None,
+              sync_op: bool = True) -> Tensor:
+    out = _run("broadcast", tensor, group, extra=src)
+    return tensor._rebind(out)
+
+
+def reduce(tensor: Tensor, dst: int = 0, op: str = ReduceOp.SUM,
+           group: Optional[CommGroup] = None, sync_op: bool = True) -> Tensor:
+    # on TPU a reduce-to-root is an all_reduce (no cost advantage on ICI);
+    # non-root slices also receive the value.
+    return all_reduce(tensor, op, group)
+
+
+def scatter(tensor: Tensor, tensor_list=None, src: int = 0,
+            group: Optional[CommGroup] = None, sync_op: bool = True) -> Tensor:
+    from ..tensor.manipulation import concat
+
+    if tensor_list is not None:
+        stacked = concat(tensor_list, axis=0)
+    else:
+        stacked = tensor
+    return scatter_stack(stacked, group)
+
+
+def scatter_stack(x: Tensor, group: Optional[CommGroup] = None) -> Tensor:
+    """Shard x's leading axis over the group (host → per-rank slices)."""
+    g = _resolve_group(group)
+    sharding = NamedSharding(g.mesh, P(g.axes))
+    return Tensor(jax.device_put(x._value if isinstance(x, Tensor) else jnp.asarray(x),
+                                 sharding), stop_gradient=True)
+
+
+def ppermute(tensor: Tensor, perm, group: Optional[CommGroup] = None) -> Tensor:
+    """Collective permute (the p2p send/recv primitive on TPU: reference's
+    send/recv pairs map to ppermute rings over ICI)."""
+    return _run("ppermute", tensor, group, extra=tuple(map(tuple, perm)))
+
+
+def barrier(group: Optional[CommGroup] = None) -> None:
+    g = _resolve_group(group)
+    x = Tensor(jnp.zeros((g.nranks,), jnp.float32))
+    all_reduce(scatter_stack(x, g), group=g)._value.block_until_ready()
+
+
+def wait(tensor: Tensor, group=None, use_calc_stream: bool = True) -> None:
+    tensor._value.block_until_ready()
+
+
+class stream:
+    """Parity namespace for paddle.distributed.stream.* (async variants are
+    identical on TPU: XLA owns scheduling)."""
+
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    reduce_scatter = staticmethod(reduce_scatter)
+    all_to_all = staticmethod(all_to_all)
+    broadcast = staticmethod(broadcast)

@@ -1,0 +1,75 @@
+"""fleet.utils: recompute (reference: `python/paddle/distributed/fleet/utils/__init__.py`
+recompute → `recompute/recompute.py`; capability also used by
+`passes/auto_parallel_recompute.py`).
+
+TPU-native: ``jax.checkpoint`` — activations inside the wrapped region are
+rematerialized in the backward pass instead of saved (HBM for FLOPs; the
+standard trade on TPU where HBM, not compute, binds)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+
+from ..nn.layer.layers import Layer
+from ..tensor.tensor import Tensor, apply_op
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function: Callable, *args, use_reentrant: bool = True, **kwargs):
+    """Run ``function(*args)`` under rematerialization. ``function`` may be a
+    Layer (its parameters are differentiated through) or any callable over
+    Tensors; keyword args and non-Tensor positionals are captured statically."""
+    layer = function if isinstance(function, Layer) else getattr(function, "__self__", None)
+    params = [p for _, p in layer.named_parameters()] if isinstance(layer, Layer) else []
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    tensor_args = [args[i] for i in tensor_idx]
+
+    from ..jit import _StateSwap
+
+    n_params = len(params)
+
+    def pure(*vals):
+        pvals = vals[:n_params]
+        avals = vals[n_params:]
+        rebuilt = list(args)
+        for j, i in enumerate(tensor_idx):
+            rebuilt[i] = Tensor(avals[j])
+        with _StateSwap(params, list(pvals)):
+            out = function(*rebuilt, **kwargs)
+        if isinstance(out, tuple):
+            return tuple(o._value if isinstance(o, Tensor) else o for o in out)
+        return out._value if isinstance(out, Tensor) else out
+
+    ck = jax.checkpoint(pure)
+    return apply_op("recompute", ck, tuple(params + tensor_args))
+
+
+def recompute_sequential(ctx: dict, functions, *args, **kwargs):
+    """Segmented recompute over a Sequential (reference
+    `recompute/recompute_sequential.py`): splits into segments and wraps each."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions) if not isinstance(functions, Layer) else list(functions)
+    per = max(len(layers) // max(segments, 1), 1)
+    x = args[0] if len(args) == 1 else args
+
+    class _Seg(Layer):
+        def __init__(self, ls):
+            super().__init__()
+            from ..nn.layer.container import LayerList
+
+            self.ls = LayerList(ls)
+
+        def forward(self, v):
+            for l in self.ls:
+                v = l(v)
+            return v
+
+    i = 0
+    while i < len(layers):
+        seg = _Seg(layers[i:i + per])
+        x = recompute(seg, x, **kwargs)
+        i += per
+    return x

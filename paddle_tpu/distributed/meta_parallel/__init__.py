@@ -1,0 +1,10 @@
+"""Hybrid-parallel building blocks (reference: `fleet/meta_parallel/`)."""
+
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,  # noqa: F401
+                        RowParallelLinear, VocabParallelEmbedding)
+from .pipeline_parallel import PipelineParallel  # noqa: F401
+from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+from .sequence_parallel import (AllGatherOp, ColumnSequenceParallelLinear, GatherOp,  # noqa: F401
+                                ReduceScatterOp, RowSequenceParallelLinear, ScatterOp,
+                                mark_as_sequence_parallel_parameter,
+                                register_sequence_parallel_allreduce_hooks)

@@ -1,0 +1,177 @@
+"""Tensor-parallel layers (reference: `fleet/layers/mpu/mp_layers.py` —
+VocabParallelEmbedding:46, ColumnParallelLinear:335, RowParallelLinear:542,
+ParallelCrossEntropy:743).
+
+TPU-native: instead of explicit `_c_identity/_mp_allreduce` PyLayers
+(`mpu/mp_ops.py`), parameters carry a NamedSharding over the "model" mesh
+axis and forward outputs get sharding constraints — GSPMD inserts the
+identity/allreduce/allgather collectives the reference codes by hand, and
+fuses them with the matmuls. The layer API (gather_output,
+input_is_parallel, mp_group) is preserved so Megatron-style model code
+ports unchanged.
+
+Each parameter also records ``split_axis`` + ``is_distributed`` so the
+distributed engine and the hybrid grad-clip know which params are
+TP-sharded (reference marks the same via is_distributed)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ... import nn
+from ...framework.param_attr import ParamAttr
+from ...nn import functional as F
+from ...nn.layer.layers import Layer
+from ...tensor.tensor import Tensor, apply_op
+from ..topology import get_hybrid_communicate_group
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear", "RowParallelLinear",
+           "ParallelCrossEntropy"]
+
+
+def _mesh():
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        raise RuntimeError("call fleet.init / init_parallel_env (or set a "
+                           "HybridCommunicateGroup) before building parallel layers")
+    return hcg.mesh
+
+
+def _shard_param(p: Tensor, spec: P, mesh) -> Tensor:
+    p._value = jax.device_put(p._value, NamedSharding(mesh, spec))
+    p.is_distributed = True
+    return p
+
+
+_U = P.UNCONSTRAINED
+
+
+def _constrain(t: Tensor, spec: P, mesh) -> Tensor:
+    """Sharding constraint that leaves unmentioned dims UNCONSTRAINED so
+    batch/sequence shardings from the surrounding program survive."""
+
+    def fn(v):
+        full = list(spec) + [_U] * (v.ndim - len(spec))
+        try:
+            return jax.lax.with_sharding_constraint(v, NamedSharding(mesh, P(*full)))
+        except (ValueError, TypeError):
+            # eager path: UNCONSTRAINED not allowed in device_put → use None
+            concrete = [None if s is _U else s for s in full]
+            return jax.device_put(v, NamedSharding(mesh, P(*concrete)))
+
+    return apply_op("sharding_constraint", fn, (t,))
+
+
+def _last_dim_spec(ndim: int, axis_or_none) -> P:
+    """[U, U, ..., axis] — constrain only the feature dim."""
+    return P(*([_U] * (ndim - 1) + [axis_or_none]))
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over "model" (reference :46).
+    GSPMD turns the lookup into shard-local gathers + psum of the masked
+    partial results — the same masked-lookup+allreduce the reference codes
+    manually."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        mesh = _mesh()
+        ws = mesh.shape["model"]
+        if num_embeddings % ws != 0:
+            raise ValueError(f"vocab size {num_embeddings} not divisible by mp degree {ws}")
+        self._num_embeddings = num_embeddings
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal())
+        _shard_param(self.weight, P("model", None), mesh)
+        self.weight.split_axis = 0
+        self._mesh = mesh
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return _constrain(out, _last_dim_spec(out.ndim, None), self._mesh)
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with out-features sharded over "model" (reference :335)."""
+
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 has_bias: bool = True, gather_output: bool = True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        mesh = _mesh()
+        ws = mesh.shape["model"]
+        if out_features % ws != 0:
+            raise ValueError(f"out_features {out_features} not divisible by mp degree {ws}")
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal())
+        _shard_param(self.weight, P(None, "model"), mesh)
+        self.weight.split_axis = 1
+        if has_bias:
+            self.bias = self.create_parameter([out_features], attr=None, is_bias=True)
+            _shard_param(self.bias, P("model"), mesh)
+            self.bias.split_axis = 0
+        else:
+            self.bias = None
+        self._mesh = mesh
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            return _constrain(out, _last_dim_spec(out.ndim, None), self._mesh)
+        return _constrain(out, _last_dim_spec(out.ndim, "model"), self._mesh)
+
+
+class RowParallelLinear(Layer):
+    """Linear with in-features sharded over "model" (reference :542); output
+    is the psum of per-shard partial matmuls (GSPMD inserts it)."""
+
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 has_bias: bool = True, input_is_parallel: bool = False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        mesh = _mesh()
+        ws = mesh.shape["model"]
+        if in_features % ws != 0:
+            raise ValueError(f"in_features {in_features} not divisible by mp degree {ws}")
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal())
+        _shard_param(self.weight, P("model", None), mesh)
+        self.weight.split_axis = 0
+        if has_bias:
+            self.bias = self.create_parameter([out_features], attr=None, is_bias=True)
+            # bias is applied after the reduction → replicated (reference keeps
+            # it un-sharded on the rank-0 partial too)
+        else:
+            self.bias = None
+        self._mesh = mesh
+
+    def forward(self, x):
+        if not self.input_is_parallel:
+            x = _constrain(x, _last_dim_spec(x.ndim, "model"), self._mesh)
+        out = F.linear(x, self.weight, self.bias)
+        return _constrain(out, _last_dim_spec(out.ndim, None), self._mesh)
+
+
+class ParallelCrossEntropy(Layer):
+    """Softmax CE over class-dim-sharded logits (reference :743). The
+    log-softmax reduction over the sharded class dim becomes a psum."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index: int = -100):
+        super().__init__()
+        self._mesh = _mesh()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        loss = F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)
+        return loss

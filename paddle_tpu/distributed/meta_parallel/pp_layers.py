@@ -1,0 +1,157 @@
+"""Pipeline model segmentation (reference: `meta_parallel/parallel_layers/pp_layers.py`
+— PipelineLayer:237, LayerDesc:56, SharedLayerDesc:76).
+
+A PipelineLayer is built from a flat list of layer descriptors, segmented
+into ``num_stages`` contiguous stages. On TPU we keep ALL stages materialized
+in the single SPMD program (each stage's params are placed on its pipe-mesh
+slice by the distributed engine); ``get_stage_layers(i)`` exposes the slice
+for the host-side 1F1B runtime and for the shard_map GPipe engine."""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ...nn.layer.container import LayerList, Sequential
+from ...nn.layer.layers import Layer
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer"]
+
+
+class LayerDesc:
+    """Deferred layer construction (reference pp_layers.py:56)."""
+
+    def __init__(self, layer_func: Callable, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer) and not callable(layer_func):
+            raise TypeError("layer_func must be a Layer subclass or callable")
+
+    def build_layer(self) -> Layer:
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({getattr(self.layer_func, '__name__', self.layer_func)})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Weight-shared layer across stages (tied embeddings; reference :76).
+    All occurrences with the same ``key`` share ONE built layer — on TPU the
+    tied weight is simply the same (replicated or pipe-spanning) array, and
+    the cross-stage grad allreduce the reference does by hand
+    (`allreduce_shared_weight_gradients`) falls out of autodiff on the
+    shared parameter."""
+
+    def __init__(self, key: str, layer_func: Callable, forward_func: Optional[Callable] = None,
+                 shared_weight_attr: str = "weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class _SharedLayerProxy(Layer):
+    def __init__(self, inner: Layer, forward_func: Optional[Callable]):
+        super().__init__()
+        self.add_sublayer("shared", inner)
+        self._forward_func = forward_func
+
+    def forward(self, *args, **kwargs):
+        if self._forward_func is not None:
+            return self._forward_func(self._sub_layers["shared"], *args, **kwargs)
+        return self._sub_layers["shared"](*args, **kwargs)
+
+
+class PipelineLayer(Layer):
+    def __init__(self, layers: Sequence, num_stages: Optional[int] = None,
+                 topology=None, loss_fn: Optional[Callable] = None,
+                 seg_method: str = "uniform", recompute_interval: int = 0,
+                 num_virtual_pipeline_stages: Optional[int] = None, **kwargs):
+        super().__init__()
+        from ..topology import get_hybrid_communicate_group
+
+        if num_stages is None:
+            hcg = get_hybrid_communicate_group()
+            num_stages = hcg.get_pipe_parallel_world_size() if hcg else 1
+        self._num_stages = num_stages
+        self._loss_fn = loss_fn
+        self._seg_method = seg_method
+        self._shared: Dict[str, Layer] = {}
+
+        built: List[Layer] = []
+        self._desc_names: List[str] = []
+        for i, item in enumerate(layers):
+            if isinstance(item, SharedLayerDesc):
+                if item.layer_name not in self._shared:
+                    self._shared[item.layer_name] = item.build_layer()
+                built.append(_SharedLayerProxy(self._shared[item.layer_name],
+                                               item.forward_func))
+                self._desc_names.append(item.layer_name)
+            elif isinstance(item, LayerDesc):
+                built.append(item.build_layer())
+                self._desc_names.append(type(built[-1]).__name__)
+            elif isinstance(item, Layer):
+                built.append(item)
+                self._desc_names.append(type(item).__name__)
+            elif callable(item):
+                built.append(_FnLayer(item))
+                self._desc_names.append(getattr(item, "__name__", "fn"))
+            else:
+                raise TypeError(f"unsupported pipeline item: {item!r}")
+        self.run_function = LayerList(built)
+        self._segment()
+
+    def _segment(self) -> None:
+        n = len(self.run_function)
+        stages = self._num_stages
+        if self._seg_method.startswith("layer:"):
+            pattern = self._seg_method.split("layer:", 1)[1]
+            idxs = [i for i, name in enumerate(self._desc_names) if re.search(pattern, name)]
+            if len(idxs) < stages:
+                raise ValueError(f"seg_method {self._seg_method}: found {len(idxs)} cut "
+                                 f"layers for {stages} stages")
+            per = len(idxs) // stages
+            bounds = [0]
+            for s in range(1, stages):
+                bounds.append(idxs[s * per])
+            bounds.append(n)
+        else:  # uniform
+            per = n // stages
+            rem = n % stages
+            bounds = [0]
+            for s in range(stages):
+                bounds.append(bounds[-1] + per + (1 if s < rem else 0))
+        self.segment_parts = bounds
+
+    def get_stage_layers(self, stage_id: int) -> List[Layer]:
+        lo, hi = self.segment_parts[stage_id], self.segment_parts[stage_id + 1]
+        return list(self.run_function)[lo:hi]
+
+    def stage_forward(self, stage_id: int, x):
+        for layer in self.get_stage_layers(stage_id):
+            x = layer(x) if not isinstance(x, tuple) else layer(*x)
+        return x
+
+    def forward(self, x):
+        for layer in self.run_function:
+            x = layer(x) if not isinstance(x, tuple) else layer(*x)
+        return x
+
+    @property
+    def num_stages(self) -> int:
+        return self._num_stages
+
+    def shared_layers(self) -> Dict[str, Layer]:
+        return dict(self._shared)
+
+
+class _FnLayer(Layer):
+    def __init__(self, fn: Callable):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)

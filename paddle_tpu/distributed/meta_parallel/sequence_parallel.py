@@ -1,0 +1,150 @@
+"""Megatron-style sequence parallelism (reference:
+`fleet/utils/sequence_parallel_utils.py` — ScatterOp:84/GatherOp:96/
+AllGatherOp:110/ReduceScatterOp:126 PyLayers, ColumnSequenceParallelLinear:229,
+RowSequenceParallelLinear:339, mark_as_sequence_parallel_parameter:147).
+
+TPU-native: activations between TP regions carry a seq-dim sharding over the
+"model" axis (constraint), so XLA emits exactly the reference's
+allgather-before-column / reduce-scatter-after-row pattern fused into the
+matmuls. The op classes are kept as callable parity shims that apply/release
+the seq-dim constraint."""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ... import nn
+from ...nn import functional as F
+from ...nn.layer.layers import Layer
+from ...tensor.tensor import Tensor, apply_op
+from ..topology import get_hybrid_communicate_group
+from .mp_layers import _U, _constrain, _last_dim_spec, _mesh, _shard_param
+
+__all__ = ["ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "mark_as_sequence_parallel_parameter", "is_sequence_parallel_parameter",
+           "register_sequence_parallel_allreduce_hooks"]
+
+_SEQ_AXIS = 0  # paddle SP convention: [s, b, h] with seq leading; we accept [b, s, h]
+               # via seq_dim arg defaulting to 1 (batch-first framework layout)
+
+
+def _seq_spec(ndim: int, seq_dim: int) -> P:
+    spec = [_U] * ndim
+    spec[seq_dim] = "model"
+    return P(*spec)
+
+
+class ScatterOp:
+    """Split activations along seq dim over the mp group (reference :84)."""
+
+    @staticmethod
+    def apply(x: Tensor, seq_dim: int = 1) -> Tensor:
+        return _constrain(x, _seq_spec(x.ndim, seq_dim), _mesh())
+
+
+class GatherOp:
+    """Re-replicate seq-sharded activations (reference :96)."""
+
+    @staticmethod
+    def apply(x: Tensor, seq_dim: int = 1) -> Tensor:
+        spec = [_U] * x.ndim
+        spec[seq_dim] = None
+        return _constrain(x, P(*spec), _mesh())
+
+
+AllGatherOp = GatherOp
+
+
+class ReduceScatterOp:
+    """Sum partials and shard the seq dim (reference :126): on GSPMD, a
+    constraint to the seq-sharded layout after a Partial-producing op."""
+
+    @staticmethod
+    def apply(x: Tensor, seq_dim: int = 1) -> Tensor:
+        return _constrain(x, _seq_spec(x.ndim, seq_dim), _mesh())
+
+
+def mark_as_sequence_parallel_parameter(parameter: Tensor) -> None:
+    """Tag params living in the SP region (LayerNorm weights etc.): their
+    grads must be summed over the mp group (reference :147, hooks at :191).
+    Under GSPMD this happens automatically (grad of a replicated param used
+    by sharded activations is psummed); the tag is kept for the hybrid
+    optimizer's bookkeeping/tests."""
+    parameter.sequence_parallel = True  # type: ignore[attr-defined]
+
+
+def is_sequence_parallel_parameter(parameter: Tensor) -> bool:
+    return getattr(parameter, "sequence_parallel", False)
+
+
+def register_sequence_parallel_allreduce_hooks(model: Layer, accumulation_steps: int = 1,
+                                               fuse_sequence_parallel_allreduce: bool = False):
+    """Parity no-op on TPU: GSPMD already reduces SP-param grads over the
+    model axis (see mark_as_sequence_parallel_parameter)."""
+    return model
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """Column-parallel linear whose INPUT arrives seq-sharded; the seq
+    all-gather fuses into the matmul boundary (reference :229)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 gather_output=False, mp_group=None, name=None):
+        super().__init__()
+        mesh = _mesh()
+        ws = mesh.shape["model"]
+        if out_features % ws != 0:
+            raise ValueError(f"out_features {out_features} % mp degree {ws} != 0")
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal())
+        _shard_param(self.weight, P(None, "model"), mesh)
+        self.weight.split_axis = 1
+        self.bias = self.create_parameter([out_features], attr=None, is_bias=True) \
+            if has_bias else None
+        if self.bias is not None:
+            _shard_param(self.bias, P("model"), mesh)
+            self.bias.split_axis = 0
+        self._mesh = mesh
+
+    def forward(self, x):
+        # input is seq-sharded [b, s/mp, h]; gather seq, shard hidden out
+        spec = [_U] * x.ndim
+        spec[1] = None
+        x = _constrain(x, P(*spec), self._mesh)
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            return _constrain(out, _last_dim_spec(out.ndim, None), self._mesh)
+        return _constrain(out, _last_dim_spec(out.ndim, "model"), self._mesh)
+
+
+class RowSequenceParallelLinear(Layer):
+    """Row-parallel linear whose OUTPUT leaves seq-sharded via
+    reduce-scatter (reference :339)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=True, mp_group=None, name=None):
+        super().__init__()
+        mesh = _mesh()
+        ws = mesh.shape["model"]
+        if in_features % ws != 0:
+            raise ValueError(f"in_features {in_features} % mp degree {ws} != 0")
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal())
+        _shard_param(self.weight, P("model", None), mesh)
+        self.weight.split_axis = 0
+        self.bias = self.create_parameter([out_features], attr=None, is_bias=True) \
+            if has_bias else None
+        self._mesh = mesh
+        self.input_is_parallel = input_is_parallel
+
+    def forward(self, x, seq_dim: int = 1):
+        if not self.input_is_parallel:
+            x = _constrain(x, _last_dim_spec(x.ndim, "model"), self._mesh)
+        out = F.linear(x, self.weight, self.bias)
+        # reduce partials + shard seq dim in one constraint (reduce-scatter)
+        return _constrain(out, _seq_spec(out.ndim, seq_dim), self._mesh)

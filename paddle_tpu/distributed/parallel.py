@@ -1,0 +1,128 @@
+"""Process bootstrap + DataParallel (reference: `distributed/parallel.py` —
+init_parallel_env:943, env contract :687-710, DataParallel:202).
+
+Multi-host: ``init_parallel_env`` reads the reference's env contract
+(PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER) or JAX-native
+COORDINATOR_ADDRESS, calls ``jax.distributed.initialize`` (the TCPStore +
+comm-context bootstrap rolled into one), and builds the default mesh over
+all global devices."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from ..nn.layer.layers import Layer
+from .topology import HybridCommunicateGroup, set_hybrid_communicate_group, \
+    get_hybrid_communicate_group
+
+__all__ = ["init_parallel_env", "get_rank", "get_world_size", "ParallelEnv", "DataParallel",
+           "is_initialized"]
+
+_initialized = False
+
+
+def init_parallel_env(strategy=None) -> "ParallelEnv":
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("JAX_PROCESS_ID", "0")))
+    nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", os.environ.get("JAX_NUM_PROCESSES", "1")))
+    master = os.environ.get("PADDLE_MASTER", os.environ.get("COORDINATOR_ADDRESS"))
+    if nprocs > 1:
+        if master is None:
+            eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+            master = eps.split(",")[0] if eps else None
+        if master is None:
+            raise RuntimeError("multi-process init requires PADDLE_MASTER or "
+                               "COORDINATOR_ADDRESS")
+        jax.distributed.initialize(coordinator_address=master, num_processes=nprocs,
+                                   process_id=rank)
+    if get_hybrid_communicate_group() is None:
+        n = len(jax.devices())
+        set_hybrid_communicate_group(HybridCommunicateGroup(dp=n))
+    _initialized = True
+    return ParallelEnv()
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def get_rank(group=None) -> int:
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    return len(jax.devices())
+
+
+class ParallelEnv:
+    @property
+    def rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def world_size(self) -> int:
+        return len(jax.devices())
+
+    @property
+    def device_id(self) -> int:
+        return jax.devices()[0].id
+
+    @property
+    def nranks(self) -> int:
+        return self.world_size
+
+    @property
+    def local_rank(self) -> int:
+        return self.rank
+
+
+class DataParallel(Layer):
+    """paddle.DataParallel parity (reference parallel.py:202 → EagerReducer).
+
+    On TPU the gradient allreduce is not a layer concern: run the wrapped
+    model through ``DistributedTrainStep`` (or any pjit step) with the batch
+    sharded over "data" and XLA inserts the (bucketed, overlapped) psum the
+    reference's reducer implements by hand. This wrapper keeps the API and
+    marks parameters for DP so eager-mode grads can be synced explicitly via
+    ``apply_collective_grads``."""
+
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size: int = 25,
+                 last_comm_buffer_size: int = 1, find_unused_parameters: bool = False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self._group = group
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    @property
+    def parameters_(self):
+        return self._layers.parameters()
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def apply_collective_grads(self) -> None:
+        """Eager DP grad sync: psum each param grad over the data axis
+        (the reducer's fused-allreduce behavior, unfused)."""
+        from .communication import all_reduce, ReduceOp
+
+        hcg = get_hybrid_communicate_group()
+        group = hcg.get_data_parallel_group() if hcg else None
+        for p in self._layers.parameters():
+            if p._grad is not None:
+                all_reduce(p._grad, op=ReduceOp.AVG, group=group)

@@ -1,0 +1,43 @@
+"""group_sharded_parallel API (reference:
+`python/paddle/distributed/sharding/group_sharded.py:40` — the ZeRO entry).
+
+level: "os" (stage 1: optimizer states) | "os_g" (stage 2: +grads) |
+"p_g_os" (stage 3: +params). On TPU this sets the sharding stage consumed by
+``DistributedTrainStep``, which expresses the stages as mesh shardings (see
+engine.py docstring); there is no separate stage2/stage3 runtime class to
+keep in sync — XLA's partitioner IS the runtime."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ..nn.layer.layers import Layer
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model"]
+
+_LEVELS = {"os": 1, "os_g": 2, "p_g_os": 3}
+
+
+def group_sharded_parallel(model: Layer, optimizer, level: str, scaler=None,
+                           group=None, offload: bool = False, sync_buffers: bool = False,
+                           buffer_max_size: int = 2 ** 23, segment_size: int = 2 ** 20,
+                           sync_comm: bool = False, dp_group=None,
+                           exclude_layer=None) -> Tuple:
+    if level not in _LEVELS:
+        raise ValueError(f"level must be one of {sorted(_LEVELS)}, got {level!r}")
+    if offload:
+        raise NotImplementedError("offload=True: host-offloaded states planned; on TPU "
+                                  "prefer stage-3 sharding (HBM) first")
+    optimizer._sharding_stage = _LEVELS[level]
+    model._sharding_stage = _LEVELS[level]
+    if scaler is not None:
+        return model, optimizer, scaler
+    return model, optimizer, None
+
+
+def save_group_sharded_model(model: Layer, output: str, optimizer=None) -> None:
+    from ..framework.io import save
+
+    save(model.state_dict(), output + ".pdmodel")
+    if optimizer is not None:
+        save(optimizer.state_dict(), output + ".pdopt")

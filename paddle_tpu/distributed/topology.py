@@ -1,0 +1,189 @@
+"""Hybrid-parallel topology → jax.sharding.Mesh.
+
+Reference: ``HybridCommunicateGroup`` (`fleet/base/topology.py:174`) nests
+communication groups over axes ``["data", "pipe", "sharding", "sep", "model"]``
+(`topology.py:64`). TPU-native translation: the axes ARE mesh axis names on a
+`jax.sharding.Mesh`; a "communication group" is a subset of mesh axes, and
+collectives over a group lower to XLA collectives over those axes (ICI/DCN
+hierarchy handled by the compiler).
+
+Axis order matters for ICI locality: the innermost (fastest-varying) mesh
+axis maps to physically adjacent devices, so "model" (highest-bandwidth
+demand: TP allreduces every layer) is innermost, matching the reference's
+ordering rationale."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup", "get_hybrid_communicate_group",
+           "set_hybrid_communicate_group", "build_mesh"]
+
+_HYBRID_AXES = ("data", "pipe", "sharding", "sep", "model")
+
+
+def build_mesh(dp: int = 1, pp: int = 1, sharding: int = 1, sep: int = 1, mp: int = 1,
+               devices=None) -> Mesh:
+    """Create the hybrid mesh. Degrees must multiply to the device count
+    (a degree of -1 absorbs the remainder, like the reference's strategy)."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    degrees = {"data": dp, "pipe": pp, "sharding": sharding, "sep": sep, "model": mp}
+    unknown = [k for k, v in degrees.items() if v == -1]
+    known = int(np.prod([v for v in degrees.values() if v != -1]))
+    if unknown:
+        if len(unknown) > 1:
+            raise ValueError("at most one degree may be -1")
+        if n % known != 0:
+            raise ValueError(f"device count {n} not divisible by fixed degrees {known}")
+        degrees[unknown[0]] = n // known
+    total = int(np.prod(list(degrees.values())))
+    if total != n:
+        raise ValueError(
+            f"parallel degrees {degrees} multiply to {total}, but {n} device(s) visible")
+    shape = tuple(degrees[a] for a in _HYBRID_AXES)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, _HYBRID_AXES)
+
+
+class CommunicateTopology:
+    """Axis bookkeeping (reference `topology.py:24` CommunicateTopology)."""
+
+    def __init__(self, hybrid_group_names: Sequence[str] = _HYBRID_AXES,
+                 dims: Sequence[int] = (1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+
+    def get_hybrid_group_names(self) -> List[str]:
+        return list(self._parallel_names)
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    def world_size(self) -> int:
+        return int(np.prod(self._dims))
+
+    def get_dim_size(self, axis_name: str) -> int:
+        return self.get_dim(axis_name)
+
+
+class CommGroup:
+    """A logical communication group = a set of mesh axes (the TPU analogue
+    of a ProcessGroup; reference `process_group.h:47`)."""
+
+    def __init__(self, mesh: Mesh, axes: Tuple[str, ...], group_id: int = 0):
+        self.mesh = mesh
+        self.axes = tuple(axes)
+        self.id = group_id
+
+    @property
+    def nranks(self) -> int:
+        return int(np.prod([self.mesh.shape[a] for a in self.axes])) if self.axes else 1
+
+    @property
+    def world_size(self) -> int:
+        return self.nranks
+
+    @property
+    def rank(self) -> int:
+        # SPMD: inside shard_map, rank is axis_index; host-side we report 0
+        return 0
+
+    def __repr__(self):
+        return f"CommGroup(axes={self.axes}, nranks={self.nranks})"
+
+
+class HybridCommunicateGroup:
+    """reference `topology.py:174`: per-axis groups + fused groups + p2p
+    neighbors, rebuilt over a Mesh."""
+
+    def __init__(self, topology: Optional[CommunicateTopology] = None, *,
+                 mesh: Optional[Mesh] = None, dp: int = 1, pp: int = 1, sharding: int = 1,
+                 sep: int = 1, mp: int = 1):
+        if mesh is None:
+            if topology is not None:
+                dims = dict(zip(topology.get_hybrid_group_names(), topology._dims))
+                mesh = build_mesh(dims.get("data", 1), dims.get("pipe", 1),
+                                  dims.get("sharding", 1), dims.get("sep", 1),
+                                  dims.get("model", 1))
+            else:
+                mesh = build_mesh(dp, pp, sharding, sep, mp)
+        self.mesh = mesh
+        self._topo = CommunicateTopology(_HYBRID_AXES,
+                                         [mesh.shape[a] for a in _HYBRID_AXES])
+        self.nranks = int(np.prod([mesh.shape[a] for a in _HYBRID_AXES]))
+        self.global_rank = jax.process_index()
+
+    # degrees ----------------------------------------------------------
+    def get_data_parallel_world_size(self) -> int:
+        return self.mesh.shape["data"]
+
+    def get_model_parallel_world_size(self) -> int:
+        return self.mesh.shape["model"]
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self.mesh.shape["pipe"]
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self.mesh.shape["sharding"]
+
+    def get_sep_parallel_world_size(self) -> int:
+        return self.mesh.shape["sep"]
+
+    # groups -----------------------------------------------------------
+    def get_data_parallel_group(self) -> CommGroup:
+        return CommGroup(self.mesh, ("data",))
+
+    def get_model_parallel_group(self) -> CommGroup:
+        return CommGroup(self.mesh, ("model",))
+
+    def get_pipe_parallel_group(self) -> CommGroup:
+        return CommGroup(self.mesh, ("pipe",))
+
+    def get_sharding_parallel_group(self) -> CommGroup:
+        return CommGroup(self.mesh, ("sharding",))
+
+    def get_sep_parallel_group(self) -> CommGroup:
+        return CommGroup(self.mesh, ("sep",))
+
+    def get_dp_sep_parallel_group(self) -> CommGroup:
+        return CommGroup(self.mesh, ("data", "sep"))
+
+    def get_check_parallel_group(self, sharding: bool = False) -> CommGroup:
+        """Group spanning every non-data axis: used for inf/nan + global-norm
+        allreduce (reference topology.py:202-217 check groups)."""
+        axes = ("pipe", "sharding", "sep", "model") if not sharding else \
+            ("pipe", "sep", "model")
+        return CommGroup(self.mesh, axes)
+
+    def get_global_group(self) -> CommGroup:
+        return CommGroup(self.mesh, _HYBRID_AXES)
+
+    # rank queries (meaningful inside shard_map; host-side return 0) ----
+    def get_data_parallel_rank(self) -> int:
+        return 0
+
+    def get_model_parallel_rank(self) -> int:
+        return 0
+
+    def get_stage_id(self) -> int:
+        return 0
+
+    def topology(self) -> CommunicateTopology:
+        return self._topo
+
+
+_hcg: Optional[HybridCommunicateGroup] = None
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup) -> None:
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg

@@ -40,6 +40,7 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
     initializer_range: float = 0.02
+    recompute: bool = False  # rematerialize each decoder layer (jax.checkpoint)
 
     @property
     def head_dim(self) -> int:
@@ -198,8 +199,14 @@ class LlamaModel(nn.Layer):
         attn_mask = _normalize_mask(attn_mask)
         x = self.embed_tokens(input_ids)
         cos, sin = self.rope_cos._value, self.rope_sin._value
-        for layer in self.layers:
-            x = layer(x, cos, sin, attn_mask, position_offset)
+        if self.config.recompute:
+            from ..distributed.fleet_utils import recompute
+
+            for layer in self.layers:
+                x = recompute(layer, x, cos, sin, attn_mask, position_offset)
+        else:
+            for layer in self.layers:
+                x = layer(x, cos, sin, attn_mask, position_offset)
         return self.norm(x)
 
 

@@ -1,0 +1,134 @@
+"""Hybrid-parallel Llama: the flagship distributed configuration
+(BASELINE.md configs #4/#5 — Llama-2 7B/70B on dp × sharding × tp × pp × sp).
+
+Composition (each maps to a SURVEY §2.3 strategy):
+- VocabParallelEmbedding + Column/RowParallelLinear   → TP over "model"
+- ScannedLayers over the decoder stack                → PP over "pipe"
+- DistributedTrainStep(sharding_stage=...)            → DP + ZeRO over
+                                                        ("data","sharding")
+- batch seq-dim sharded over "sep"                    → SEP/context parallel
+- ParallelCrossEntropy on vocab-sharded logits        → TP loss
+
+All collectives are inserted by GSPMD from these shardings; the whole train
+step is ONE compiled XLA program."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..distributed.engine import ScannedLayers
+from ..distributed.meta_parallel.mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                                                   VocabParallelEmbedding, _constrain,
+                                                   _last_dim_spec)
+from ..distributed.topology import HybridCommunicateGroup
+from ..nn import functional as F
+from ..tensor.manipulation import reshape
+from ..tensor.tensor import Tensor
+from .llama import LlamaConfig, _normalize_mask, _rope_tables
+
+__all__ = ["LlamaForCausalLMHybrid"]
+
+
+class HybridLlamaAttention(nn.Layer):
+    """TP attention: heads sharded over "model" (q/k/v column-parallel,
+    output row-parallel)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        h, kv, d = config.num_attention_heads, config.num_key_value_heads, config.head_dim
+        init = nn.initializer.Normal(0.0, config.initializer_range)
+        self.q_proj = ColumnParallelLinear(config.hidden_size, h * d, weight_attr=init,
+                                           has_bias=False, gather_output=False)
+        self.k_proj = ColumnParallelLinear(config.hidden_size, kv * d, weight_attr=init,
+                                           has_bias=False, gather_output=False)
+        self.v_proj = ColumnParallelLinear(config.hidden_size, kv * d, weight_attr=init,
+                                           has_bias=False, gather_output=False)
+        self.o_proj = RowParallelLinear(h * d, config.hidden_size, weight_attr=init,
+                                        has_bias=False, input_is_parallel=True)
+
+    def forward(self, x, cos, sin, attn_mask=None):
+        from .llama import apply_rotary_pos_emb
+
+        b, s = x.shape[0], x.shape[1]
+        cfg = self.config
+        q = reshape(self.q_proj(x), [b, s, cfg.num_attention_heads, cfg.head_dim])
+        k = reshape(self.k_proj(x), [b, s, cfg.num_key_value_heads, cfg.head_dim])
+        v = reshape(self.v_proj(x), [b, s, cfg.num_key_value_heads, cfg.head_dim])
+        q, k = apply_rotary_pos_emb(q, k, cos, sin)
+        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask, is_causal=True)
+        return self.o_proj(reshape(out, [b, s, cfg.num_attention_heads * cfg.head_dim]))
+
+
+class HybridLlamaMLP(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        init = nn.initializer.Normal(0.0, config.initializer_range)
+        self.gate_proj = ColumnParallelLinear(config.hidden_size, config.intermediate_size,
+                                              weight_attr=init, has_bias=False,
+                                              gather_output=False)
+        self.up_proj = ColumnParallelLinear(config.hidden_size, config.intermediate_size,
+                                            weight_attr=init, has_bias=False,
+                                            gather_output=False)
+        self.down_proj = RowParallelLinear(config.intermediate_size, config.hidden_size,
+                                           weight_attr=init, has_bias=False,
+                                           input_is_parallel=True)
+
+    def forward(self, x):
+        return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class HybridLlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.self_attn = HybridLlamaAttention(config)
+        self.mlp = HybridLlamaMLP(config)
+        self.input_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+        self.post_attention_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+
+    def forward(self, x, cos, sin, attn_mask=None):
+        x = x + self.self_attn(self.input_layernorm(x), cos, sin, attn_mask)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaForCausalLMHybrid(nn.Layer):
+    def __init__(self, config: LlamaConfig, hcg: HybridCommunicateGroup):
+        super().__init__()
+        self.config = config
+        self.hcg = hcg
+        self.embed_tokens = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size,
+            weight_attr=nn.initializer.Normal(0.0, config.initializer_range))
+        pp = hcg.get_pipe_parallel_world_size()
+        if config.num_hidden_layers % pp != 0:
+            raise ValueError(f"num_hidden_layers {config.num_hidden_layers} % pp {pp} != 0")
+        blocks = [HybridLlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)]
+        self.decoder = ScannedLayers(blocks, mesh=hcg.mesh, pipe_axis="pipe")
+        self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+        self.lm_head = ColumnParallelLinear(
+            config.hidden_size, config.vocab_size,
+            weight_attr=nn.initializer.Normal(0.0, config.initializer_range),
+            has_bias=False, gather_output=False)
+        cos, sin = _rope_tables(config.head_dim, config.max_position_embeddings,
+                                config.rope_theta)
+        self.register_buffer("rope_cos", Tensor(cos), persistable=False)
+        self.register_buffer("rope_sin", Tensor(sin), persistable=False)
+
+    def forward(self, input_ids, labels=None, attn_mask=None):
+        if input_ids.shape[1] > self.config.max_position_embeddings:
+            raise ValueError("sequence too long")
+        attn_mask = _normalize_mask(attn_mask)
+        x = self.embed_tokens(input_ids)
+        x = self.decoder(x, self.rope_cos._value, self.rope_sin._value, attn_mask)
+        x = self.norm(x)
+        logits = self.lm_head(x)  # vocab-sharded over "model"
+        if labels is not None:
+            # CE over the vocab-sharded logits: the log-softmax reduction over
+            # the sharded class dim lowers to a psum (ParallelCrossEntropy)
+            loss = F.cross_entropy(reshape(logits, [-1, self.config.vocab_size]),
+                                   reshape(labels, [-1]))
+            return loss, logits
+        return logits

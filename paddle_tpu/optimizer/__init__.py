@@ -91,7 +91,10 @@ class Optimizer:
         return st
 
     def _init_state(self, p: Tensor) -> Dict[str, jax.Array]:
-        return {name: jnp.zeros_like(self._master(p)) for name in self._slot_names}
+        st: Dict[str, Any] = {name: jnp.zeros_like(self._master(p))
+                              for name in self._slot_names}
+        st["@t"] = 0  # step counter slot: stable pytree structure for jit paths
+        return st
 
     def _master(self, p: Tensor) -> jax.Array:
         """fp32 view of the parameter (master weight when multi_precision)."""
