@@ -1,9 +1,11 @@
 """Benchmark entry (driver contract): ONE JSON line
 {"metric", "value", "unit", "vs_baseline"}.
 
-Measures fused-train-step throughput (tokens/sec/chip) for a ~350M-param
+Measures fused-train-step throughput (tokens/sec/chip) for a ~670M-param
 Llama in bf16 (AMP O2, fp32 master weights, AdamW, global-norm clip) on the
 visible accelerator — the single-chip slice of BASELINE.md's Llama ladder.
+Attention runs through the Pallas flash kernel (ops/pallas/flash_attention),
+norm/rope through the fused Pallas kernels; head_dim=128 to fill the MXU.
 
 ``vs_baseline``: BASELINE.md publishes no in-tree reference numbers (the
 reference repo has none); we normalize against the north-star target of 50%
@@ -45,10 +47,10 @@ def main() -> None:
     on_accel = dev.platform != "cpu"
 
     if on_accel:
-        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=4096,
-                          num_hidden_layers=24, num_attention_heads=16,
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048, intermediate_size=8192,
+                          num_hidden_layers=8, num_attention_heads=16,
                           num_key_value_heads=16, max_position_embeddings=2048,
-                          recompute=True)
+                          recompute=False)
         batch, seq, steps, warmup = 4, 2048, 10, 3
     else:  # CPU smoke: tiny shapes, same code path
         cfg = LlamaConfig(vocab_size=1024, hidden_size=128, intermediate_size=512,
@@ -86,7 +88,7 @@ def main() -> None:
     vs_baseline = mfu / 0.50  # north-star: 50% MFU
 
     print(json.dumps({
-        "metric": "llama_350m_train_tokens_per_sec_per_chip" if on_accel
+        "metric": "llama_670m_train_tokens_per_sec_per_chip" if on_accel
                   else "llama_tiny_cpu_smoke_tokens_per_sec",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",

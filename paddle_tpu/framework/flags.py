@@ -167,5 +167,15 @@ define_flag("allocator_strategy", "auto_growth",
 define_flag("comm_timeout_seconds", 1800.0,
             "Collective watchdog timeout (reference: CommTaskManager).")
 define_flag("log_level", "INFO", "Framework log level.")
+define_flag("use_flash_attention", True,
+            "Dispatch F.scaled_dot_product_attention to the Pallas flash "
+            "kernel on TPU when shapes allow (reference: FLAGS controlling "
+            "flash_attn_kernel.cu selection).")
+define_flag("use_fused_rms_norm", True,
+            "Dispatch rms_norm to the fused Pallas kernel on TPU "
+            "(reference: fused_rms_norm.py surface).")
+define_flag("use_fused_rope", True,
+            "Dispatch rotary embedding to the fused Pallas kernel on TPU "
+            "(reference: fused_rotary_position_embedding.py surface).")
 define_flag("seed_offset_by_rank", True,
             "Offset the global seed by process rank for per-host RNG streams.")

@@ -103,8 +103,19 @@ def _rope_tables(head_dim: int, max_pos: int, theta: float):
 
 def apply_rotary_pos_emb(q: Tensor, k: Tensor, cos, sin, position_offset: int = 0):
     """q/k: [b, s, h, d]; cos/sin: [max_pos, d] jax arrays (fused path:
-    ops/pallas; reference `fused_rotary_position_embedding.py`)."""
+    ops/pallas/rope.py; reference `fused_rotary_position_embedding.py`)."""
+    from ..ops import pallas_eligible
+
     s = q.shape[1]
+    if pallas_eligible("use_fused_rope") and q.shape[-1] % 2 == 0 and s % 8 == 0:
+        from ..ops.pallas import fused_rope
+
+        table_c = cos[position_offset:position_offset + s]
+        table_s = sin[position_offset:position_offset + s]
+        return apply_op("fused_rope",
+                        lambda qv, kv: fused_rope(qv, kv, table_c, table_s),
+                        (q, k), multi_out=True)
+
     cos_s = cos[position_offset:position_offset + s][None, :, None, :]
     sin_s = sin[position_offset:position_offset + s][None, :, None, :]
 

@@ -505,9 +505,20 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon: float = 1e-
 
 
 def rms_norm(x, weight=None, epsilon: float = 1e-6, name=None) -> Tensor:
-    """RMSNorm (reference: `python/paddle/incubate/nn/functional/fused_rms_norm.py`)."""
+    """RMSNorm (reference: `python/paddle/incubate/nn/functional/fused_rms_norm.py`).
+    Dispatches to the fused Pallas kernel on TPU; XLA path elsewhere."""
+    from ...ops import pallas_eligible
+
     x = ensure_tensor(x)
     tensors = (x, ensure_tensor(weight)) if weight is not None else (x,)
+
+    if weight is not None and pallas_eligible("use_fused_rms_norm") and \
+            x.shape[-1] == weight.shape[-1] and x.ndim >= 2 and \
+            (x.size // x.shape[-1]) % 8 == 0 and x.shape[-1] % 128 == 0:
+        from ...ops.pallas import fused_rms_norm
+
+        return apply_op("fused_rms_norm",
+                        lambda v, w: fused_rms_norm(v, w, epsilon), tensors)
 
     def fn(v, *w):
         vf = v.astype(jnp.float32)
@@ -866,6 +877,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     """SDPA (reference: `nn/functional/flash_attention.py:442`). Inputs
     [batch, seq, heads, head_dim] (paddle flash-attn layout). Dispatches to
     the Pallas flash kernel on TPU when shapes allow, else the XLA path."""
+    from ...ops import pallas_eligible
     from ...ops.attention import sdpa_reference
 
     from ...amp import maybe_autocast_tensors
@@ -876,6 +888,17 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     tensors = (query, key, value)
     p = dropout_p if training else 0.0
     dkey = next_key() if p > 0.0 else None
+
+    if pallas_eligible("use_flash_attention"):
+        from ...ops.pallas import flash_attention, flash_attention_supported
+
+        if flash_attention_supported(query.shape, key.shape,
+                                     has_mask=mask_val is not None,
+                                     dropout_p=p, causal=is_causal):
+            def flash_fn(q, k, v):
+                return flash_attention(q, k, v, causal=is_causal)
+
+            return apply_op("flash_attn", flash_fn, tensors)
 
     def fn(q, k, v):
         return sdpa_reference(q, k, v, mask=mask_val, is_causal=is_causal,

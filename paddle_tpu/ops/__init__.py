@@ -1,1 +1,27 @@
 """Low-level op implementations: XLA reference paths + Pallas TPU kernels."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+@functools.lru_cache(maxsize=1)
+def _tpu_single_device() -> bool:
+    try:
+        devs = jax.devices()
+    except Exception:
+        return False
+    return devs[0].platform == "tpu" and len(devs) == 1
+
+
+def pallas_eligible(flag_name: str) -> bool:
+    """True when the Pallas path should be used: TPU backend, single-device
+    context (multi-chip goes through GSPMD where the sharded XLA path is
+    used until the kernels grow shard_map wrappers), and the flag is on."""
+    from ..framework.flags import get_flags
+
+    if not _tpu_single_device():
+        return False
+    return bool(get_flags(flag_name)[flag_name])

@@ -1,0 +1,14 @@
+"""Pallas TPU kernels — the hand-tiled hot set the reference ships as CUDA
+fusion kernels (`paddle/phi/kernels/fusion/gpu/`, `flash_attn_kernel.cu`).
+
+Each kernel is a `jax.custom_vjp` function over `pl.pallas_call`, so it works
+under the eager vjp tape (apply_op) and inside whole-step jit alike. On
+non-TPU backends the functional layer falls back to the XLA reference paths;
+tests exercise the kernels in interpreter mode."""
+
+from .flash_attention import flash_attention, flash_attention_supported
+from .fused_norm import fused_rms_norm
+from .rope import fused_rope
+
+__all__ = ["flash_attention", "flash_attention_supported", "fused_rms_norm",
+           "fused_rope"]

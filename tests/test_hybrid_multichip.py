@@ -23,7 +23,7 @@ def _make_hcg(**degrees):
     return dist.get_hybrid_communicate_group()
 
 
-def _train_two_steps(hcg, *, pp, mp, sep, sharding_stage=3, batch=4, seq=16):
+def _train_two_steps(hcg, *, pp, mp, sharding_stage=3, batch=4, seq=16):
     from paddle_tpu.models.llama import llama_tiny
     from paddle_tpu.models.llama_parallel import LlamaForCausalLMHybrid
 
@@ -47,7 +47,7 @@ def _train_two_steps(hcg, *, pp, mp, sep, sharding_stage=3, batch=4, seq=16):
 class TestPipelineDegree2:
     def test_pp2_mp2_dp2_train_step(self):
         hcg = _make_hcg(dp=2, mp=2, pp=2)
-        model, l1, l2 = _train_two_steps(hcg, pp=2, mp=2, sep=1)
+        model, l1, l2 = _train_two_steps(hcg, pp=2, mp=2)
         assert np.isfinite(l1) and np.isfinite(l2)
         assert l2 < l1, f"loss did not decrease: {l1} -> {l2}"
         specs = " ".join(str(p._value.sharding.spec) for p in model.parameters()
@@ -57,8 +57,7 @@ class TestPipelineDegree2:
 
     def test_pp2_sharding2_sep2_train_step(self):
         hcg = _make_hcg(pp=2, sharding=2, sep=2)
-        model, l1, l2 = _train_two_steps(hcg, pp=2, mp=1, sep=2,
-                                         batch=4, seq=32)
+        model, l1, l2 = _train_two_steps(hcg, pp=2, mp=1, batch=4, seq=32)
         assert np.isfinite(l1) and np.isfinite(l2)
         assert l2 < l1, f"loss did not decrease: {l1} -> {l2}"
         specs = " ".join(str(p._value.sharding.spec) for p in model.parameters()
@@ -71,7 +70,7 @@ class TestSepDegree:
     def test_sep2_activations_sharded(self):
         """sep>1: the sequence dim of activations is sharded over 'sep'."""
         hcg = _make_hcg(dp=4, sep=2)
-        _, l1, l2 = _train_two_steps(hcg, pp=1, mp=1, sep=2, batch=8, seq=32,
+        _, l1, l2 = _train_two_steps(hcg, pp=1, mp=1, batch=8, seq=32,
                                      sharding_stage=2)
         assert np.isfinite(l1) and np.isfinite(l2)
         assert l2 < l1
