@@ -138,9 +138,9 @@ class AmpScaler:
     semantics are kept for parity: scale losses, unscale grads before step,
     skip the step and shrink the scale when any grad has NaN/Inf."""
 
-    def __init__(self, enable: bool = True, init_loss_scaling: float = 2.0 ** 16,
+    def __init__(self, enable: bool = True, init_loss_scaling: float = 2.0 ** 15,
                  incr_ratio: float = 2.0, decr_ratio: float = 0.5,
-                 incr_every_n_steps: int = 2000, decr_every_n_nan_or_inf: int = 1,
+                 incr_every_n_steps: int = 1000, decr_every_n_nan_or_inf: int = 1,
                  use_dynamic_loss_scaling: bool = True):
         self._enable = enable
         self._scale = float(init_loss_scaling)
@@ -203,10 +203,19 @@ class AmpScaler:
         self._already_unscaled = False
         self.update()
 
-    def minimize(self, optimizer, scaled_loss, *args, **kwargs):
-        scaled_loss.backward()
-        self.step(optimizer)
-        optimizer.clear_grad()
+    def minimize(self, optimizer, *args, **kwargs):
+        """Unscale grads, skip the update on NaN/Inf, refresh the scale.
+
+        Reference contract (`grad_scaler.py:202`): the caller has already run
+        ``scaled.backward()``; minimize neither runs backward nor clears
+        grads (so gradient-accumulation idioms keep working)."""
+        if not self._enable:
+            return optimizer.minimize(*args, **kwargs)
+        self._unscale(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._already_unscaled = False
+        self.update()
 
     def update(self) -> None:
         if not (self._enable and self._dynamic):
@@ -240,7 +249,16 @@ class AmpScaler:
 
 
 class GradScaler(AmpScaler):
-    """paddle.amp.GradScaler parity (reference grad_scaler.py:573)."""
+    """paddle.amp.GradScaler parity (reference grad_scaler.py:573; its
+    defaults differ from the AmpScaler base: 2**16 / 2000 steps)."""
+
+    def __init__(self, enable: bool = True, init_loss_scaling: float = 2.0 ** 16,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 2000, decr_every_n_nan_or_inf: int = 1,
+                 use_dynamic_loss_scaling: bool = True):
+        super().__init__(enable, init_loss_scaling, incr_ratio, decr_ratio,
+                         incr_every_n_steps, decr_every_n_nan_or_inf,
+                         use_dynamic_loss_scaling)
 
     def unscale_(self, optimizer) -> None:
         self._unscale(optimizer)

@@ -362,14 +362,26 @@ def _pool(x, kernel, stride, padding, nd, reducer, init, data_format, ceil_mode=
     st = _tuple_n(stride if stride is not None else kernel, nd)
     pad = _conv_padding(padding, nd)
     channel_first = data_format.startswith("NC")
+    extras = (0,) * nd
+    if ceil_mode and not isinstance(pad, str):
+        # extend right-side padding so the output size uses ceil division;
+        # windows hanging past the input only see init values (paddle clips
+        # them, which is equivalent for max and for exclusive-count avg)
+        spatial = tuple(x.shape[2:2 + nd]) if channel_first else \
+            tuple(x.shape[1:1 + nd])
+        extras = tuple(_ceil_extra(n, k, s, lo, hi)
+                       for (lo, hi), n, k, s in zip(pad, spatial, ks, st))
+        pad = [(lo, hi + e) for (lo, hi), e in zip(pad, extras)]
     if channel_first:
+        lead = [(0, 0), (0, 0)]
         window = (1, 1) + ks
         strides = (1, 1) + st
-        pads = [(0, 0), (0, 0)] + (pad if not isinstance(pad, str) else pad)
+        pads = lead + (pad if not isinstance(pad, str) else pad)
     else:
+        lead = [(0, 0)]
         window = (1,) + ks + (1,)
         strides = (1,) + st + (1,)
-        pads = [(0, 0)] + (pad if not isinstance(pad, str) else pad) + [(0, 0)]
+        pads = lead + (pad if not isinstance(pad, str) else pad) + [(0, 0)]
     if isinstance(pad, str):
         pads = pad
 
@@ -377,55 +389,102 @@ def _pool(x, kernel, stride, padding, nd, reducer, init, data_format, ceil_mode=
         out = jax.lax.reduce_window(v, init(v.dtype), reducer, window, strides,
                                     pads if not isinstance(pads, str) else pads)
         if average:
-            if count_include_pad or (not isinstance(pads, str) and
-                                     all(p == (0, 0) for p in pads)):
+            no_pad = (not isinstance(pads, str) and
+                      all(p == (0, 0) for p in pads))
+            if count_include_pad and any(extras):
+                # real padding counts as elements, the ceil extension never
+                # does (paddle clips it): pad ones with 1 over the real pads,
+                # let reduce_window's init(0) cover the extension
+                real = [(lo, hi - e) for (lo, hi), e in
+                        zip(pads[len(lead):len(lead) + nd] if channel_first
+                            else pads[len(lead):len(lead) + nd], extras)]
+                full_real = (lead + real if channel_first
+                             else lead + real + [(0, 0)])
+                ext = [(0, e) for e in extras]
+                full_ext = (lead + ext if channel_first
+                            else lead + ext + [(0, 0)])
+                ones = jnp.pad(jnp.ones_like(v, jnp.float32),
+                               full_real, constant_values=1.0)
+                counts = jax.lax.reduce_window(
+                    ones, 0.0, jax.lax.add, window,
+                    strides, full_ext)
+                out = out / counts.astype(out.dtype)
+            elif count_include_pad or no_pad:
                 out = out / np.prod(ks)
             else:
                 ones = jnp.ones_like(v)
-                counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+                counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                               strides, pads)
                 out = out / counts
         return out
 
     return apply_op("pool", fn, (x,))
 
 
-def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False,
+def _ceil_extra(n: int, k: int, s: int, lo: int, hi: int) -> int:
+    """Extra right padding for ceil_mode output size. Mirrors paddle/torch's
+    rule that the last window must START inside the input or left padding —
+    a window living entirely in right padding is dropped."""
+    import math as _math
+
+    out_ceil = _math.ceil((n + lo + hi - k) / s) + 1
+    if (out_ceil - 1) * s >= n + lo:
+        out_ceil -= 1
+    needed = (out_ceil - 1) * s + k - (n + lo + hi)
+    return max(0, needed)
+
+
+def _max_init(dt):
+    return -jnp.inf if jnp.issubdtype(dt, jnp.floating) else jnp.iinfo(dt).min
+
+
+def _check_no_mask(return_mask):
+    if return_mask:
+        raise NotImplementedError(
+            "return_mask=True (argmax indices for max_unpool) is not "
+            "implemented on the TPU backend")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
                data_format="NCL", name=None):
-    return _pool(x, kernel_size, stride, padding, 1, jax.lax.max,
-                 lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating) else jnp.iinfo(dt).min,
-                 data_format)
+    _check_no_mask(return_mask)
+    return _pool(x, kernel_size, stride, padding, 1, jax.lax.max, _max_init,
+                 data_format, ceil_mode=ceil_mode)
 
 
-def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False,
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
                data_format="NCHW", name=None):
-    return _pool(x, kernel_size, stride, padding, 2, jax.lax.max,
-                 lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating) else jnp.iinfo(dt).min,
-                 data_format)
+    _check_no_mask(return_mask)
+    return _pool(x, kernel_size, stride, padding, 2, jax.lax.max, _max_init,
+                 data_format, ceil_mode=ceil_mode)
 
 
-def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False,
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
                data_format="NCDHW", name=None):
-    return _pool(x, kernel_size, stride, padding, 3, jax.lax.max,
-                 lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating) else jnp.iinfo(dt).min,
-                 data_format)
+    _check_no_mask(return_mask)
+    return _pool(x, kernel_size, stride, padding, 3, jax.lax.max, _max_init,
+                 data_format, ceil_mode=ceil_mode)
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False,
                data_format="NCL", name=None):
     return _pool(x, kernel_size, stride, padding, 1, jax.lax.add, lambda dt: 0.0,
-                 data_format, average=True, count_include_pad=not exclusive)
+                 data_format, ceil_mode=ceil_mode, average=True,
+                 count_include_pad=not exclusive)
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
                divisor_override=None, data_format="NCHW", name=None):
     return _pool(x, kernel_size, stride, padding, 2, jax.lax.add, lambda dt: 0.0,
-                 data_format, average=True, count_include_pad=not exclusive)
+                 data_format, ceil_mode=ceil_mode, average=True,
+                 count_include_pad=not exclusive)
 
 
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
                divisor_override=None, data_format="NCDHW", name=None):
     return _pool(x, kernel_size, stride, padding, 3, jax.lax.add, lambda dt: 0.0,
-                 data_format, average=True, count_include_pad=not exclusive)
+                 data_format, ceil_mode=ceil_mode, average=True,
+                 count_include_pad=not exclusive)
 
 
 def adaptive_avg_pool1d(x, output_size, name=None) -> Tensor:
@@ -541,18 +600,7 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training: b
     bshape[c_axis] = x.shape[c_axis]
 
     use_batch_stats = training and not use_global_stats
-    if use_batch_stats:
-        vf = x._value.astype(jnp.float32)
-        batch_mean = jnp.mean(vf, axis=reduce_axes)
-        batch_var = jnp.var(vf, axis=reduce_axes)
-        # update running stats in place (paddle: r = m*r + (1-m)*batch)
-        if running_mean is not None:
-            running_mean._value = (momentum * running_mean._value +
-                                   (1 - momentum) * batch_mean.astype(running_mean._value.dtype))
-            running_var._value = (momentum * running_var._value +
-                                  (1 - momentum) * batch_var.astype(running_var._value.dtype))
-        mean_c, var_c = batch_mean, batch_var
-    else:
+    if not use_batch_stats:
         mean_c = running_mean._value.astype(jnp.float32)
         var_c = running_var._value.astype(jnp.float32)
 
@@ -563,16 +611,45 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training: b
     if has_b:
         tensors.append(ensure_tensor(bias))
 
-    def fn(v, *wb):
-        vf = v.astype(jnp.float32)
-        out = (vf - mean_c.reshape(bshape)) * jax.lax.rsqrt(var_c.reshape(bshape) + epsilon)
+    def _affine(out, wb):
         i = 0
         if has_w:
             out = out * wb[i].astype(jnp.float32).reshape(bshape)
             i += 1
         if has_b:
             out = out + wb[i].astype(jnp.float32).reshape(bshape)
-        return out.astype(v.dtype)
+        return out
+
+    if use_batch_stats:
+        # stats come from the traced input so the vjp differentiates through
+        # them (the saved-mean/saved-variance grad terms); they are also
+        # returned so the running-stat update reuses them instead of
+        # re-reducing the input eagerly
+        def fn(v, *wb):
+            vf = v.astype(jnp.float32)
+            mean = jnp.mean(vf, axis=reduce_axes)
+            var = jnp.var(vf, axis=reduce_axes)
+            out = (vf - mean.reshape(bshape)) * \
+                jax.lax.rsqrt(var.reshape(bshape) + epsilon)
+            return _affine(out, wb).astype(v.dtype), mean, var
+
+        out, batch_mean, batch_var = apply_op("batch_norm", fn, tuple(tensors),
+                                              multi_out=True)
+        if running_mean is not None:
+            # paddle: r = m*r + (1-m)*batch (not differentiated)
+            running_mean._value = (
+                momentum * running_mean._value + (1 - momentum) *
+                batch_mean._value.astype(running_mean._value.dtype))
+            running_var._value = (
+                momentum * running_var._value + (1 - momentum) *
+                batch_var._value.astype(running_var._value.dtype))
+        return out
+
+    def fn(v, *wb):
+        vf = v.astype(jnp.float32)
+        out = (vf - mean_c.reshape(bshape)) * \
+            jax.lax.rsqrt(var_c.reshape(bshape) + epsilon)
+        return _affine(out, wb).astype(v.dtype)
 
     return apply_op("batch_norm", fn, tuple(tensors))
 
@@ -910,19 +987,89 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
 # ---------------------------------------------------------------------------
 # vision / misc
 # ---------------------------------------------------------------------------
+def _interp_coords(n_in, n_out, align_corners, align_mode):
+    """Source coordinates per output index (paddle's three conventions)."""
+    if align_corners:
+        if n_out == 1:
+            return np.zeros(1)
+        return np.linspace(0.0, n_in - 1.0, n_out)
+    ratio = n_in / n_out
+    if align_mode == 1:  # asymmetric (src = i * ratio)
+        return np.arange(n_out) * ratio
+    return (np.arange(n_out) + 0.5) * ratio - 0.5  # half-pixel
+
+
+def _interp_matrix(n_in, n_out, align_corners, align_mode):
+    """(n_out, n_in) linear-interp weight matrix for one spatial dim."""
+    coords = np.clip(_interp_coords(n_in, n_out, align_corners, align_mode),
+                     0.0, n_in - 1.0)
+    lo = np.floor(coords).astype(np.int64)
+    hi = np.minimum(lo + 1, n_in - 1)
+    w = coords - lo
+    mat = np.zeros((n_out, n_in), np.float32)
+    mat[np.arange(n_out), lo] += 1.0 - w
+    mat[np.arange(n_out), hi] += w
+    return jnp.asarray(mat)
+
+
 def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
                 align_mode=0, data_format="NCHW", name=None) -> Tensor:
+    """Reference: `python/paddle/nn/functional/common.py` interpolate. The
+    default half-pixel path uses jax.image.resize; align_corners=True and
+    align_mode=1 build explicit per-dim interpolation matrices (separable
+    linear resample as matmuls — MXU-friendly); mode='area' is true area
+    pooling."""
     x = ensure_tensor(x)
     channel_first = data_format.startswith("NC")
     spatial = tuple(x.shape[2:]) if channel_first else tuple(x.shape[1:-1])
+    nd = len(spatial)
     if size is None:
         if isinstance(scale_factor, (int, float)):
-            scale_factor = [scale_factor] * len(spatial)
+            scale_factor = [scale_factor] * nd
         size = tuple(int(s * f) for s, f in zip(spatial, scale_factor))
     else:
-        size = _tuple_n(size, len(spatial))
+        size = _tuple_n(size, nd)
+
+    if mode == "area":
+        # true area pooling (adaptive average); paddle reduces each output
+        # cell to the mean of its input region
+        return _adaptive_pool(x, size, nd, "avg", data_format)
+
+    linear_modes = ("linear", "bilinear", "trilinear")
+    if (align_corners or align_mode == 1) and mode in linear_modes:
+        mats = [_interp_matrix(s, o, align_corners, align_mode)
+                for s, o in zip(spatial, size)]
+
+        def fn_mat(v):
+            vf = v.astype(jnp.float32)
+            first_sp = 2 if channel_first else 1
+            for i, mat in enumerate(mats):
+                vf = jnp.moveaxis(vf, first_sp + i, -1)
+                vf = jnp.matmul(vf, mat.T)
+                vf = jnp.moveaxis(vf, -1, first_sp + i)
+            return vf.astype(v.dtype)
+
+        return apply_op("interpolate", fn_mat, (x,))
+    if align_corners and mode == "nearest":
+        # paddle rounds half up: static_cast<int>(coord + 0.5)
+        idxs = [jnp.asarray(np.floor(_interp_coords(s, o, True, 0) + 0.5)
+                            .astype(np.int64).clip(0, s - 1))
+                for s, o in zip(spatial, size)]
+
+        def fn_nearest(v):
+            first_sp = 2 if channel_first else 1
+            for i, idx in enumerate(idxs):
+                v = jnp.take(v, idx, axis=first_sp + i)
+            return v
+
+        return apply_op("interpolate", fn_nearest, (x,))
+    if align_corners:
+        raise NotImplementedError(
+            f"interpolate(mode={mode!r}, align_corners=True) is not supported "
+            "on the TPU backend")
+
     jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
-             "bicubic": "cubic", "trilinear": "linear", "area": "linear"}[mode]
+             "bicubic": "cubic", "trilinear": "linear"}[mode]
 
     def fn(v):
         if channel_first:

@@ -10,44 +10,64 @@ __all__ = ["MaxPool1D", "MaxPool2D", "MaxPool3D", "AvgPool1D", "AvgPool2D", "Avg
            "AdaptiveMaxPool1D", "AdaptiveMaxPool2D"]
 
 
-class _Pool(Layer):
-    def __init__(self, kernel_size, stride=None, padding=0, **kw):
+class _MaxPool(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, **kw):
         super().__init__()
         self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.return_mask, self.ceil_mode = return_mask, ceil_mode
         self.kw = kw
 
     def extra_repr(self):
         return f"kernel_size={self.kernel_size}, stride={self.stride}, padding={self.padding}"
 
 
-class MaxPool1D(_Pool):
+class _AvgPool(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, exclusive=True,
+                 ceil_mode=False, **kw):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.exclusive, self.ceil_mode = exclusive, ceil_mode
+        self.kw = kw
+
+    def extra_repr(self):
+        return f"kernel_size={self.kernel_size}, stride={self.stride}, padding={self.padding}"
+
+
+class MaxPool1D(_MaxPool):
     def forward(self, x):
-        return F.max_pool1d(x, self.kernel_size, self.stride, self.padding)
+        return F.max_pool1d(x, self.kernel_size, self.stride, self.padding,
+                            return_mask=self.return_mask, ceil_mode=self.ceil_mode)
 
 
-class MaxPool2D(_Pool):
+class MaxPool2D(_MaxPool):
     def forward(self, x):
-        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding)
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            return_mask=self.return_mask, ceil_mode=self.ceil_mode)
 
 
-class MaxPool3D(_Pool):
+class MaxPool3D(_MaxPool):
     def forward(self, x):
-        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding)
+        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            return_mask=self.return_mask, ceil_mode=self.ceil_mode)
 
 
-class AvgPool1D(_Pool):
+class AvgPool1D(_AvgPool):
     def forward(self, x):
-        return F.avg_pool1d(x, self.kernel_size, self.stride, self.padding)
+        return F.avg_pool1d(x, self.kernel_size, self.stride, self.padding,
+                            exclusive=self.exclusive, ceil_mode=self.ceil_mode)
 
 
-class AvgPool2D(_Pool):
+class AvgPool2D(_AvgPool):
     def forward(self, x):
-        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding)
+        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            exclusive=self.exclusive, ceil_mode=self.ceil_mode)
 
 
-class AvgPool3D(_Pool):
+class AvgPool3D(_AvgPool):
     def forward(self, x):
-        return F.avg_pool3d(x, self.kernel_size, self.stride, self.padding)
+        return F.avg_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            exclusive=self.exclusive, ceil_mode=self.ceil_mode)
 
 
 class _AdaptivePool(Layer):

@@ -135,10 +135,13 @@ class Optimizer:
             self._accumulators[id(p)] = new_state
         self._step_count += 1
 
-    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
-        loss.backward()
+    def minimize(self, loss=None, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        """Apply the update from already-computed grads. Reference dygraph
+        contract (`optimizer.py:1306` backward): grads are COLLECTED, not
+        produced — the caller runs ``loss.backward()`` first — and minimize
+        does not clear them."""
         self.step()
-        self.clear_grad()
         return None, None
 
     @no_grad()
@@ -286,6 +289,13 @@ class AdamW(Adam):
 
     def _decoupled(self):
         return True
+
+    def _update_rule(self, p, g, state, lr, param_meta=None):
+        # layer-wise lr scaling (reference adamw.py lr_ratio(param)); the
+        # ratio is a static per-param constant, folded into the traced lr
+        if self._lr_ratio is not None and param_meta is not None:
+            lr = lr * float(self._lr_ratio(param_meta))
+        return super()._update_rule(p, g, state, lr, param_meta)
 
     def _should_decay(self, param_meta):
         if not self._weight_decay:

@@ -1,0 +1,180 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md): batch_norm
+eager gradients, pool ceil_mode/return_mask, AmpScaler.minimize contract,
+interpolate align_corners, AdamW lr_ratio."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestBatchNormEagerGrad:
+    def test_eager_grad_differentiates_batch_stats(self):
+        """Training-mode BN grads must include the terms through batch
+        mean/var (advisor found them dropped: eager treated stats as
+        constants)."""
+        import jax
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(0)
+        xv = rng.standard_normal((8, 4, 5, 5)).astype("float32")
+
+        x = paddle.to_tensor(xv, stop_gradient=False)
+        rm = paddle.zeros([4])
+        rv = paddle.ones([4])
+        out = F.batch_norm(x, rm, rv, training=True)
+        (out * out).sum().backward()
+        got = x.grad.numpy()
+
+        def ref(v):
+            mean = jnp.mean(v, axis=(0, 2, 3), keepdims=True)
+            var = jnp.var(v, axis=(0, 2, 3), keepdims=True)
+            o = (v - mean) * jax.lax.rsqrt(var + 1e-5)
+            return jnp.sum(o * o)
+
+        want = np.asarray(jax.grad(ref)(jnp.asarray(xv)))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_running_stats_still_update(self):
+        x = paddle.to_tensor(np.random.default_rng(1)
+                             .standard_normal((16, 3)).astype("float32"))
+        rm = paddle.zeros([3])
+        rv = paddle.ones([3])
+        F.batch_norm(x, rm, rv, training=True, momentum=0.9)
+        assert not np.allclose(rm.numpy(), 0.0)
+
+
+class TestPoolModes:
+    def test_return_mask_raises(self):
+        x = paddle.rand([1, 2, 8, 8])
+        with pytest.raises(NotImplementedError):
+            F.max_pool2d(x, 2, return_mask=True)
+
+    def test_ceil_mode_shape_and_values(self):
+        import torch
+
+        xv = np.random.default_rng(2).standard_normal((1, 1, 8, 8)).astype("float32")
+        got = F.max_pool2d(paddle.to_tensor(xv), 3, stride=2, padding=0,
+                           ceil_mode=True).numpy()
+        want = torch.nn.functional.max_pool2d(
+            torch.from_numpy(xv), 3, stride=2, padding=0, ceil_mode=True).numpy()
+        assert got.shape == want.shape == (1, 1, 4, 4)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_ceil_mode_drops_window_entirely_in_padding(self):
+        """(out-1)*stride >= n + pad_lo must drop the last window (torch/
+        paddle rule); a naive ceil extension yields a -inf element."""
+        import torch
+
+        xv = np.array([[[1.0, 2.0, 3.0]]], dtype="float32")
+        got = F.max_pool1d(paddle.to_tensor(xv), 2, stride=2, padding=1,
+                           ceil_mode=True).numpy()
+        want = torch.nn.functional.max_pool1d(
+            torch.from_numpy(xv), 2, stride=2, padding=1, ceil_mode=True).numpy()
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want)
+
+    def test_avg_inclusive_count_ceil_mode(self):
+        """count_include_pad counts real padding but never the ceil
+        extension."""
+        import torch
+
+        xv = np.ones((1, 1, 5), dtype="float32")
+        got = F.avg_pool1d(paddle.to_tensor(xv), 2, stride=2, padding=0,
+                           exclusive=False, ceil_mode=True).numpy()
+        want = torch.nn.functional.avg_pool1d(
+            torch.from_numpy(xv), 2, stride=2, padding=0,
+            count_include_pad=True, ceil_mode=True).numpy()
+        np.testing.assert_allclose(got, want)
+
+    def test_layer_wrappers_forward_ceil_and_mask(self):
+        x = paddle.rand([1, 1, 8, 8])
+        out = nn.MaxPool2D(3, stride=2, ceil_mode=True)(x)
+        assert tuple(out.shape) == (1, 1, 4, 4)
+        with pytest.raises(NotImplementedError):
+            nn.MaxPool2D(2, return_mask=True)(x)
+
+    def test_avg_ceil_mode_matches_torch(self):
+        import torch
+
+        xv = np.random.default_rng(3).standard_normal((1, 2, 7, 7)).astype("float32")
+        got = F.avg_pool2d(paddle.to_tensor(xv), 2, stride=2,
+                           ceil_mode=True).numpy()
+        # paddle exclusive=True counts only real elements, = torch
+        # count_include_pad=False
+        want = torch.nn.functional.avg_pool2d(
+            torch.from_numpy(xv), 2, stride=2, ceil_mode=True,
+            count_include_pad=False).numpy()
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+class TestInterpolateAlignment:
+    def test_bilinear_align_corners_matches_torch(self):
+        import torch
+
+        xv = np.random.default_rng(4).standard_normal((2, 3, 5, 7)).astype("float32")
+        got = F.interpolate(paddle.to_tensor(xv), size=(10, 13), mode="bilinear",
+                            align_corners=True).numpy()
+        want = torch.nn.functional.interpolate(
+            torch.from_numpy(xv), size=(10, 13), mode="bilinear",
+            align_corners=True).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_area_mode_is_true_area_pool(self):
+        import torch
+
+        xv = np.random.default_rng(5).standard_normal((1, 2, 8, 8)).astype("float32")
+        got = F.interpolate(paddle.to_tensor(xv), size=(4, 4), mode="area").numpy()
+        want = torch.nn.functional.interpolate(
+            torch.from_numpy(xv), size=(4, 4), mode="area").numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_unsupported_align_corners_raises(self):
+        x = paddle.rand([1, 1, 4, 4])
+        with pytest.raises(NotImplementedError):
+            F.interpolate(x, size=(8, 8), mode="bicubic", align_corners=True)
+
+
+class TestAdamWLrRatio:
+    def test_lr_ratio_scales_updates(self):
+        paddle.seed(0)
+        m = nn.Linear(4, 4)
+        w0 = m.weight.numpy().copy()
+        b0 = m.bias.numpy().copy()
+        # ratio 0 for the 2-D weight, 1 for bias → weight must not move
+        opt = paddle.optimizer.AdamW(
+            0.1, parameters=m.parameters(), weight_decay=0.0,
+            lr_ratio=lambda p: 0.0 if p.ndim == 2 else 1.0)
+        loss = (m(paddle.rand([2, 4])) ** 2).sum()
+        loss.backward()
+        opt.step()
+        np.testing.assert_allclose(m.weight.numpy(), w0)
+        assert not np.allclose(m.bias.numpy(), b0)
+
+
+class TestAmpScalerContract:
+    def test_minimize_does_not_clear_grads_or_backward(self):
+        paddle.seed(0)
+        m = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(0.1, parameters=m.parameters())
+        scaler = paddle.amp.AmpScaler(init_loss_scaling=8.0)
+        loss = (m(paddle.rand([2, 4])) ** 2).sum()
+        scaled = scaler.scale(loss)
+        scaled.backward()  # caller's responsibility (reference contract)
+        g_before = m.weight.grad.numpy().copy()
+        scaler.minimize(opt)
+        # grads unscaled in place but NOT cleared
+        assert m.weight.grad is not None
+        np.testing.assert_allclose(m.weight.grad.numpy(), g_before / 8.0,
+                                   rtol=1e-6)
+
+    def test_scaler_defaults_match_reference(self):
+        s = paddle.amp.AmpScaler()
+        assert s.get_loss_scaling() == 2.0 ** 15
+        assert s._incr_every_n_steps == 1000
+        g = paddle.amp.GradScaler()
+        assert g.get_loss_scaling() == 2.0 ** 16
+        assert g._incr_every_n_steps == 2000
