@@ -5,6 +5,7 @@ ICI/DCN via mesh axes instead of NCCL process groups; the semi-auto API
 (auto_parallel) over NamedSharding is the recommended path."""
 
 from . import auto_parallel  # noqa: F401
+from . import checkpoint  # noqa: F401
 from . import fleet  # noqa: F401
 from . import meta_parallel  # noqa: F401
 from .auto_parallel import (Partial, Placement, ProcessMesh, Replicate, Shard,  # noqa: F401

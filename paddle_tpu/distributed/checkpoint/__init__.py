@@ -1,0 +1,14 @@
+"""Distributed (sharded) checkpoint with reshard-on-load.
+
+API parity with `python/paddle/distributed/checkpoint/`:
+``save_state_dict`` / ``load_state_dict``. Format is mesh-independent
+(global offsets + shapes), so parallelism configs can change between save
+and load — the hard requirement for elastic resume and the 7B→70B config
+ladder (SURVEY §5.4)."""
+
+from .load_state_dict import load_state_dict
+from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
+from .save_state_dict import save_state_dict
+
+__all__ = ["save_state_dict", "load_state_dict", "Metadata",
+           "LocalTensorMetadata", "LocalTensorIndex"]

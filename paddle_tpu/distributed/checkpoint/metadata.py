@@ -1,0 +1,45 @@
+"""Sharded-checkpoint metadata schema.
+
+Mirrors the reference's mesh-independent format
+(`python/paddle/distributed/checkpoint/metadata.py:20-40`):
+
+- ``state_dict_metadata``: flat key → list of :class:`LocalTensorMetadata`
+  (one per saved shard: global_offset, local_shape, dtype)
+- ``storage_metadata``: :class:`LocalTensorIndex` (key, global_offset) →
+  shard file name
+- ``flat_mapping``: flat key → original nested key path
+
+Because the schema speaks only in global offsets/shapes, a checkpoint saved
+under one mesh/parallelism config can be loaded under any other — the loader
+intersects saved slices with wanted slices (reshard-on-load)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+__all__ = ["LocalTensorMetadata", "LocalTensorIndex", "Metadata"]
+
+
+@dataclass(frozen=True)
+class LocalTensorMetadata:
+    """One saved shard of a tensor, in global coordinates."""
+
+    global_offset: Tuple[int, ...]
+    local_shape: Tuple[int, ...]
+    dtype: str
+
+
+@dataclass(frozen=True)
+class LocalTensorIndex:
+    """Identity of a saved shard: (flat key, global offset)."""
+
+    tensor_key: str
+    global_offset: Tuple[int, ...]
+
+
+@dataclass
+class Metadata:
+    state_dict_metadata: Dict[str, List[LocalTensorMetadata]] = field(default_factory=dict)
+    storage_metadata: Dict[LocalTensorIndex, str] = field(default_factory=dict)
+    flat_mapping: Dict[str, Tuple[str, ...]] = field(default_factory=dict)

@@ -1,0 +1,117 @@
+"""Sharded checkpoint save (reference
+`python/paddle/distributed/checkpoint/save_state_dict.py:104`).
+
+TPU-native translation: a sharded ``jax.Array`` carries its FULL global
+sharding on every process, so the global metadata is derivable locally with
+no gather step — each process writes the shards it owns to its own
+``rank_k.distcp`` file, and the coordinator writes one ``metadata`` file
+describing every shard of every tensor. Replicated arrays are saved once (by
+the lowest-rank owner) rather than once per replica.
+
+``async_save=True`` snapshots shard data to host memory synchronously and
+writes files on a background thread (the reference's async checkpoint
+capability)."""
+
+from __future__ import annotations
+
+import atexit
+import os
+import pickle
+import threading
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
+from .utils import flatten_state_dict, shard_offsets, tensor_value
+
+__all__ = ["save_state_dict"]
+
+_pending: list = []
+
+
+def _wait_pending() -> None:
+    while _pending:
+        _pending.pop().join()
+
+
+# interpreter exit must not truncate an in-flight async checkpoint
+atexit.register(_wait_pending)
+
+
+def save_state_dict(state_dict: Dict[str, Any], path: str,
+                    process_group=None, coordinator_rank: int = 0,
+                    async_save: bool = False) -> None:
+    """Write ``state_dict`` (possibly nested; values may be sharded over any
+    mesh) as per-rank shard files plus a global ``metadata`` file under
+    ``path``."""
+    _wait_pending()
+    os.makedirs(path, exist_ok=True)
+    rank = jax.process_index()
+    flat, mapping = flatten_state_dict(state_dict)
+
+    meta = Metadata(flat_mapping=mapping)
+    local_shards: Dict[tuple, np.ndarray] = {}
+
+    for key, leaf in flat.items():
+        v = tensor_value(leaf)
+        if not isinstance(v, jax.Array):
+            v = np.asarray(v)
+            meta.state_dict_metadata[key] = [LocalTensorMetadata(
+                (0,) * v.ndim, tuple(v.shape), str(v.dtype))]
+            meta.storage_metadata[LocalTensorIndex(key, (0,) * v.ndim)] = \
+                f"rank_{coordinator_rank}.distcp"
+            if rank == coordinator_rank:
+                local_shards[(key, (0,) * v.ndim)] = v
+            continue
+
+        shard_metas = []
+        seen_offsets = {}
+        # iterate the GLOBAL sharding (all devices) so every process derives
+        # identical metadata; dedupe replicas by offset, owner = lowest rank
+        for shard in _global_shards(v):
+            offset, local_shape = shard_offsets(shard["index"], v.shape)
+            owner = shard["process_index"]
+            if offset in seen_offsets:
+                seen_offsets[offset] = min(seen_offsets[offset], owner)
+                continue
+            seen_offsets[offset] = owner
+            shard_metas.append(LocalTensorMetadata(offset, local_shape,
+                                                   str(v.dtype)))
+        meta.state_dict_metadata[key] = shard_metas
+        for sm in shard_metas:
+            owner = seen_offsets[sm.global_offset]
+            meta.storage_metadata[LocalTensorIndex(key, sm.global_offset)] = \
+                f"rank_{owner}.distcp"
+
+        # materialize the shards THIS process owns
+        for shard in v.addressable_shards:
+            offset, _ = shard_offsets(shard.index, v.shape)
+            if seen_offsets.get(offset) == rank and (key, offset) not in local_shards:
+                local_shards[(key, offset)] = np.asarray(shard.data)
+
+    def _write():
+        with open(os.path.join(path, f"rank_{rank}.distcp"), "wb") as f:
+            pickle.dump(local_shards, f, protocol=pickle.HIGHEST_PROTOCOL)
+        if rank == coordinator_rank:
+            with open(os.path.join(path, "metadata"), "wb") as f:
+                pickle.dump(meta, f, protocol=pickle.HIGHEST_PROTOCOL)
+
+    if async_save:
+        t = threading.Thread(target=_write, daemon=True)
+        t.start()
+        _pending.append(t)
+    else:
+        _write()
+
+
+def _global_shards(v: jax.Array):
+    """All (index, process_index) pairs of a jax.Array's sharding, across
+    every device — derivable locally because shardings are global."""
+    sharding = v.sharding
+    out = []
+    for dev, index in sharding.devices_indices_map(v.shape).items():
+        out.append({"index": index, "process_index": dev.process_index,
+                    "device": dev})
+    return out

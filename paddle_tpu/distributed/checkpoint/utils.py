@@ -1,0 +1,79 @@
+"""Shared helpers: state_dict flattening and slice-overlap computation
+(reference `distributed/checkpoint/utils.py` + `load_state_dict.py:247`
+compute_overlap)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["flatten_state_dict", "unflatten_key", "compute_overlap",
+           "tensor_value", "shard_offsets"]
+
+
+def tensor_value(t):
+    """paddle_tpu Tensor | jax.Array | np.ndarray → the underlying array."""
+    return getattr(t, "_value", t)
+
+
+def flatten_state_dict(state_dict, prefix: Tuple[str, ...] = ()):
+    """Nested dicts / lists / tuples → {flat_key: leaf} + {flat_key:
+    key_path}. Sequence elements are indexed positionally (reference
+    flattens the same way)."""
+    flat: Dict[str, Any] = {}
+    mapping: Dict[str, Tuple[str, ...]] = {}
+    items = state_dict.items() if isinstance(state_dict, dict) \
+        else enumerate(state_dict)
+    for k, v in items:
+        path = prefix + (str(k),)
+        if isinstance(v, (dict, list, tuple)):
+            sub_flat, sub_map = flatten_state_dict(v, path)
+            flat.update(sub_flat)
+            mapping.update(sub_map)
+        else:
+            key = ".".join(path)
+            flat[key] = v
+            mapping[key] = path
+    return flat, mapping
+
+
+def unflatten_key(target, path: Tuple[str, ...], value) -> None:
+    d = target
+    for p in path[:-1]:
+        d = d[int(p)] if isinstance(d, (list, tuple)) else d.setdefault(p, {})
+    if isinstance(d, list):
+        d[int(path[-1])] = value
+    elif isinstance(d, tuple):
+        raise TypeError(
+            f"cannot write scalar leaf back into a tuple at {'.'.join(path)}; "
+            "use a list in the target state_dict")
+    else:
+        d[path[-1]] = value
+
+
+def compute_overlap(saved_offset, saved_shape, want_offset, want_shape
+                    ) -> Optional[Tuple[Tuple[slice, ...], Tuple[slice, ...]]]:
+    """Intersection of a saved shard and a wanted shard, both in global
+    coordinates. Returns (slices into the saved array, slices into the wanted
+    array), or None when disjoint (reference `load_state_dict.py:247`)."""
+    src_slices, dst_slices = [], []
+    for so, sl, wo, wl in zip(saved_offset, saved_shape, want_offset, want_shape):
+        lo = max(so, wo)
+        hi = min(so + sl, wo + wl)
+        if hi <= lo:
+            return None
+        src_slices.append(slice(lo - so, hi - so))
+        dst_slices.append(slice(lo - wo, hi - wo))
+    return tuple(src_slices), tuple(dst_slices)
+
+
+def shard_offsets(index, shape) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+    """jax shard index (tuple of slices) → (global_offset, local_shape)."""
+    offset, local = [], []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        offset.append(start)
+        local.append(stop - start)
+    return tuple(offset), tuple(local)

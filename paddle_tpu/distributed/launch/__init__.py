@@ -1,0 +1,5 @@
+"""Distributed launch CLI (reference `python/paddle/distributed/launch/`)."""
+
+from .main import launch, main
+
+__all__ = ["launch", "main"]

@@ -1,0 +1,138 @@
+"""`python -m paddle_tpu.distributed.launch` — per-host process launcher.
+
+Reference: `python/paddle/distributed/launch/main.py` +
+`controllers/collective.py:22` (CollectiveController.build_pod). One pod per
+host; each worker process gets the PADDLE_* env contract
+(`parallel.py:687-710` in the reference) and a per-rank
+``log_dir/workerlog.N`` file. The first worker failure tears the pod down
+(reference controller watch-loop semantics).
+
+On TPU the normal deployment is ONE process per host owning all local chips
+(`--nproc_per_node 1`, the default); multi-process-per-host is used by the
+CPU "fake cluster" tests."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import List
+
+__all__ = ["launch", "main"]
+
+
+def _free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Launch a distributed training job (pod-per-host).")
+    p.add_argument("--nnodes", type=int,
+                   default=int(os.environ.get("PADDLE_NNODES", "1")),
+                   help="number of hosts in the job")
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", "0")),
+                   help="rank of this host")
+    p.add_argument("--nproc_per_node", type=int,
+                   default=int(os.environ.get("PADDLE_NPROC_PER_NODE", "1")),
+                   help="worker processes on this host (1 = own all chips)")
+    p.add_argument("--master", type=str,
+                   default=os.environ.get("PADDLE_MASTER"),
+                   help="coordinator host:port (default: local free port)")
+    p.add_argument("--log_dir", type=str, default="log",
+                   help="directory for per-rank workerlog.N files")
+    p.add_argument("--job_id", type=str, default="default",
+                   help="job name tag (reference parity)")
+    p.add_argument("script", type=str, help="training script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def launch(argv=None) -> int:
+    args = _parse(argv if argv is not None else sys.argv[1:])
+    nproc = args.nproc_per_node
+    world = args.nnodes * nproc
+    master = args.master
+    if master is None:
+        if args.nnodes > 1:
+            raise SystemExit("--master host:port is required when nnodes > 1")
+        master = f"127.0.0.1:{_free_port()}"
+    os.makedirs(args.log_dir, exist_ok=True)
+
+    procs: List[subprocess.Popen] = []
+    logs = []
+    try:
+        for local in range(nproc):
+            rank = args.node_rank * nproc + local
+            env = os.environ.copy()
+            env.update({
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_MASTER": master,
+                "PADDLE_LOCAL_RANK": str(local),
+                "PADDLE_RANK_IN_NODE": str(local),
+                "PADDLE_JOB_ID": args.job_id,
+                # multi-process-per-host (CPU fake cluster): keep each worker
+                # to its own slice of host devices
+                "PADDLE_NPROC_PER_NODE": str(nproc),
+            })
+            log_path = os.path.join(args.log_dir, f"workerlog.{rank}")
+            log_f = open(log_path, "w")
+            logs.append(log_f)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-u", args.script, *args.script_args],
+                env=env, stdout=log_f, stderr=subprocess.STDOUT))
+    except BaseException:
+        # a failed spawn must not leave earlier workers blocked on a
+        # rendezvous that will never complete
+        for pr in procs:
+            pr.kill()
+        for f in logs:
+            f.close()
+        raise
+
+    rc = 0
+    try:
+        while procs:
+            for pr in list(procs):
+                code = pr.poll()
+                if code is None or pr not in procs:
+                    continue
+                procs.remove(pr)
+                if code != 0:
+                    rc = code
+                    # first failure tears down the pod (reference
+                    # CollectiveController watch loop)
+                    for other in procs:
+                        other.terminate()
+                    for other in procs:
+                        try:
+                            other.wait(timeout=10)
+                        except subprocess.TimeoutExpired:
+                            other.kill()
+                    procs.clear()
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        for pr in procs:
+            pr.send_signal(signal.SIGINT)
+        rc = 130
+    finally:
+        for f in logs:
+            f.close()
+    return rc
+
+
+def main() -> None:
+    raise SystemExit(launch())
+
+
+if __name__ == "__main__":
+    main()

@@ -14,6 +14,7 @@ ordering rationale."""
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -71,6 +72,21 @@ class CommunicateTopology:
         return self.get_dim(axis_name)
 
 
+@functools.lru_cache(maxsize=64)
+def process_mesh_coords(mesh: Mesh) -> Dict[str, int]:
+    """Mesh coordinates of THIS process: the position of its lowest-placed
+    addressable device along each axis. With one process owning the whole
+    mesh this is all zeros; in multi-host SPMD it identifies the host's
+    block (host-side analogue of `axis_index`, which only exists inside
+    shard_map). Cached per mesh — rank queries run per step."""
+    me = jax.process_index()
+    arr = np.asarray(mesh.devices)
+    for idx in np.ndindex(arr.shape):
+        if arr[idx].process_index == me:
+            return dict(zip(mesh.axis_names, idx))
+    return {a: 0 for a in mesh.axis_names}
+
+
 class CommGroup:
     """A logical communication group = a set of mesh axes (the TPU analogue
     of a ProcessGroup; reference `process_group.h:47`)."""
@@ -90,8 +106,14 @@ class CommGroup:
 
     @property
     def rank(self) -> int:
-        # SPMD: inside shard_map, rank is axis_index; host-side we report 0
-        return 0
+        """This process's rank within the group: its mesh coordinates along
+        the group axes, flattened in axis order. (Inside shard_map, per-
+        device rank is `jax.lax.axis_index` instead.)"""
+        coords = process_mesh_coords(self.mesh)
+        r = 0
+        for a in self.axes:
+            r = r * self.mesh.shape[a] + coords[a]
+        return r
 
     def __repr__(self):
         return f"CommGroup(axes={self.axes}, nranks={self.nranks})"
@@ -163,15 +185,22 @@ class HybridCommunicateGroup:
     def get_global_group(self) -> CommGroup:
         return CommGroup(self.mesh, _HYBRID_AXES)
 
-    # rank queries (meaningful inside shard_map; host-side return 0) ----
+    # rank queries: this process's block coordinates on the mesh (per-device
+    # ranks inside shard_map come from jax.lax.axis_index instead) ----
     def get_data_parallel_rank(self) -> int:
-        return 0
+        return process_mesh_coords(self.mesh)["data"]
 
     def get_model_parallel_rank(self) -> int:
-        return 0
+        return process_mesh_coords(self.mesh)["model"]
+
+    def get_sharding_parallel_rank(self) -> int:
+        return process_mesh_coords(self.mesh)["sharding"]
+
+    def get_sep_parallel_rank(self) -> int:
+        return process_mesh_coords(self.mesh)["sep"]
 
     def get_stage_id(self) -> int:
-        return 0
+        return process_mesh_coords(self.mesh)["pipe"]
 
     def topology(self) -> CommunicateTopology:
         return self._topo

@@ -38,18 +38,27 @@ class Generator:
     def manual_seed(self, seed: int) -> "Generator":
         with getattr(self, "_lock", threading.Lock()):
             self._seed = int(seed)
-            self._key = jax.random.PRNGKey(self._seed)
+            # lazy: creating a PRNGKey initializes the jax backend, which
+            # must not happen at library import (the launch CLI imports the
+            # package in the parent process before workers pick platforms)
+            self._key = None
             self._counter = 0
         return self
 
+    def _ensure_key(self) -> None:
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self._seed)
+
     def next_key(self) -> jax.Array:
         with self._lock:
+            self._ensure_key()
             self._key, sub = jax.random.split(self._key)
             self._counter += 1
             return sub
 
     def split(self, n: int):
         with self._lock:
+            self._ensure_key()
             self._key, *subs = jax.random.split(self._key, n + 1)
             self._counter += n
             return list(subs)
@@ -60,6 +69,7 @@ class Generator:
 
     def get_state(self):
         with self._lock:
+            self._ensure_key()
             return {"seed": self._seed, "key": np.asarray(self._key), "counter": self._counter}
 
     def set_state(self, state) -> None:

@@ -1,0 +1,134 @@
+"""Distributed checkpoint: sharded save + reshard-on-load across mesh
+changes (reference `distributed/checkpoint/` semantics, SURVEY §8.6)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import ProcessMesh, Replicate, Shard, shard_tensor
+from paddle_tpu.distributed.checkpoint import load_state_dict, save_state_dict
+
+
+@pytest.fixture
+def ckpt_dir(tmp_path):
+    return str(tmp_path / "ckpt")
+
+
+def _mesh(shape, names):
+    return ProcessMesh(np.arange(8).reshape(shape), dim_names=list(names))
+
+
+class TestRoundTrip:
+    def test_same_sharding_roundtrip(self, ckpt_dir):
+        pm = _mesh((8,), "x")
+        src = np.arange(64, dtype="float32").reshape(16, 4)
+        t = shard_tensor(src, pm, [Shard(0), Replicate()])
+        save_state_dict({"w": t}, ckpt_dir)
+
+        dst = shard_tensor(np.zeros_like(src), pm, [Shard(0), Replicate()])
+        load_state_dict({"w": dst}, ckpt_dir)
+        np.testing.assert_array_equal(dst.numpy(), src)
+
+    def test_nested_and_scalar_leaves(self, ckpt_dir):
+        pm = _mesh((8,), "x")
+        src = np.random.default_rng(0).standard_normal((8, 8)).astype("float32")
+        t = shard_tensor(src, pm, [Shard(0), Replicate()])
+        save_state_dict({"model": {"w": t}, "opt": {"step": paddle.to_tensor(7)}},
+                        ckpt_dir)
+
+        dst = shard_tensor(np.zeros_like(src), pm, [Replicate(), Shard(1)])
+        step = paddle.to_tensor(0)
+        load_state_dict({"model": {"w": dst}, "opt": {"step": step}}, ckpt_dir)
+        np.testing.assert_array_equal(dst.numpy(), src)
+        assert int(step) == 7
+
+    def test_async_save_then_load(self, ckpt_dir):
+        pm = _mesh((8,), "x")
+        src = np.random.default_rng(4).standard_normal((16, 4)).astype("float32")
+        t = shard_tensor(src, pm, [Shard(0), Replicate()])
+        save_state_dict({"w": t}, ckpt_dir, async_save=True)
+        dst = shard_tensor(np.zeros_like(src), pm, [Replicate(), Replicate()])
+        load_state_dict({"w": dst}, ckpt_dir)  # waits for the async writer
+        np.testing.assert_array_equal(dst.numpy(), src)
+
+    def test_missing_key_raises(self, ckpt_dir):
+        pm = _mesh((8,), "x")
+        t = shard_tensor(np.ones((8, 2), "float32"), pm, [Shard(0), Replicate()])
+        save_state_dict({"a": t}, ckpt_dir)
+        with pytest.raises(KeyError):
+            load_state_dict({"b": t}, ckpt_dir)
+
+
+class TestReshardOnLoad:
+    @pytest.mark.parametrize("save_spec,load_spec", [
+        ([Shard(0), Shard(1)], [Shard(1), Shard(0)]),
+        ([Shard(0), Replicate()], [Replicate(), Shard(1)]),
+        ([Replicate(), Replicate()], [Shard(0), Shard(1)]),
+    ])
+    def test_mesh_change_2d(self, ckpt_dir, save_spec, load_spec):
+        """Save on a 4x2 mesh, load on a 2x4 mesh with different placements."""
+        pm_save = _mesh((4, 2), ("a", "b"))
+        pm_load = _mesh((2, 4), ("c", "d"))
+        src = np.random.default_rng(1).standard_normal((16, 8)).astype("float32")
+        t = shard_tensor(src, pm_save, save_spec)
+        save_state_dict({"w": t}, ckpt_dir)
+
+        dst = shard_tensor(np.zeros_like(src), pm_load, load_spec)
+        load_state_dict({"w": dst}, ckpt_dir)
+        np.testing.assert_array_equal(dst.numpy(), src)
+
+    def test_dp2mp2_to_dp4(self, ckpt_dir):
+        """The VERDICT's acceptance case: save under dp2×mp2-style sharding,
+        load under dp4-style (pure replication + different axis)."""
+        pm_save = _mesh((2, 2, 2), ("dp", "mp", "extra"))
+        pm_load = _mesh((8,), ("dp",))
+        src = np.random.default_rng(2).standard_normal((8, 16)).astype("float32")
+        t = shard_tensor(src, pm_save, [Shard(0), Shard(1)])
+        save_state_dict({"w": t}, ckpt_dir)
+
+        dst = shard_tensor(np.zeros_like(src), pm_load, [Replicate(), Shard(0)])
+        load_state_dict({"w": dst}, ckpt_dir)
+        np.testing.assert_array_equal(dst.numpy(), src)
+
+
+class TestTrainingStateRoundTrip:
+    def test_model_and_optimizer_reshard(self, ckpt_dir):
+        """Sharded train state (ZeRO-3 params + moments) round-trips onto a
+        differently-factored mesh and training continues identically."""
+        def build(hcg, stage):
+            paddle.seed(11)
+            m = nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 16))
+            o = paddle.optimizer.AdamW(1e-2, parameters=m.parameters())
+            step = dist.DistributedTrainStep(
+                m, lambda mm, a, b: F.mse_loss(mm(a), b), o, hcg,
+                sharding_stage=stage)
+            return m, o, step
+
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "sharding_degree": 4}
+        dist.fleet.init(is_collective=True, strategy=strategy)
+        hcg1 = dist.get_hybrid_communicate_group()
+        m1, o1, step1 = build(hcg1, 3)
+        X = paddle.rand([16, 16])
+        Y = X * 0.5
+        for _ in range(3):
+            step1(X, Y)
+        save_state_dict({"model": m1.state_dict(),
+                         "opt": o1.state_dict()}, ckpt_dir)
+        ref_next = float(step1(X, Y))  # the 4th step, after the snapshot
+
+        strategy2 = dist.fleet.DistributedStrategy()
+        strategy2.hybrid_configs = {"dp_degree": 4, "sharding_degree": 2}
+        dist.fleet.init(is_collective=True, strategy=strategy2)
+        hcg2 = dist.get_hybrid_communicate_group()
+        m2, o2, step2 = build(hcg2, 2)
+        step2(X, Y)  # materialize sharded opt state on the new mesh
+        target = {"model": m2.state_dict(), "opt": o2.state_dict()}
+        load_state_dict(target, ckpt_dir)
+        m2.set_state_dict(target["model"])
+        o2.set_state_dict(target["opt"])
+        got_next = float(step2(X, Y))
+        np.testing.assert_allclose(got_next, ref_next, rtol=1e-4)

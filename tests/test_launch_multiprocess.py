@@ -1,0 +1,89 @@
+"""Launch CLI + real multi-process collectives on CPU (the reference's
+`test/collective/test_communication_api_base.py:26` driver/payload pattern:
+spawn workers via the launch CLI with loopback rendezvous, assert inside the
+payload)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_PAYLOAD = textwrap.dedent("""
+    import os, re
+    flags = os.environ.get("XLA_FLAGS", "")
+    os.environ["XLA_FLAGS"] = re.sub(
+        r"--xla_force_host_platform_device_count=\\d+", "", flags).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import communication as comm
+
+    env = dist.init_parallel_env()
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 2, jax.devices()
+    assert env.rank == rank == dist.get_rank()
+
+    # rank queries reflect this process's mesh block
+    hcg = dist.get_hybrid_communicate_group()
+    g = hcg.get_data_parallel_group()
+    assert g.rank == rank, (g.rank, rank)
+    assert hcg.get_data_parallel_rank() == rank
+
+    # cross-process all_reduce: slices [1.] and [3.] -> every slice 4.
+    x = comm.scatter_stack(paddle.to_tensor(np.array([[1.0], [3.0]], "float32")))
+    comm.all_reduce(x)
+    local = np.asarray(x._value.addressable_shards[0].data)
+    np.testing.assert_allclose(local.ravel(), [4.0])
+
+    # all_gather: every process sees the full stack
+    y = comm.scatter_stack(paddle.to_tensor(
+        np.array([[10.0], [20.0]], "float32")))
+    gathered = comm.all_gather(y)
+    gl = np.asarray(gathered._value.addressable_shards[0].data)
+    print("PAYLOAD OK rank", rank, flush=True)
+""")
+
+
+def _run_launch(tmp_path, payload_src, nproc=2, timeout=240):
+    payload = tmp_path / "payload.py"
+    payload.write_text(payload_src)
+    log_dir = tmp_path / "log"
+    env = os.environ.copy()
+    env.pop("PADDLE_TRAINER_ID", None)
+    env.pop("PADDLE_TRAINERS_NUM", None)
+    # workers run script-mode (script dir on sys.path, not cwd); make the
+    # repo-local package importable
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", str(nproc), "--log_dir", str(log_dir),
+         str(payload)],
+        env=env, cwd="/root/repo", capture_output=True, text=True,
+        timeout=timeout)
+    return proc, log_dir
+
+
+class TestLaunchMultiProcess:
+    def test_two_process_collectives(self, tmp_path):
+        proc, log_dir = _run_launch(tmp_path, _PAYLOAD)
+        logs = {p.name: p.read_text() for p in log_dir.glob("workerlog.*")}
+        assert proc.returncode == 0, f"launch failed: {proc.stderr}\n{logs}"
+        assert set(logs) == {"workerlog.0", "workerlog.1"}
+        for name, text in logs.items():
+            assert "PAYLOAD OK rank" in text, f"{name}: {text[-2000:]}"
+
+    def test_worker_failure_tears_down_pod(self, tmp_path):
+        bad = textwrap.dedent("""
+            import os, sys, time
+            if os.environ["PADDLE_TRAINER_ID"] == "1":
+                sys.exit(3)
+            time.sleep(60)  # rank 0 hangs; the launcher must kill it
+        """)
+        proc, _ = _run_launch(tmp_path, bad, timeout=90)
+        assert proc.returncode == 3
