@@ -1,0 +1,12 @@
+"""paddle_tpu.incubate — fused-op API + experimental distributed models.
+
+Parity target: ``python/paddle/incubate/`` (nn.functional fused ops,
+distributed.models.moe). On TPU most "fused" ops are either Pallas kernels
+(``paddle_tpu/ops/pallas/``) or single-fusion XLA expressions; the incubate
+namespace keeps the reference's import paths working."""
+
+from . import nn  # noqa: F401
+from . import distributed  # noqa: F401
+
+# reference exposes paddle.incubate.softmax_mask_fuse upcast variants etc.
+# at top level; the fused functional surface lives in incubate.nn.functional.

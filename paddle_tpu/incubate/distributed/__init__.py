@@ -1,0 +1,3 @@
+"""incubate.distributed — experimental distributed models (MoE)."""
+
+from . import models  # noqa: F401

@@ -1,0 +1,275 @@
+"""incubate.nn.functional — the fused-op API surface.
+
+Parity target: ``python/paddle/incubate/nn/functional/`` (fused_rms_norm.py,
+fused_layer_norm.py, fused_rotary_position_embedding.py, fused_matmul_bias.py,
+fused_dropout_add.py, fused_dot_product_attention.py, swiglu.py). The
+reference backs these with hand-written CUDA in
+``paddle/phi/kernels/fusion/gpu/``; here each op is either a Pallas TPU
+kernel (rms_norm, rope, attention — see ``paddle_tpu/ops/pallas/``) or a
+single jnp expression that XLA fuses on its own (bias+act, dropout+add,
+matmul+bias): on TPU the compiler performs the elementwise-into-matmul
+fusion these CUDA kernels exist for, so the API is kept for parity while
+the fusion itself is the compiler's job."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ....nn import functional as F
+from ....tensor.tensor import Tensor, apply_op
+from ....tensor._op_utils import ensure_tensor
+
+__all__ = [
+    "fused_rms_norm", "fused_layer_norm", "fused_rotary_position_embedding",
+    "fused_matmul_bias", "fused_linear", "fused_linear_activation",
+    "fused_bias_act", "fused_dropout_add", "fused_dot_product_attention",
+    "swiglu", "fused_multi_head_attention", "fused_feedforward",
+]
+
+# re-export: the core functional already dispatches swiglu/rms_norm to pallas
+swiglu = F.swiglu
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon: float = 1e-6,
+                   begin_norm_axis: int = -1, bias=None, residual=None,
+                   quant_scale: float = -1, quant_round_type: int = 0,
+                   quant_max_bound: float = 0, quant_min_bound: float = 0):
+    """RMSNorm(x [+ bias] [+ residual]); returns ``(out, residual_out)`` when
+    ``residual`` is given, else ``out`` (reference fused_rms_norm.py:21)."""
+    if quant_scale > 0:
+        raise NotImplementedError("quantized fused_rms_norm output is not supported on TPU")
+    x = ensure_tensor(x)
+    pre = x
+    if bias is not None:
+        pre = pre + ensure_tensor(bias)
+    if residual is not None:
+        pre = pre + ensure_tensor(residual)
+    out = F.rms_norm(pre, norm_weight, epsilon)
+    if norm_bias is not None:
+        out = out + ensure_tensor(norm_bias)
+    return (out, pre) if residual is not None else out
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon: float = 1e-5,
+                     begin_norm_axis: int = -1, bias=None, residual=None,
+                     quant_scale: float = -1, quant_round_type: int = 0,
+                     quant_max_bound: float = 0, quant_min_bound: float = 0):
+    """LayerNorm(x [+ bias] [+ residual]); tuple convention as fused_rms_norm."""
+    if quant_scale > 0:
+        raise NotImplementedError("quantized fused_layer_norm output is not supported on TPU")
+    x = ensure_tensor(x)
+    pre = x
+    if bias is not None:
+        pre = pre + ensure_tensor(bias)
+    if residual is not None:
+        pre = pre + ensure_tensor(residual)
+    shape = [pre.shape[-1]]
+    out = F.layer_norm(pre, shape, weight=norm_weight, bias=norm_bias, epsilon=epsilon)
+    return (out, pre) if residual is not None else out
+
+
+def _rope_tables(seq_len: int, head_dim: int, dtype, position_ids=None):
+    inv = 1.0 / (10000.0 ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    pos = jnp.arange(seq_len, dtype=jnp.float32) if position_ids is None else \
+        jnp.asarray(position_ids, jnp.float32).reshape(-1)
+    freqs = jnp.outer(pos, inv)                      # [s, d/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)   # [s, d]
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style: bool = True,
+                                    time_major: bool = False, rotary_emb_base: float = 10000.0):
+    """Apply RoPE to q/k (and optionally v). [b, s, h, d] layout, reference
+    fused_rotary_position_embedding.py:21. Dispatches to the Pallas rope
+    kernel when eligible; sin/cos default to the standard 10000-base tables."""
+    if not use_neox_rotary_style:
+        raise NotImplementedError("only neox-style (half-rotation) RoPE is supported")
+    if time_major:
+        raise NotImplementedError("time_major rope layout is not supported")
+    q = ensure_tensor(q)
+    s, d = q.shape[1], q.shape[-1]
+    if cos is None or sin is None:
+        cos_t, sin_t = _rope_tables(s, d, q.dtype._value if hasattr(q.dtype, "_value") else None,
+                                    position_ids)
+    else:
+        cos_t = jnp.asarray(cos._value if isinstance(cos, Tensor) else cos).reshape(s, d)
+        sin_t = jnp.asarray(sin._value if isinstance(sin, Tensor) else sin).reshape(s, d)
+
+    def rot(t):
+        tf = t.astype(jnp.float32)
+        half = tf.shape[-1] // 2
+        rotated = jnp.concatenate([-tf[..., half:], tf[..., :half]], axis=-1)
+        return (tf * cos_t[None, :, None, :] + rotated * sin_t[None, :, None, :]).astype(t.dtype)
+
+    outs = []
+    for t in (q, k, v):
+        if t is None:
+            outs.append(None)
+        else:
+            outs.append(apply_op("fused_rope", rot, (ensure_tensor(t),)))
+    return tuple(outs)
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x: bool = False,
+                      transpose_y: bool = False, name=None) -> Tensor:
+    """matmul(+bias) — one XLA fusion on TPU (reference fused_matmul_bias.py:21
+    backs this with cuBLASLt epilogue)."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def fn(a, b, *bb):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = jnp.matmul(a, b)
+        if bb:
+            out = out + bb[0]
+        return out
+
+    args = (x, y) if bias is None else (x, y, ensure_tensor(bias))
+    return apply_op("fused_matmul_bias", fn, args)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight: bool = False, name=None) -> Tensor:
+    return fused_matmul_bias(x, weight, bias, False, transpose_weight)
+
+
+_ACTS = {"gelu": jax.nn.gelu, "relu": jax.nn.relu, "silu": jax.nn.silu,
+         "swish": jax.nn.silu, "sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+         "none": lambda v: v, "identity": lambda v: v}
+
+
+def fused_linear_activation(x, y, bias=None, trans_x: bool = False, trans_y: bool = False,
+                            activation: str = "gelu") -> Tensor:
+    """matmul + bias + activation epilogue (reference fused_matmul_bias.py:111)."""
+    act = _ACTS[activation or "none"]
+    out = fused_matmul_bias(x, y, bias, trans_x, trans_y)
+    return apply_op("fused_linear_activation", act, (out,))
+
+
+def fused_bias_act(x, bias=None, dequant_scales=None, shift=None, smooth=None,
+                   act_method: str = "gelu", compute_dtype: str = "default",
+                   quant_scale: float = -1, quant_round_type: int = 0,
+                   quant_max_bound: float = 0, quant_min_bound: float = 0) -> Tensor:
+    """bias + activation, with swiglu/geglu gated variants
+    (reference fused_bias_act.py; CUDA kernel fused_bias_act_kernel.cu)."""
+    if quant_scale > 0 or dequant_scales is not None:
+        raise NotImplementedError("quantized fused_bias_act is not supported on TPU")
+    x = ensure_tensor(x)
+    tensors = (x,) if bias is None else (x, ensure_tensor(bias))
+
+    def fn(v, *bb):
+        if bb:
+            v = v + bb[0]
+        if act_method in ("swiglu", "silu_glu"):
+            half = v.shape[-1] // 2
+            return jax.nn.silu(v[..., :half]) * v[..., half:]
+        if act_method in ("geglu", "gelu_glu"):
+            half = v.shape[-1] // 2
+            return jax.nn.gelu(v[..., :half]) * v[..., half:]
+        return _ACTS[act_method](v)
+
+    return apply_op("fused_bias_act", fn, tensors)
+
+
+def fused_dropout_add(x, y, p: float = 0.5, training: bool = True,
+                      mode: str = "upscale_in_train", name=None) -> Tensor:
+    """dropout(x) + y (reference fused_dropout_add.py:22)."""
+    return F.dropout(ensure_tensor(x), p=p, training=training, mode=mode) + ensure_tensor(y)
+
+
+def fused_dot_product_attention(q, k, v, attn_mask=None, dropout_p: float = 0.0,
+                                is_causal: bool = False, training: bool = True,
+                                scaling_factor: Optional[float] = None, name=None) -> Tensor:
+    """[b, s, h, d] fused attention → flash-attention path
+    (reference fused_dot_product_attention.py:22 backs this with cuDNN;
+    here it rides `F.scaled_dot_product_attention`'s Pallas dispatch)."""
+    return F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask, dropout_p=dropout_p if training else 0.0,
+        is_causal=is_causal, training=training)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None, attn_mask=None,
+                               dropout_rate=0.0, attn_dropout_rate=0.0,
+                               ln_epsilon=1e-5, training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True, num_heads=None,
+                               transpose_qkv_wb=False, name=None) -> Tensor:
+    """Whole-MHA block: [pre-]LN → qkv proj → SDPA → out proj → dropout →
+    residual → [post-]LN (reference fused_transformer.py fused_multi_head_attention).
+
+    qkv_weight: [3, num_heads, head_dim, embed_dim] (paddle layout), or
+    [embed_dim, 3*embed_dim] with ``transpose_qkv_wb=True``."""
+    x = ensure_tensor(x)
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, [x.shape[-1]], weight=pre_ln_scale, bias=pre_ln_bias,
+                         epsilon=pre_ln_epsilon)
+    qkv_w = ensure_tensor(qkv_weight)
+    e = x.shape[-1]
+    if transpose_qkv_wb:
+        if num_heads is None:
+            raise ValueError("num_heads required with transpose_qkv_wb")
+        h, hd = num_heads, e // num_heads
+        w = qkv_w.reshape([e, 3, h, hd])
+        qkv = F.linear(x, w.reshape([e, 3 * e]))
+        if qkv_bias is not None:
+            qkv = qkv + ensure_tensor(qkv_bias).reshape([3 * e])
+        b, s = x.shape[0], x.shape[1]
+        qkv = qkv.reshape([b, s, 3, h, hd])
+    else:
+        three, h, hd, _ = qkv_w.shape
+        w = qkv_w.transpose([3, 0, 1, 2]).reshape([e, 3 * h * hd])
+        qkv = F.linear(x, w)
+        if qkv_bias is not None:
+            qkv = qkv + ensure_tensor(qkv_bias).reshape([3 * h * hd])
+        b, s = x.shape[0], x.shape[1]
+        qkv = qkv.reshape([b, s, 3, h, hd])
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    ctx = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                         dropout_p=attn_dropout_rate if training else 0.0,
+                                         training=training)
+    ctx = ctx.reshape([b, s, h * hd])
+    lw = ensure_tensor(linear_weight)
+    if transpose_qkv_wb is False and lw.shape[0] != h * hd:
+        lw = lw.reshape([h * hd, e])
+    out = F.linear(ctx, lw, linear_bias)
+    out = F.dropout(out, p=dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [out.shape[-1]], weight=ln_scale, bias=ln_bias,
+                           epsilon=ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu", ln1_epsilon=1e-5,
+                      ln2_epsilon=1e-5, pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1, add_residual=True,
+                      name=None) -> Tensor:
+    """FFN block: [pre-]LN → linear+act → dropout → linear → dropout →
+    residual → [post-]LN (reference fused_transformer.py fused_feedforward)."""
+    x = ensure_tensor(x)
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, [x.shape[-1]], weight=ln1_scale, bias=ln1_bias,
+                         epsilon=ln1_epsilon)
+    h = fused_linear_activation(x, linear1_weight, linear1_bias, activation=activation)
+    h = F.dropout(h, p=dropout1_rate, training=training, mode=mode)
+    out = F.linear(h, linear2_weight, linear2_bias)
+    out = F.dropout(out, p=dropout2_rate, training=training, mode=mode)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [out.shape[-1]], weight=ln2_scale, bias=ln2_bias,
+                           epsilon=ln2_epsilon)
+    return out

@@ -1,0 +1,263 @@
+"""incubate fused ops + MoE tests.
+
+Reference test strategy: test/legacy_test/test_fused_*.py compare fused
+kernels against composed eager ops; incubate MoE tests check routing and
+parity against a dense gated mixture (moe_layer.py). Here additionally:
+the ExpertParallelMLP must produce identical outputs replicated vs
+expert-sharded on the 8-device mesh (the EP correctness test VERDICT asked
+for)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import incubate
+from paddle_tpu.incubate.nn import functional as FI
+from paddle_tpu.incubate.distributed.models.moe import (
+    ExpertParallelMLP, GShardGate, MoELayer, NaiveGate, SwitchGate, _capacity,
+    _topk_routing)
+from paddle_tpu import nn
+
+
+def rand(*shape, dtype=np.float32, seed=0):
+    return np.random.default_rng(seed).standard_normal(shape).astype(dtype)
+
+
+class TestFusedFunctional:
+    def test_fused_rms_norm_matches_composed(self):
+        x, res, w = rand(4, 16), rand(4, 16, seed=1), rand(16, seed=2)
+        out, res_out = FI.fused_rms_norm(paddle.to_tensor(x), paddle.to_tensor(w),
+                                         residual=paddle.to_tensor(res))
+        ref_pre = x + res
+        ref = F.rms_norm(paddle.to_tensor(ref_pre), paddle.to_tensor(w))
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-6)
+        np.testing.assert_allclose(res_out.numpy(), ref_pre, rtol=1e-6)
+        # no residual → single tensor
+        single = FI.fused_rms_norm(paddle.to_tensor(x), paddle.to_tensor(w))
+        assert not isinstance(single, tuple)
+
+    def test_fused_layer_norm_matches_composed(self):
+        x, w, b = rand(4, 16), rand(16, seed=1), rand(16, seed=2)
+        out = FI.fused_layer_norm(paddle.to_tensor(x), paddle.to_tensor(w),
+                                  paddle.to_tensor(b))
+        ref = F.layer_norm(paddle.to_tensor(x), [16], weight=paddle.to_tensor(w),
+                           bias=paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5, atol=1e-6)
+
+    def test_fused_rope_rotates_qk(self):
+        q, k = rand(2, 8, 4, 16), rand(2, 8, 4, 16, seed=1)
+        qr, kr, v = FI.fused_rotary_position_embedding(
+            paddle.to_tensor(q), paddle.to_tensor(k))
+        assert v is None
+        assert qr.shape == list(q.shape)
+        # position 0 has zero rotation → unchanged
+        np.testing.assert_allclose(qr.numpy()[:, 0], q[:, 0], rtol=1e-5, atol=1e-6)
+        assert not np.allclose(qr.numpy()[:, 5], q[:, 5])
+        # norms preserved (rotation is orthogonal)
+        np.testing.assert_allclose(np.linalg.norm(qr.numpy(), axis=-1),
+                                   np.linalg.norm(q, axis=-1), rtol=1e-4)
+
+    def test_fused_matmul_bias_and_linear_activation(self):
+        x, w, b = rand(3, 8), rand(8, 5, seed=1), rand(5, seed=2)
+        out = FI.fused_matmul_bias(paddle.to_tensor(x), paddle.to_tensor(w),
+                                   paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), x @ w + b, rtol=1e-5)
+        outT = FI.fused_matmul_bias(paddle.to_tensor(x), paddle.to_tensor(w.T),
+                                    paddle.to_tensor(b), transpose_y=True)
+        np.testing.assert_allclose(outT.numpy(), x @ w + b, rtol=1e-5)
+        act = FI.fused_linear_activation(paddle.to_tensor(x), paddle.to_tensor(w),
+                                         paddle.to_tensor(b), activation="relu")
+        np.testing.assert_allclose(act.numpy(), np.maximum(x @ w + b, 0), rtol=1e-5)
+
+    def test_fused_bias_act_swiglu(self):
+        x = rand(4, 16)
+        out = FI.fused_bias_act(paddle.to_tensor(x), act_method="swiglu")
+        ref = F.swiglu(paddle.to_tensor(x))
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-6)
+
+    def test_fused_dropout_add_eval_is_add(self):
+        x, y = rand(4, 4), rand(4, 4, seed=1)
+        out = FI.fused_dropout_add(paddle.to_tensor(x), paddle.to_tensor(y),
+                                   p=0.5, training=False)
+        np.testing.assert_allclose(out.numpy(), x + y, rtol=1e-6)
+
+    def test_fused_dot_product_attention_matches_sdpa(self):
+        q = rand(2, 8, 2, 16)
+        k = rand(2, 8, 2, 16, seed=1)
+        v = rand(2, 8, 2, 16, seed=2)
+        out = FI.fused_dot_product_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                                             paddle.to_tensor(v), is_causal=True)
+        ref = F.scaled_dot_product_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                                             paddle.to_tensor(v), is_causal=True)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5, atol=1e-6)
+
+
+class TestFusedLayers:
+    def test_fused_linear_layer(self):
+        layer = incubate.nn.FusedLinear(8, 4)
+        x = paddle.to_tensor(rand(2, 8))
+        out = layer(x)
+        ref = x.numpy() @ layer.weight.numpy() + layer.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_fused_mha_shapes_and_grad(self):
+        layer = incubate.nn.FusedMultiHeadAttention(32, 4, dropout_rate=0.0,
+                                                    attn_dropout_rate=0.0)
+        x = paddle.to_tensor(rand(2, 6, 32), stop_gradient=False)
+        out = layer(x)
+        assert out.shape == [2, 6, 32]
+        out.sum().backward()
+        assert layer.qkv_weight.grad is not None
+        assert float(np.abs(layer.qkv_weight.grad.numpy()).sum()) > 0
+
+    def test_fused_ffn_pre_post_norm(self):
+        for pre in (True, False):
+            layer = incubate.nn.FusedFeedForward(16, 32, dropout_rate=0.0,
+                                                 act_dropout_rate=0.0,
+                                                 normalize_before=pre)
+            out = layer(paddle.to_tensor(rand(2, 4, 16)))
+            assert out.shape == [2, 4, 16]
+            assert np.isfinite(out.numpy()).all()
+
+
+class TestRouting:
+    def test_capacity_rounding(self):
+        assert _capacity(64, 4, 2, 1.0) == 32
+        assert _capacity(10, 4, 1, 1.0) == 8   # floor at 8
+        assert _capacity(100, 4, 2, 1.5) % 8 == 0
+
+    def test_topk_routing_dispatch_properties(self):
+        logits = jnp.asarray(rand(32, 4, seed=3))
+        dispatch, combine, l_aux = _topk_routing(logits, 2, 16)
+        # each token dispatched to ≤ k slots, each (expert, slot) used ≤ once
+        assert float(jnp.max(jnp.sum(dispatch, axis=(1, 2)))) <= 2.0
+        assert float(jnp.max(jnp.sum(dispatch, axis=0))) <= 1.0
+        # combine weights of a token sum to ≤ 1 (normalized, minus drops)
+        assert float(jnp.max(jnp.sum(combine, axis=(1, 2)))) <= 1.0 + 1e-5
+        assert np.isfinite(float(l_aux))
+
+    def test_capacity_drops_overflow(self):
+        # all 16 tokens want expert 0; capacity 8 → 8 dispatched
+        logits = jnp.tile(jnp.asarray([[10.0, 0.0]]), (16, 1))
+        dispatch, _, _ = _topk_routing(logits, 1, 8)
+        assert float(jnp.sum(dispatch[:, 0])) == 8.0
+
+
+class TestGates:
+    def test_gate_factory(self):
+        assert isinstance(MoELayer(8, experts=[nn.Linear(8, 8)],
+                                   gate={"type": "naive", "top_k": 1}).gate, NaiveGate)
+        assert isinstance(MoELayer(8, experts=[nn.Linear(8, 8)],
+                                   gate={"type": "switch"}).gate, SwitchGate)
+        g = GShardGate(8, 4)
+        assert MoELayer(8, experts=[nn.Linear(8, 8) for _ in range(4)], gate=g).gate is g
+
+    def test_gshard_gate_loss(self):
+        g = GShardGate(8, 4)
+        x = paddle.to_tensor(rand(16, 8))
+        val, idx = g(x)
+        assert val.shape == [16, 2] and idx.shape == [16, 2]
+        assert g.get_loss() is not None
+        assert g.get_loss() is None  # cleared
+
+    def test_switch_gate_top1(self):
+        g = SwitchGate(8, 4)
+        g.eval()
+        val, idx = g(paddle.to_tensor(rand(16, 8)))
+        assert val.shape == [16, 1]
+
+
+class Expert(nn.Layer):
+    def __init__(self, d, h):
+        super().__init__()
+        self.up = nn.Linear(d, h)
+        self.down = nn.Linear(h, d)
+
+    def forward(self, x):
+        return self.down(F.relu(self.up(x)))
+
+
+class TestMoELayer:
+    def test_moe_forward_backward(self):
+        layer = MoELayer(16, experts=[Expert(16, 32) for _ in range(4)],
+                         gate={"type": "gshard", "top_k": 2}, capacity_factor=4.0)
+        x = paddle.to_tensor(rand(2, 8, 16), stop_gradient=False)
+        out = layer(x)
+        assert out.shape == [2, 8, 16]
+        loss = out.sum() + layer.l_aux
+        loss.backward()
+        # gate and at least one expert receive gradients
+        assert layer.gate.gate_weight.grad is not None
+        grads = [e.up.weight.grad for e in layer.experts if e.up.weight.grad is not None]
+        assert grads and any(float(np.abs(g.numpy()).sum()) > 0 for g in grads)
+
+    def test_moe_with_ample_capacity_matches_dense_mixture(self):
+        """With capacity ≥ tokens, no drops: MoE == Σ_k w_k · expert_k(x)."""
+        d, n_exp = 8, 3
+        layer = MoELayer(d, experts=[Expert(d, 16) for _ in range(n_exp)],
+                         gate={"type": "gshard", "top_k": 2},
+                         capacity_factor=float(n_exp))  # cap ≥ all tokens
+        x = paddle.to_tensor(rand(1, 6, d))
+        out = layer(x).numpy().reshape(-1, d)
+
+        tokens = paddle.to_tensor(x.numpy().reshape(-1, d))
+        logits = tokens.numpy() @ layer.gate.gate_weight.numpy()
+        probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), -1))
+        expert_outs = np.stack([layer.experts[e](tokens).numpy() for e in range(n_exp)])
+        ref = np.zeros_like(out)
+        for t in range(out.shape[0]):
+            top2 = np.argsort(-probs[t])[:2]
+            w = probs[t][top2] / probs[t][top2].sum()
+            for wi, e in zip(w, top2):
+                ref[t] += wi * expert_outs[e, t]
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=1e-5)
+
+
+class TestExpertParallelMLP:
+    def test_forward_backward_swiglu(self):
+        layer = ExpertParallelMLP(16, 32, num_experts=4, top_k=2, capacity_factor=4.0)
+        x = paddle.to_tensor(rand(2, 8, 16), stop_gradient=False)
+        out = layer(x)
+        assert out.shape == [2, 8, 16]
+        (out.sum() + layer.l_aux).backward()
+        assert layer.w1.grad is not None and layer.gate_weight.grad is not None
+
+    def test_sharded_matches_replicated(self):
+        """The EP correctness test: same math replicated vs expert-sharded."""
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        layer = ExpertParallelMLP(16, 32, num_experts=8, top_k=2,
+                                  capacity_factor=2.0, expert_axes="expert")
+        x = rand(4, 16, 16)
+        ref = layer(paddle.to_tensor(x)).numpy()
+
+        mesh = Mesh(np.asarray(jax.devices()).reshape(8), ("expert",))
+        params = [layer.gate_weight, layer.w1, layer.w_gate, layer.w2]
+        vals = [p._value for p in params]
+        shardings = [NamedSharding(mesh, P()),
+                     NamedSharding(mesh, P("expert")),
+                     NamedSharding(mesh, P("expert")),
+                     NamedSharding(mesh, P("expert"))]
+
+        def step(t, gw, w1, wg, w2):
+            from paddle_tpu.incubate.distributed.models.moe import _topk_routing
+            cap = _capacity(t.shape[0], 8, 2, 2.0)
+            logits = t @ gw
+            dispatch, combine, _ = _topk_routing(logits, 2, cap)
+            xe = jnp.einsum("nec,nd->ecd", dispatch.astype(t.dtype), t)
+            xe = jax.lax.with_sharding_constraint(xe, NamedSharding(mesh, P("expert")))
+            h = jax.nn.silu(jnp.einsum("ecd,edh->ech", xe, w1)) * \
+                jnp.einsum("ecd,edh->ech", xe, wg)
+            ye = jnp.einsum("ech,ehd->ecd", h, w2)
+            return jnp.einsum("nec,ecd->nd", combine.astype(ye.dtype), ye)
+
+        with mesh:
+            placed = [jax.device_put(v, s) for v, s in zip(vals, shardings)]
+            tokens = jnp.asarray(x.reshape(-1, 16))
+            out = jax.jit(step)(tokens, *placed)
+        np.testing.assert_allclose(np.asarray(out).reshape(ref.shape), ref,
+                                   rtol=1e-4, atol=1e-5)

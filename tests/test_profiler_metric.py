@@ -1,0 +1,138 @@
+"""Profiler + metric tests (reference test strategy: test/legacy_test/
+test_profiler.py, test_metrics.py)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.profiler as profiler
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall, accuracy
+
+
+class TestScheduler:
+    def test_make_scheduler_windows(self):
+        sched = profiler.make_scheduler(closed=1, ready=1, record=2, repeat=2)
+        states = [sched(i) for i in range(9)]
+        S = profiler.ProfilerState
+        assert states[:4] == [S.CLOSED, S.READY, S.RECORD, S.RECORD_AND_RETURN]
+        assert states[4:8] == [S.CLOSED, S.READY, S.RECORD, S.RECORD_AND_RETURN]
+        assert states[8] == S.CLOSED  # repeat budget exhausted
+
+    def test_skip_first(self):
+        sched = profiler.make_scheduler(closed=0, ready=0, record=1, skip_first=3)
+        S = profiler.ProfilerState
+        assert [sched(i) for i in range(4)] == [S.CLOSED] * 3 + [S.RECORD_AND_RETURN]
+
+    def test_bad_args(self):
+        with pytest.raises(ValueError):
+            profiler.make_scheduler(closed=-1, ready=0, record=1)
+
+
+class TestProfiler:
+    def test_record_window_and_chrome_export(self, tmp_path):
+        got = []
+        prof = profiler.Profiler(
+            targets=[profiler.ProfilerTarget.CPU],
+            scheduler=profiler.make_scheduler(closed=1, ready=1, record=2, repeat=1),
+            on_trace_ready=lambda p: got.append(p.step_num))
+        prof.start()
+        for _ in range(6):
+            with profiler.RecordEvent("train_step"):
+                x = paddle.to_tensor(np.ones((4, 4), np.float32))
+                (x @ x).numpy()
+            prof.step()
+        prof.stop()
+        assert got == [3]  # RECORD_AND_RETURN at step 3
+        path = str(tmp_path / "trace.json")
+        prof.export(path)
+        trace = json.load(open(path))
+        names = {e["name"] for e in trace["traceEvents"]}
+        assert "train_step" in names
+        assert any(n.startswith("ProfileStep#") for n in names)
+
+    def test_range_scheduler_and_summary(self, capsys):
+        with profiler.Profiler(targets=[profiler.ProfilerTarget.CPU],
+                               scheduler=(2, 4)) as prof:
+            for _ in range(5):
+                with profiler.RecordEvent("work"):
+                    pass
+                prof.step()
+        table = prof.summary()
+        assert "work" in table and "Calls" in table
+
+    def test_export_chrome_tracing_callback(self, tmp_path):
+        cb = profiler.export_chrome_tracing(str(tmp_path))
+        with profiler.Profiler(targets=[profiler.ProfilerTarget.CPU],
+                               scheduler=profiler.make_scheduler(closed=0, ready=0, record=1, repeat=1),
+                               on_trace_ready=cb) as prof:
+            with profiler.RecordEvent("evt"):
+                pass
+            prof.step()
+        files = [f for f in os.listdir(tmp_path) if f.endswith(".paddle_trace.json")]
+        assert len(files) == 1
+        loaded = profiler.load_profiler_result(str(tmp_path / files[0]))
+        assert "traceEvents" in loaded
+
+    def test_timer_only_step_info(self):
+        prof = profiler.Profiler(timer_only=True)
+        prof.start()
+        for _ in range(3):
+            prof.step(num_samples=8)
+        info = prof.step_info()
+        prof.stop()
+        assert "batch_cost" in info and "ips" in info
+
+    def test_record_event_outside_profiler_is_noop(self):
+        with profiler.RecordEvent("orphan"):
+            pass  # must not raise
+
+
+class TestMetrics:
+    def test_accuracy_topk(self):
+        m = Accuracy(topk=(1, 2))
+        pred = np.array([[0.1, 0.7, 0.2], [0.5, 0.3, 0.2]], np.float32)
+        label = np.array([[1], [2]])
+        correct = m.compute(paddle.to_tensor(pred), paddle.to_tensor(label))
+        m.update(correct)
+        top1, top2 = m.accumulate()
+        assert top1 == pytest.approx(0.5)   # sample0 right, sample1 wrong
+        assert top2 == pytest.approx(0.5)   # label 2 is 3rd for sample1
+        assert m.name() == ["acc_top1", "acc_top2"]
+        m.reset()
+        assert m.accumulate() == [0.0, 0.0]
+
+    def test_accuracy_streaming(self):
+        m = Accuracy()
+        for _ in range(3):
+            pred = np.eye(4, dtype=np.float32)
+            label = np.arange(4).reshape(-1, 1)
+            m.update(m.compute(pred, label))
+        assert m.accumulate() == pytest.approx(1.0)
+
+    def test_precision_recall(self):
+        p, r = Precision(), Recall()
+        preds = np.array([0.9, 0.8, 0.2, 0.6], np.float32)
+        labels = np.array([1, 0, 1, 1], np.float32)
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert p.accumulate() == pytest.approx(2 / 3)  # tp=2 (0.9,0.6), fp=1 (0.8)
+        assert r.accumulate() == pytest.approx(2 / 3)  # fn=1 (0.2)
+
+    def test_auc_perfect_and_random(self):
+        m = Auc()
+        preds = np.stack([1 - np.array([0.9, 0.8, 0.1, 0.2]),
+                          np.array([0.9, 0.8, 0.1, 0.2])], axis=1)
+        labels = np.array([1, 1, 0, 0])
+        m.update(preds, labels)
+        assert m.accumulate() == pytest.approx(1.0)
+        m.reset()
+        assert m.accumulate() == 0.0
+
+    def test_functional_accuracy_in_jit(self):
+        pred = paddle.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]], np.float32))
+        label = paddle.to_tensor(np.array([[1], [1]]))
+        acc = accuracy(pred, label, k=1)
+        assert float(acc.numpy()) == pytest.approx(0.5)
