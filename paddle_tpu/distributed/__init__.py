@@ -11,9 +11,10 @@ from . import meta_parallel  # noqa: F401
 from .auto_parallel import (Partial, Placement, ProcessMesh, Replicate, Shard,  # noqa: F401
                             dtensor_from_fn, get_mesh, reshard, set_mesh, shard_layer,
                             shard_optimizer, shard_tensor)
-from .communication import (ReduceOp, all_gather, all_reduce, all_to_all, barrier,  # noqa: F401
-                            broadcast, get_group, new_group, ppermute, reduce,
-                            reduce_scatter, scatter, scatter_stack, stream, wait)
+from .communication import (P2POp, ReduceOp, all_gather, all_reduce, all_to_all,  # noqa: F401
+                            barrier, batch_isend_irecv, broadcast, get_group, irecv,
+                            isend, new_group, ppermute, recv, reduce, reduce_scatter,
+                            scatter, scatter_stack, send, stream, wait)
 from .engine import DistributedTrainStep, ScannedLayers  # noqa: F401
 from .parallel import (DataParallel, ParallelEnv, get_rank, get_world_size,  # noqa: F401
                        init_parallel_env, is_initialized)

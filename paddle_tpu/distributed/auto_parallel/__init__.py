@@ -175,9 +175,35 @@ def to_partition_spec(placements: Sequence[Placement], mesh: ProcessMesh,
     return P(*spec)
 
 
+def _partial_axes_of(placements: Sequence[Placement], mesh: ProcessMesh) -> dict:
+    """mesh-axis-name → (reduce_type, axis_degree) for every Partial placement.
+    The degree is captured at creation: the pending reduction belongs to the
+    mesh the tensor was sharded on, not to whatever mesh it is later
+    resharded to."""
+    out = {}
+    for axis, pl in zip(mesh.dim_names, placements):
+        if isinstance(pl, Partial):
+            if pl.reduce_type not in ("sum", "avg"):
+                raise NotImplementedError(
+                    f"Partial reduce_type {pl.reduce_type!r} (sum/avg supported)")
+            out[axis] = (pl.reduce_type, mesh.get_dim_size(axis))
+    return out
+
+
 def shard_tensor(data, mesh: ProcessMesh, placements: Sequence[Placement],
                  dtype=None, place=None, stop_gradient: Optional[bool] = None) -> Tensor:
-    """Distribute a tensor over the mesh (reference api.py:126)."""
+    """Distribute a tensor over the mesh (reference api.py:126).
+
+    Partial placements follow the reference's ``r_to_p`` convention
+    (reshard_r_to_p_kernel): ``data`` is the GLOBAL (already-reduced) value;
+    conceptually rank 0 of the partial axis holds it and the others hold the
+    identity element, so the pending sum equals ``data``. In the
+    single-controller global-array view that state is indistinguishable from
+    Replicate by value, so we lay the array out replicated and record the
+    pending axes in ``_partial_axes`` — ``reshard`` consumes them (the psum
+    of [data, 0, ..., 0] is ``data``, making Partial→Replicate an identity
+    and Partial→Shard(d) a slice, exactly the reference's p_to_r / p_to_s
+    observable results)."""
     t = data if isinstance(data, Tensor) else Tensor(np.asarray(data))
     spec = to_partition_spec(placements, mesh, ndim=t.ndim)
     sharding = NamedSharding(mesh.jax_mesh, spec)
@@ -187,10 +213,7 @@ def shard_tensor(data, mesh: ProcessMesh, placements: Sequence[Placement],
     out.persistable = t.persistable
     out.optimize_attr = getattr(t, "optimize_attr", {"learning_rate": 1.0})
     out.need_clip = getattr(t, "need_clip", True)
-    for placement in placements:
-        if isinstance(placement, Partial):
-            # materialize the pending reduction once, eagerly
-            from ..communication import all_reduce  # noqa: F401 (documented semantic)
+    out._partial_axes = _partial_axes_of(placements, mesh)
     return out
 
 
@@ -215,12 +238,28 @@ def dtensor_from_fn(fn: Callable, mesh: ProcessMesh, placements: Sequence[Placem
 
 def reshard(x: Tensor, mesh: ProcessMesh, placements: Sequence[Placement]) -> Tensor:
     """Change an array's distribution (reference api.py:304 → the 8 reshard
-    kernels of N6; here one device_put — XLA emits the collective)."""
+    kernels of N6; here one device_put — XLA emits the collective).
+
+    Pending Partial reductions on the source (``x._partial_axes``, see
+    shard_tensor): resolving an axis to Replicate/Shard applies the pending
+    reduction — a value-identity under the r_to_p convention, except "avg",
+    which divides by the axis degree (psum of [data,0,...]/n on n ranks).
+    Reshard TO Partial re-records pending axes."""
+    src_partial = dict(getattr(x, "_partial_axes", {}) or {})
+    dst_partial = _partial_axes_of(placements, mesh)
+    arr = x._value
+    for axis, (rt, degree) in src_partial.items():
+        if axis in dst_partial:
+            dst_partial[axis] = (rt, degree)  # still pending, on the source degree
+            continue
+        if rt == "avg":
+            arr = arr / degree
     spec = to_partition_spec(placements, mesh, ndim=x.ndim)
     sharding = NamedSharding(mesh.jax_mesh, spec)
-    out = Tensor(jax.device_put(x._value, sharding), stop_gradient=x.stop_gradient,
+    out = Tensor(jax.device_put(arr, sharding), stop_gradient=x.stop_gradient,
                  name=x.name)
     out.persistable = x.persistable
+    out._partial_axes = dst_partial
     return out
 
 

@@ -33,7 +33,8 @@ from .topology import CommGroup, build_mesh, get_hybrid_communicate_group
 
 __all__ = ["ReduceOp", "all_reduce", "all_gather", "reduce_scatter", "all_to_all",
            "broadcast", "reduce", "scatter", "barrier", "new_group", "get_group",
-           "scatter_stack", "ppermute", "wait", "stream"]
+           "scatter_stack", "ppermute", "wait", "stream",
+           "send", "recv", "isend", "irecv", "P2POp", "batch_isend_irecv"]
 
 
 class ReduceOp:
@@ -259,6 +260,158 @@ def ppermute(tensor: Tensor, perm, group: Optional[CommGroup] = None) -> Tensor:
     """Collective permute (the p2p send/recv primitive on TPU: reference's
     send/recv pairs map to ppermute rings over ICI)."""
     return _run("ppermute", tensor, group, extra=tuple(map(tuple, perm)))
+
+
+# ---------------------------------------------------------------------------
+# p2p send/recv (reference: per-primitive modules
+# `python/paddle/distributed/communication/{send,recv,batch_isend_irecv}.py`)
+#
+# TPU-native semantics: a point-to-point transfer IS a collective-permute on
+# the mesh — there is no one-sided message primitive in the XLA programming
+# model. A send(dst)/recv(src) PAIR therefore lowers to one ``ppermute``
+# whose permutation is the ring offset (dst − src) mod n, applied
+# SPMD-symmetrically: every rank r sends its slice to r+offset (exactly the
+# pattern the reference's pipeline p2p helpers issue —
+# `pp_utils/p2p_communication.py:313` send-next/recv-prev rings).
+# Consequently BOTH halves of a pair must be issued by the program (as the
+# reference's fake-cluster tests and pipeline code do); a recv with no
+# matching pending send raises instead of deadlocking.
+# ---------------------------------------------------------------------------
+
+class _P2PTask:
+    """Returned by isend/irecv (reference returns a distributed task)."""
+
+    def __init__(self, result: Optional[Tensor] = None):
+        self._result = result
+
+    def wait(self) -> None:
+        if self._result is not None:
+            self._result._value.block_until_ready()
+
+    def is_completed(self) -> bool:
+        return True
+
+
+# (mesh, axes, ring_offset) → FIFO of pending send tensors. Keyed on the
+# group's mesh+axes, not its id: every HCG-derived group shares id 0, and a
+# group IS its axes for collective purposes.
+_pending_sends: dict = {}
+_MAX_PENDING_SENDS = 64
+
+
+def _p2p_key(g: CommGroup, off: int):
+    return (g.mesh, g.axes, off)
+
+
+def clear_pending_p2p() -> None:
+    """Drop all staged, un-received sends (e.g. after an aborted step)."""
+    _pending_sends.clear()
+
+
+def send(tensor: Tensor, dst: int = 0, group: Optional[CommGroup] = None,
+         sync_op: bool = True) -> _P2PTask:
+    """Stage this group's stacked tensor for a ring transfer to ``dst``.
+    The data moves when the matching ``recv`` is issued (see section note)."""
+    g = _resolve_group(group)
+    off = (dst - g.rank) % g.nranks
+    queue = _pending_sends.setdefault(_p2p_key(g, off), [])
+    if len(queue) >= _MAX_PENDING_SENDS:
+        raise RuntimeError(
+            f"{_MAX_PENDING_SENDS} sends staged without a matching recv on ring "
+            f"offset {off} — likely a leaked send from an aborted step; call "
+            "paddle_tpu.distributed.communication.clear_pending_p2p()")
+    queue.append(tensor)
+    return _P2PTask()
+
+
+def isend(tensor: Tensor, dst: int = 0, group: Optional[CommGroup] = None) -> _P2PTask:
+    return send(tensor, dst, group, sync_op=False)
+
+
+def _ring_transfer(x: Tensor, offset: int, g: CommGroup) -> Tensor:
+    n = g.nranks
+    perm = tuple((r, (r + offset) % n) for r in range(n))
+    return _run("ppermute", x, g, extra=perm)
+
+
+def recv(tensor: Optional[Tensor] = None, src: int = 0,
+         group: Optional[CommGroup] = None, sync_op: bool = True) -> _P2PTask:
+    """Complete the pending ``send`` whose ring offset matches ``src``→here;
+    the result is rebound into ``tensor`` (paddle's in-place recv buffer)."""
+    g = _resolve_group(group)
+    off = (g.rank - src) % g.nranks
+    queue = _pending_sends.get(_p2p_key(g, off))
+    if not queue:
+        raise RuntimeError(
+            f"recv(src={src}): no matching send pending for ring offset {off}. "
+            "paddle_tpu p2p is SPMD-symmetric: issue both send() and recv() in "
+            "the same program (see communication.py p2p section note)")
+    moved = _ring_transfer(queue.pop(0), off, g)
+    if tensor is not None:
+        tensor._rebind(moved)
+        return _P2PTask(tensor)
+    return _P2PTask(moved)
+
+
+def irecv(tensor: Optional[Tensor] = None, src: int = 0,
+          group: Optional[CommGroup] = None) -> _P2PTask:
+    return recv(tensor, src, group, sync_op=False)
+
+
+class P2POp:
+    """One half of a batched p2p exchange (reference batch_isend_irecv.py:25)."""
+
+    def __init__(self, op, tensor: Tensor, peer: int, group: Optional[CommGroup] = None):
+        if op not in (isend, irecv, send, recv):
+            raise ValueError("P2POp op must be paddle_tpu.distributed.isend/irecv")
+        self.op = isend if op in (isend, send) else irecv
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list) -> list:
+    """Fuse a list of P2POps into one ppermute per distinct ring offset
+    (reference batch_isend_irecv.py:90 fuses into one NCCL group call).
+    Recv buffers are rebound in place; returns one task per op."""
+    if not p2p_op_list:
+        return []
+    g = _resolve_group(p2p_op_list[0].group)
+    for op in p2p_op_list[1:]:
+        og = _resolve_group(op.group)
+        if og.mesh is not g.mesh or og.axes != g.axes:
+            raise ValueError("batch_isend_irecv: all ops must share one group "
+                             "(as the reference requires); got axes "
+                             f"{g.axes} vs {og.axes}")
+    n, rank = g.nranks, g.rank
+    sends = {}
+    seen_recv_offs = set()
+    for op in p2p_op_list:
+        if op.op is isend:
+            off = (op.peer - rank) % n
+            if off in sends:
+                raise ValueError(f"duplicate send offset {off} in one batch")
+            sends[off] = op.tensor
+        else:
+            off = (rank - op.peer) % n
+            if off in seen_recv_offs:
+                raise ValueError(f"duplicate recv offset {off} in one batch: two "
+                                 "irecvs would alias one transferred tensor")
+            seen_recv_offs.add(off)
+    results = {off: _ring_transfer(t, off, g) for off, t in sends.items()}
+    tasks = []
+    for op in p2p_op_list:
+        if op.op is isend:
+            tasks.append(_P2PTask())
+        else:
+            off = (rank - op.peer) % n
+            if off not in results:
+                raise RuntimeError(
+                    f"batch_isend_irecv: irecv(peer={op.peer}) has no matching "
+                    f"isend at ring offset {off} in this batch")
+            op.tensor._rebind(results[off])
+            tasks.append(_P2PTask(op.tensor))
+    return tasks
 
 
 def barrier(group: Optional[CommGroup] = None) -> None:

