@@ -179,7 +179,14 @@ def _partial_axes_of(placements: Sequence[Placement], mesh: ProcessMesh) -> dict
     """mesh-axis-name → (reduce_type, axis_degree) for every Partial placement.
     The degree is captured at creation: the pending reduction belongs to the
     mesh the tensor was sharded on, not to whatever mesh it is later
-    resharded to."""
+    resharded to.
+
+    Value convention ("eager-avg"): an avg-Partial's stored global value is
+    ALREADY divided by the axis degree at the transition into the Partial
+    state, so resolving any Partial (sum or avg) to Replicate/Shard is a
+    value identity. This keeps a Partial tensor that flows through ordinary
+    ops (which don't propagate placement metadata) numerically consistent
+    with one resolved first — there is no deferred division to lose."""
     out = {}
     for axis, pl in zip(mesh.dim_names, placements):
         if isinstance(pl, Partial):
@@ -207,13 +214,18 @@ def shard_tensor(data, mesh: ProcessMesh, placements: Sequence[Placement],
     t = data if isinstance(data, Tensor) else Tensor(np.asarray(data))
     spec = to_partition_spec(placements, mesh, ndim=t.ndim)
     sharding = NamedSharding(mesh.jax_mesh, spec)
-    arr = jax.device_put(t._value, sharding)
+    partial_axes = _partial_axes_of(placements, mesh)
+    arr = t._value
+    for rt, degree in partial_axes.values():
+        if rt == "avg":
+            arr = arr / degree  # eager-avg convention (see _partial_axes_of)
+    arr = jax.device_put(arr, sharding)
     out = Tensor(arr, stop_gradient=t.stop_gradient if stop_gradient is None else stop_gradient,
                  name=t.name)
     out.persistable = t.persistable
     out.optimize_attr = getattr(t, "optimize_attr", {"learning_rate": 1.0})
     out.need_clip = getattr(t, "need_clip", True)
-    out._partial_axes = _partial_axes_of(placements, mesh)
+    out._partial_axes = partial_axes
     return out
 
 
@@ -240,20 +252,24 @@ def reshard(x: Tensor, mesh: ProcessMesh, placements: Sequence[Placement]) -> Te
     """Change an array's distribution (reference api.py:304 → the 8 reshard
     kernels of N6; here one device_put — XLA emits the collective).
 
-    Pending Partial reductions on the source (``x._partial_axes``, see
-    shard_tensor): resolving an axis to Replicate/Shard applies the pending
-    reduction — a value-identity under the r_to_p convention, except "avg",
-    which divides by the axis degree (psum of [data,0,...]/n on n ranks).
-    Reshard TO Partial re-records pending axes."""
+    Partial transitions (eager-avg convention, see _partial_axes_of):
+    resolving a pending axis to Replicate/Shard is a value identity; entering
+    an avg-Partial divides by the axis degree; converting a pending sum→avg
+    divides (resolved value sum/n), avg→sum multiplies back."""
     src_partial = dict(getattr(x, "_partial_axes", {}) or {})
     dst_partial = _partial_axes_of(placements, mesh)
     arr = x._value
-    for axis, (rt, degree) in src_partial.items():
-        if axis in dst_partial:
-            dst_partial[axis] = (rt, degree)  # still pending, on the source degree
-            continue
-        if rt == "avg":
-            arr = arr / degree
+    for axis, (dst_rt, dst_deg) in list(dst_partial.items()):
+        src_rt, src_deg = src_partial.get(axis, (None, None))
+        if src_rt is None:
+            if dst_rt == "avg":   # Replicate/Shard → Partial(avg)
+                arr = arr / dst_deg
+        else:
+            dst_partial[axis] = (dst_rt, src_deg)  # pending on the source degree
+            if (src_rt, dst_rt) == ("sum", "avg"):
+                arr = arr / src_deg
+            elif (src_rt, dst_rt) == ("avg", "sum"):
+                arr = arr * src_deg
     spec = to_partition_spec(placements, mesh, ndim=x.ndim)
     sharding = NamedSharding(mesh.jax_mesh, spec)
     out = Tensor(jax.device_put(arr, sharding), stop_gradient=x.stop_gradient,

@@ -320,7 +320,9 @@ def send(tensor: Tensor, dst: int = 0, group: Optional[CommGroup] = None,
             f"{_MAX_PENDING_SENDS} sends staged without a matching recv on ring "
             f"offset {off} — likely a leaked send from an aborted step; call "
             "paddle_tpu.distributed.communication.clear_pending_p2p()")
-    queue.append(tensor)
+    # snapshot the VALUE: mutating the tensor after send must not change
+    # what the matching recv delivers (reference send transmits at call time)
+    queue.append(Tensor(tensor._value, stop_gradient=True))
     return _P2PTask()
 
 
@@ -398,7 +400,15 @@ def batch_isend_irecv(p2p_op_list) -> list:
                 raise ValueError(f"duplicate recv offset {off} in one batch: two "
                                  "irecvs would alias one transferred tensor")
             seen_recv_offs.add(off)
-    results = {off: _ring_transfer(t, off, g) for off, t in sends.items()}
+    # transfer only in-batch-matched sends; stage the rest for a later recv()
+    # (an unbatched send would stage too — data must never be dropped)
+    results = {}
+    for off, t in sends.items():
+        if off in seen_recv_offs:
+            results[off] = _ring_transfer(t, off, g)
+        else:
+            _pending_sends.setdefault(_p2p_key(g, off), []).append(
+                Tensor(t._value, stop_gradient=True))
     tasks = []
     for op in p2p_op_list:
         if op.op is isend:

@@ -50,20 +50,22 @@ def _shard_param(p: Tensor, spec: P, mesh) -> Tensor:
 _U = P.UNCONSTRAINED
 
 
+def _constrain_value(v: jax.Array, spec: P, mesh) -> jax.Array:
+    """Raw-array sharding constraint leaving unmentioned dims UNCONSTRAINED
+    so batch/sequence shardings from the surrounding program survive; falls
+    back to device_put on the eager path."""
+    full = list(spec) + [_U] * (v.ndim - len(spec))
+    try:
+        return jax.lax.with_sharding_constraint(v, NamedSharding(mesh, P(*full)))
+    except (ValueError, TypeError):
+        # eager path: UNCONSTRAINED not allowed in device_put → use None
+        concrete = [None if s is _U else s for s in full]
+        return jax.device_put(v, NamedSharding(mesh, P(*concrete)))
+
+
 def _constrain(t: Tensor, spec: P, mesh) -> Tensor:
-    """Sharding constraint that leaves unmentioned dims UNCONSTRAINED so
-    batch/sequence shardings from the surrounding program survive."""
-
-    def fn(v):
-        full = list(spec) + [_U] * (v.ndim - len(spec))
-        try:
-            return jax.lax.with_sharding_constraint(v, NamedSharding(mesh, P(*full)))
-        except (ValueError, TypeError):
-            # eager path: UNCONSTRAINED not allowed in device_put → use None
-            concrete = [None if s is _U else s for s in full]
-            return jax.device_put(v, NamedSharding(mesh, P(*concrete)))
-
-    return apply_op("sharding_constraint", fn, (t,))
+    return apply_op("sharding_constraint",
+                    lambda v: _constrain_value(v, spec, mesh), (t,))
 
 
 def _last_dim_spec(ndim: int, axis_or_none) -> P:
@@ -197,12 +199,7 @@ class ParallelCrossEntropy(Layer):
             lgf = lg.astype(jnp.float32)
             # constrain the class dim to stay "model"-sharded through the loss
             if "model" in mesh.axis_names:
-                spec = [_U] * (lgf.ndim - 1) + ["model"]
-                try:
-                    lgf = jax.lax.with_sharding_constraint(
-                        lgf, NamedSharding(mesh, P(*spec)))
-                except (ValueError, TypeError):
-                    pass  # eager single-device / no mesh context (as _constrain)
+                lgf = _constrain_value(lgf, _last_dim_spec(lgf.ndim, "model"), mesh)
             # stable logsumexp: max + expsum — each reduces over the shard,
             # then psums (GSPMD)
             mx = jax.lax.stop_gradient(jnp.max(lgf, axis=-1, keepdims=True))

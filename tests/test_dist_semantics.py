@@ -119,10 +119,32 @@ class TestPartialPlacement:
 
     def test_unsupported_reduce_type(self, mesh_22):
         pm = ProcessMesh(mesh_22.mesh)
-        with pytest.raises(NotImplementedError):
-            shard_tensor(np.ones(4, np.float32), pm,
-                         [Partial("max"), Replicate(), Replicate(), Replicate(),
-                          Replicate()])
+        for api in (lambda pl: shard_tensor(np.ones(4, np.float32), pm, pl),
+                    lambda pl: reshard(paddle.to_tensor(np.ones(4, np.float32)), pm, pl)):
+            with pytest.raises(NotImplementedError):
+                api([Partial("max"), Replicate(), Replicate(), Replicate(),
+                     Replicate()])
+
+    def test_partial_avg_consistent_through_ops(self, mesh_22):
+        """Eager-avg convention: flowing through an op (which drops placement
+        metadata) gives the same value as resolving first."""
+        pm = ProcessMesh(mesh_22.mesh)
+        x = np.full((4,), 8.0, np.float32)
+        t = shard_tensor(x, pm, [Partial("avg")] + [Replicate()] * 4)
+        resolved_first = reshard(t, pm, [Replicate()] * 5) * 1.0
+        op_first = t * 1.0  # metadata lost here
+        np.testing.assert_allclose(op_first.numpy(), resolved_first.numpy())
+
+    def test_partial_sum_to_avg_conversion(self, mesh_22):
+        pm = ProcessMesh(mesh_22.mesh)
+        x = np.full((4,), 8.0, np.float32)
+        t = shard_tensor(x, pm, [Partial("sum")] + [Replicate()] * 4)
+        t2 = reshard(t, pm, [Partial("avg")] + [Replicate()] * 4)
+        r = reshard(t2, pm, [Replicate()] * 5)
+        np.testing.assert_allclose(r.numpy(), 4.0)  # sum resolved as avg: /2
+        back = reshard(reshard(t2, pm, [Partial("sum")] + [Replicate()] * 4),
+                       pm, [Replicate()] * 5)
+        np.testing.assert_allclose(back.numpy(), 8.0)
 
 
 class TestP2P:
@@ -161,6 +183,33 @@ class TestP2P:
         buf = comm.scatter_stack(paddle.to_tensor(np.zeros((2, 1), "float32")), g)
         with pytest.raises(RuntimeError, match="no matching isend"):
             comm.batch_isend_irecv([comm.P2POp(comm.irecv, buf, peer=1, group=g)])
+
+    def test_send_snapshots_value(self, mesh_22):
+        """Mutating the tensor after send must not affect what recv gets."""
+        g = mesh_22.get_data_parallel_group()
+        x = comm.scatter_stack(paddle.to_tensor(np.array([[7.0], [5.0]], "float32")), g)
+        buf = comm.scatter_stack(paddle.to_tensor(np.zeros((2, 1), "float32")), g)
+        comm.send(x, dst=g.rank + 1, group=g)
+        x._rebind(paddle.to_tensor(np.zeros((2, 1), "float32")))
+        comm.recv(buf, src=(g.rank - 1) % g.nranks, group=g)
+        np.testing.assert_allclose(buf.numpy().ravel(), [5.0, 7.0])
+
+    def test_batch_unmatched_isend_stages_for_later_recv(self, mesh_22):
+        g = mesh_22.get_data_parallel_group()
+        x = comm.scatter_stack(paddle.to_tensor(np.array([[1.0], [2.0]], "float32")), g)
+        comm.batch_isend_irecv([comm.P2POp(comm.isend, x, peer=g.rank + 1, group=g)])
+        buf = comm.scatter_stack(paddle.to_tensor(np.zeros((2, 1), "float32")), g)
+        comm.recv(buf, src=(g.rank - 1) % g.nranks, group=g)  # completes the staged send
+        np.testing.assert_allclose(buf.numpy().ravel(), [2.0, 1.0])
+
+    def test_group_mismatch_in_batch_raises(self, mesh_22):
+        g1 = mesh_22.get_data_parallel_group()
+        g2 = mesh_22.get_model_parallel_group()
+        x = comm.scatter_stack(paddle.to_tensor(np.zeros((2, 1), "float32")), g1)
+        y = comm.scatter_stack(paddle.to_tensor(np.zeros((2, 1), "float32")), g2)
+        with pytest.raises(ValueError, match="share one group"):
+            comm.batch_isend_irecv([comm.P2POp(comm.isend, x, 1, g1),
+                                    comm.P2POp(comm.irecv, y, 1, g2)])
 
 
 class TestGroupShardedDrivesEngine:

@@ -1,0 +1,160 @@
+"""OpTest-grade numerics sweep over the hottest ops (reference
+`test/legacy_test/op_test.py:420` check_output / `:2973` check_grad; SURVEY
+§7 hard-part #6). Each entry: forward vs trusted numpy reference at
+fp32+bf16, analytic-vs-numeric grad at fp32, bf16 grad vs fp32 anchor."""
+
+import numpy as np
+import pytest
+from scipy.special import erf as sp_erf
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_test import check_op
+
+
+def rand(*shape, lo=-1.0, hi=1.0, seed=0):
+    rng = np.random.default_rng(seed + sum(shape))
+    return (lo + (hi - lo) * rng.random(shape)).astype(np.float32)
+
+
+def pos(*shape, seed=0):
+    return rand(*shape, lo=0.3, hi=2.0, seed=seed)
+
+
+def away_from_zero(*shape, seed=0):
+    x = rand(*shape, seed=seed)
+    return (np.sign(x) * (np.abs(x) + 0.2)).astype(np.float32)
+
+
+def np_softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def np_gelu(x):
+    return 0.5 * x * (1.0 + sp_erf(x / np.sqrt(2.0)))
+
+
+def np_layer_norm(x, w, b, eps=1e-5):
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    return (x - mu) / np.sqrt(var + eps) * w + b
+
+
+def np_rms_norm(x, w, eps=1e-6):
+    ms = np.mean(np.square(x), -1, keepdims=True)
+    return x / np.sqrt(ms + eps) * w
+
+
+def np_sdpa(q, k, v):
+    d = q.shape[-1]
+    logits = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    p = np_softmax(logits, -1)
+    return np.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def np_conv2d(x, w):
+    n, cin, h, wd = x.shape
+    cout, _, kh, kw = w.shape
+    out = np.zeros((n, cout, h - kh + 1, wd - kw + 1), np.float32)
+    for i in range(out.shape[2]):
+        for j in range(out.shape[3]):
+            patch = x[:, :, i:i + kh, j:j + kw]
+            out[:, :, i, j] = np.tensordot(patch, w, axes=([1, 2, 3], [1, 2, 3]))
+    return out
+
+
+def np_cross_entropy(logits, label):
+    ls = logits - logits.max(-1, keepdims=True)
+    lse = np.log(np.exp(ls).sum(-1)) - ls[np.arange(len(label)), label]
+    return lse.mean()
+
+
+# (name, op, trusted_ref, inputs, kwargs-for-check_op)
+OP_TABLE = [
+    # elementwise
+    ("tanh", lambda x: paddle.tanh(x), np.tanh, [rand(4, 8)], {}),
+    ("sigmoid", lambda x: F.sigmoid(x), lambda x: 1 / (1 + np.exp(-x)), [rand(4, 8)], {}),
+    ("exp", lambda x: paddle.exp(x), np.exp, [rand(4, 8)], {}),
+    ("log", lambda x: paddle.log(x), np.log, [pos(4, 8)], {}),
+    ("sqrt", lambda x: paddle.sqrt(x), np.sqrt, [pos(4, 8)], {}),
+    ("rsqrt", lambda x: paddle.rsqrt(x), lambda x: 1 / np.sqrt(x), [pos(4, 8)], {}),
+    ("erf", lambda x: paddle.erf(x), sp_erf, [rand(4, 8)], {}),
+    ("square", lambda x: paddle.square(x), np.square, [rand(4, 8)], {}),
+    ("pow3", lambda x: paddle.pow(x, 3), lambda x: x ** 3, [rand(4, 8)], {}),
+    ("abs", lambda x: paddle.abs(x), np.abs, [away_from_zero(4, 8)], {}),
+    ("add", lambda a, b: a + b, np.add, [rand(4, 8), rand(4, 8, seed=1)], {}),
+    ("mul", lambda a, b: a * b, np.multiply, [rand(4, 8), rand(4, 8, seed=1)], {}),
+    ("div", lambda a, b: a / b, np.divide, [rand(4, 8), pos(4, 8, seed=1)], {}),
+    ("maximum", lambda a, b: paddle.maximum(a, b), np.maximum,
+     [rand(4, 8), rand(4, 8, seed=9)], {}),
+    # activations
+    ("relu", lambda x: F.relu(x), lambda x: np.maximum(x, 0), [away_from_zero(4, 8)], {}),
+    ("gelu", lambda x: F.gelu(x), np_gelu, [rand(4, 8)], {}),
+    ("silu", lambda x: F.silu(x), lambda x: x / (1 + np.exp(-x)), [rand(4, 8)], {}),
+    ("softmax", lambda x: F.softmax(x), np_softmax, [rand(4, 8)], {}),
+    ("log_softmax", lambda x: F.log_softmax(x), lambda x: np.log(np_softmax(x)),
+     [rand(4, 8)], {}),
+    ("swiglu", lambda x: F.swiglu(x),
+     lambda x: (lambda a, b: a / (1 + np.exp(-a)) * b)(x[..., :4], x[..., 4:]),
+     [rand(3, 8)], {}),
+    # reductions
+    ("sum", lambda x: paddle.sum(x, axis=-1), lambda x: x.sum(-1), [rand(4, 8)], {}),
+    ("mean", lambda x: paddle.mean(x, axis=0), lambda x: x.mean(0), [rand(4, 8)], {}),
+    ("logsumexp", lambda x: paddle.logsumexp(x, axis=-1),
+     lambda x: np.log(np.exp(x).sum(-1)), [rand(4, 8)], {}),
+    ("max", lambda x: paddle.max(x, axis=-1), lambda x: x.max(-1),
+     [rand(4, 8)], {"grad": False}),  # subgradient at ties: forward only
+    # linalg / manipulation
+    ("matmul", lambda a, b: paddle.matmul(a, b), np.matmul,
+     [rand(4, 6), rand(6, 5, seed=1)], {}),
+    ("linear", lambda x, w, b: F.linear(x, w, b),
+     lambda x, w, b: x @ w + b, [rand(3, 6), rand(6, 4, seed=1), rand(4, seed=2)], {}),
+    ("transpose", lambda x: paddle.transpose(x, [1, 0]), lambda x: x.T, [rand(4, 6)], {}),
+    ("reshape", lambda x: paddle.reshape(x, [8, 4]), lambda x: x.reshape(8, 4),
+     [rand(4, 8)], {}),
+    ("concat", lambda a, b: paddle.concat([a, b], axis=1),
+     lambda a, b: np.concatenate([a, b], 1), [rand(4, 3), rand(4, 5, seed=1)], {}),
+    ("slice", lambda x: x[1:3, 2:6], lambda x: x[1:3, 2:6], [rand(4, 8)], {}),
+    # nn ops
+    ("layer_norm", lambda x, w, b: F.layer_norm(x, [8], weight=w, bias=b),
+     np_layer_norm, [rand(4, 8), pos(8, seed=1), rand(8, seed=2)], {}),
+    ("rms_norm", lambda x, w: F.rms_norm(x, w), np_rms_norm,
+     [rand(4, 8), pos(8, seed=1)], {}),
+    ("embedding", lambda idx, w: F.embedding(idx, w), lambda idx, w: w[idx],
+     [np.array([0, 2, 3, 1]), rand(5, 6)], {}),
+    ("mse_loss", lambda a, b: F.mse_loss(a, b), lambda a, b: np.mean((a - b) ** 2),
+     [rand(4, 8), rand(4, 8, seed=1)], {}),
+    ("softmax_ce", lambda lg, lb: F.cross_entropy(lg, lb), np_cross_entropy,
+     [rand(6, 10), np.array([0, 3, 9, 1, 4, 7])], {"numeric_eps": 5e-3}),
+    ("sdpa", lambda q, k, v: F.scaled_dot_product_attention(q, k, v), np_sdpa,
+     [rand(1, 4, 2, 8), rand(1, 4, 2, 8, seed=1), rand(1, 4, 2, 8, seed=2)],
+     {"numeric_eps": 5e-3}),
+    ("conv2d", lambda x, w: F.conv2d(x, w), np_conv2d,
+     [rand(1, 2, 5, 5), rand(3, 2, 3, 3, seed=1)], {"numeric_eps": 5e-3}),
+]
+
+
+@pytest.mark.parametrize("name,op,ref,inputs,kw",
+                         OP_TABLE, ids=[t[0] for t in OP_TABLE])
+def test_op_numerics(name, op, ref, inputs, kw):
+    check_op(name, op, ref, inputs, **kw)
+
+
+class TestHarnessSelfChecks:
+    def test_catches_wrong_forward(self):
+        with pytest.raises(AssertionError, match="forward mismatch"):
+            check_op("bad_fwd", lambda x: paddle.tanh(x), np.sinh, [rand(3, 3)])
+
+    def test_catches_wrong_grad(self):
+        # op whose forward is fine vs ref but produces a wrong-by-construction
+        # gradient: detach inside cuts the true path
+        def bad(x):
+            return paddle.tanh(x.detach()) + x * 0.0
+
+        with pytest.raises(AssertionError, match="grad mismatch|no grad"):
+            check_op("bad_grad", bad, np.tanh, [rand(3, 3)])
+
+    def test_int_inputs_skip_grad(self):
+        check_op("embedding_nograd", lambda i, w: F.embedding(i, w),
+                 lambda i, w: w[i], [np.array([1, 0]), rand(3, 4)])
