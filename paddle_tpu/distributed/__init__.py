@@ -15,7 +15,8 @@ from .communication import (P2POp, ReduceOp, all_gather, all_reduce, all_to_all,
                             barrier, batch_isend_irecv, broadcast, get_group, irecv,
                             isend, new_group, ppermute, recv, reduce, reduce_scatter,
                             scatter, scatter_stack, send, stream, wait)
-from .engine import DistributedTrainStep, ScannedLayers  # noqa: F401
+from .engine import (DistributedTrainStep, GPipeLayers, ScannedLayers,  # noqa: F401
+                     gpipe_spmd_step)
 from .parallel import (DataParallel, ParallelEnv, get_rank, get_world_size,  # noqa: F401
                        init_parallel_env, is_initialized)
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401

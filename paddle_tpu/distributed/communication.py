@@ -416,9 +416,14 @@ def batch_isend_irecv(p2p_op_list) -> list:
         else:
             off = (rank - op.peer) % n
             if off not in results:
-                raise RuntimeError(
-                    f"batch_isend_irecv: irecv(peer={op.peer}) has no matching "
-                    f"isend at ring offset {off} in this batch")
+                # fall back to a send staged earlier by unbatched send()
+                queue = _pending_sends.get(_p2p_key(g, off))
+                if not queue:
+                    raise RuntimeError(
+                        f"batch_isend_irecv: irecv(peer={op.peer}) has no "
+                        f"matching isend at ring offset {off} (in this batch "
+                        "or staged earlier)")
+                results[off] = _ring_transfer(queue.pop(0), off, g)
             op.tensor._rebind(results[off])
             tasks.append(_P2PTask(op.tensor))
     return tasks

@@ -39,7 +39,7 @@ from ..nn.layer.layers import Layer
 from ..tensor.tensor import Tensor
 from .topology import HybridCommunicateGroup
 
-__all__ = ["DistributedTrainStep", "ScannedLayers"]
+__all__ = ["DistributedTrainStep", "ScannedLayers", "GPipeLayers", "gpipe_spmd_step"]
 
 
 def _current_spec(arr, mesh: Mesh) -> List:
@@ -216,7 +216,8 @@ class ScannedLayers(Layer):
                 out = self._template(Tensor(carry), *extra)
             return (out._value if isinstance(out, Tensor) else out), None
 
-        xv = x._value if isinstance(x, Tensor) else x
+        if not isinstance(x, Tensor):
+            x = Tensor(jnp.asarray(x))
         from ..tensor.tensor import apply_op
 
         def fn(xv_, *stacks):
@@ -227,3 +228,107 @@ class ScannedLayers(Layer):
 
     def __len__(self):
         return self._n
+
+
+class GPipeLayers(ScannedLayers):
+    """Compiled GPipe: the L stacked layers are sharded over the "pipe" mesh
+    axis and executed as a micro-batched software pipeline in ONE XLA
+    program — shard_map over "pipe" with ppermute activation rotation
+    (match: reference host 1F1B `meta_parallel/pipeline_parallel.py:440`;
+    here the schedule is compiled, the scaling-book recipe).
+
+    Semantics: x's leading (batch) dim is cut into ``num_microbatches``;
+    micro-batch ``i`` enters stage 0 at tick ``i``, results leave stage
+    P−1 at tick ``i+P−1``; each stage runs its local L/P layer slice with an
+    inner scan. The whole schedule is a ``lax.scan`` over M+P−1 ticks, so
+    autodiff produces the reverse pipeline (GPipe all-forward/all-backward;
+    activation stash is the scan's residuals — apply jax.checkpoint to the
+    block for the recompute variant). Other mesh axes (data/model/...)
+    stay GSPMD-automatic inside the stage, so TP×PP×DP compose."""
+
+    def __init__(self, layers: Sequence[Layer], mesh: Mesh,
+                 num_microbatches: int, pipe_axis: str = "pipe"):
+        if len(layers) % max(1, mesh.shape[pipe_axis]) != 0:
+            raise ValueError(f"{len(layers)} layers not divisible by pipe degree "
+                             f"{mesh.shape[pipe_axis]}")
+        super().__init__(layers, mesh, pipe_axis)
+        self._mesh = mesh
+        self._pipe_axis = pipe_axis
+        self.num_microbatches = int(num_microbatches)
+
+    def forward(self, x):
+        mesh, axis = self._mesh, self._pipe_axis
+        n_stages = mesh.shape[axis]
+        m = self.num_microbatches
+        if n_stages == 1:
+            return super().forward(x)
+        template_params = [dict(self._template.named_parameters())[n]
+                           for n in self._stack_names]
+        stacked = [self._parameters[n.replace(".", "__")] for n in self._stack_names]
+        template = self._template
+
+        if not isinstance(x, Tensor):
+            x = Tensor(jnp.asarray(x))
+        xv = x._value
+        if xv.shape[0] % m != 0:
+            raise ValueError(f"batch {xv.shape[0]} not divisible by "
+                             f"num_microbatches {m}")
+
+        def stage_fn(local_stacks, h):
+            # inner scan over this stage's L/P layer slice
+            def body(c, slices):
+                with _StateSwap(template_params, list(slices)):
+                    out = template(Tensor(c))
+                return (out._value if isinstance(out, Tensor) else out), None
+
+            h, _ = jax.lax.scan(body, h, tuple(local_stacks))
+            return h
+
+        def sharded_body(xv_, *stacks):
+            stage = jax.lax.axis_index(axis)
+            mb = xv_.shape[0] // m
+            xs = xv_.reshape((m, mb) + xv_.shape[1:])
+            # initial carries become pipe-varying inside the loop:
+            # declare them so (scan requires carry VMA types to be invariant)
+            state0 = jax.lax.pcast(jnp.zeros((mb,) + xv_.shape[1:], xv_.dtype),
+                                   (axis,), to="varying")
+            ys0 = jax.lax.pcast(jnp.zeros_like(xs), (axis,), to="varying")
+            perm = [(s, (s + 1) % n_stages) for s in range(n_stages)]
+
+            def tick(carry, i):
+                state, ys = carry
+                inp = jax.lax.dynamic_index_in_dim(
+                    xs, jnp.clip(i, 0, m - 1), 0, keepdims=False)
+                state = jnp.where(stage == 0, inp, state)
+                out = stage_fn(stacks, state)
+                j = i - (n_stages - 1)
+                upd = jax.lax.dynamic_update_index_in_dim(
+                    ys, out, jnp.clip(j, 0, m - 1), 0)
+                write = jnp.logical_and(stage == n_stages - 1, j >= 0)
+                ys = jnp.where(write, upd, ys)
+                state = jax.lax.ppermute(out, axis, perm)
+                return (state, ys), None
+
+            (_, ys), _ = jax.lax.scan(tick, (state0, ys0),
+                                      jnp.arange(m + n_stages - 1))
+            # results live on the last stage; make them pipe-replicated
+            ys = jax.lax.psum(
+                jnp.where(stage == n_stages - 1, ys, jnp.zeros_like(ys)), axis)
+            return ys.reshape(xv_.shape)
+
+        pipeline = jax.shard_map(
+            sharded_body, mesh=mesh, axis_names={axis},
+            in_specs=tuple([P()] + [P(axis)] * len(stacked)),
+            out_specs=P(), check_vma=True)
+
+        from ..tensor.tensor import apply_op
+
+        return apply_op("gpipe_pipeline", pipeline, tuple([x] + stacked))
+
+
+def gpipe_spmd_step(layers: Sequence[Layer], mesh: Mesh, num_microbatches: int,
+                    pipe_axis: str = "pipe") -> GPipeLayers:
+    """Build the compiled-GPipe module (the engine promised by
+    `meta_parallel/pipeline_parallel.py`); returns a Layer whose forward is
+    the whole micro-batched pipeline as one XLA program."""
+    return GPipeLayers(layers, mesh, num_microbatches, pipe_axis)

@@ -2,7 +2,8 @@
 
 from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,  # noqa: F401
                         RowParallelLinear, VocabParallelEmbedding)
-from .pipeline_parallel import PipelineParallel  # noqa: F401
+from .pipeline_parallel import (PipelineParallel,  # noqa: F401
+                                PipelineParallelWithInterleave)
 from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
 from .sequence_parallel import (AllGatherOp, ColumnSequenceParallelLinear, GatherOp,  # noqa: F401
                                 ReduceScatterOp, RowSequenceParallelLinear, ScatterOp,

@@ -30,7 +30,7 @@ from ...tensor.manipulation import split
 from ...tensor.tensor import Tensor
 from .pp_layers import PipelineLayer
 
-__all__ = ["PipelineParallel"]
+__all__ = ["PipelineParallel", "PipelineParallelWithInterleave"]
 
 
 class PipelineParallel:
@@ -142,3 +142,131 @@ def _eval_batch(self, data, compute_loss=True):
 
 
 PipelineParallel.eval_batch = _eval_batch
+
+
+class PipelineParallelWithInterleave(PipelineParallel):
+    """Interleaved (virtual-pipeline) 1F1B — reference
+    `meta_parallel/pipeline_parallel.py:906` PipelineParallelWithInterleave /
+    Megatron's `forward_backward_pipelining_with_interleaving`.
+
+    Each pipe stage holds ``v = num_virtual_pipeline_stages`` NON-contiguous
+    model chunks (chunk c of stage s = segment c·P+s); a micro-step advances
+    one micro-batch through ONE chunk, and the schedule interleaves chunks to
+    shrink the warmup bubble from (P−1) to (P−1)/v full-forwards.
+
+    Host engine: micro-steps execute real chunk computation (per-microbatch
+    activations carried between chunk-forwards); a micro-batch's backward
+    runs through the eager tape at its final backward micro-step, so losses
+    and gradients are bit-identical to sequential execution while the
+    forward compute follows the interleaved order. The compiled path is
+    `engine.GPipeLayers`."""
+
+    def __init__(self, layers: PipelineLayer, hcg=None, strategy=None,
+                 accumulate_steps: Optional[int] = None):
+        super().__init__(layers, hcg, strategy, accumulate_steps)
+        self.num_model_chunks = layers._num_virtual_pipeline_stages
+        if self.num_model_chunks < 2:
+            raise ValueError(
+                "PipelineParallelWithInterleave requires a PipelineLayer built "
+                "with num_virtual_pipeline_stages >= 2")
+        if self.accumulate_steps % self.num_stages != 0:
+            raise ValueError("interleaved 1F1B requires accumulate_steps to be "
+                             "a multiple of the pipe degree (as the reference)")
+
+    # -- schedule bookkeeping (reference :957 _get_virtual_pp_rank) --------
+    def _virtual_chunk(self, micro_step: int, forward: bool) -> int:
+        pos = micro_step % (self.num_stages * self.num_model_chunks)
+        chunk = pos // self.num_stages
+        return chunk if forward else self.num_model_chunks - 1 - chunk
+
+    def _micro_batch_id(self, micro_step: int) -> int:
+        group = micro_step // (self.num_stages * self.num_model_chunks)
+        return group * self.num_stages + micro_step % self.num_stages
+
+    def _num_warmup(self, stage_id: int) -> int:
+        p, v, m = self.num_stages, self.num_model_chunks, self.accumulate_steps
+        total = m * v
+        if m == p:
+            return total  # degenerate: all-forward then all-backward
+        return min((p - stage_id - 1) * 2 + (v - 1) * p, total)
+
+    def interleave_scheduler(self, stage_id: int) -> str:
+        """Event stream "f{chunk}_{mb};…;b{chunk}_{mb};…" for one stage —
+        the interleaved analogue of ``static_scheduler`` (reference :447)."""
+        total = self.accumulate_steps * self.num_model_chunks
+        warmup = self._num_warmup(stage_id)
+        events: List[str] = []
+        fwd_k = bwd_k = 0
+        for _ in range(warmup):
+            events.append(f"f{self._virtual_chunk(fwd_k, True)}_"
+                          f"{self._micro_batch_id(fwd_k)}")
+            fwd_k += 1
+        for _ in range(total - warmup):
+            events.append(f"f{self._virtual_chunk(fwd_k, True)}_"
+                          f"{self._micro_batch_id(fwd_k)}")
+            fwd_k += 1
+            events.append(f"b{self._virtual_chunk(bwd_k, False)}_"
+                          f"{self._micro_batch_id(bwd_k)}")
+            bwd_k += 1
+        while bwd_k < total:
+            events.append(f"b{self._virtual_chunk(bwd_k, False)}_"
+                          f"{self._micro_batch_id(bwd_k)}")
+            bwd_k += 1
+        return ";".join(events) + ";"
+
+    # -- execution ---------------------------------------------------------
+    def _run_1f1b(self, x, y, scaler=None) -> Tensor:
+        acc = self.accumulate_steps
+        p, v = self.num_stages, self.num_model_chunks
+        micro_x = split(x, acc, axis=0)
+        micro_y = split(y, acc, axis=0)
+        total = acc * v
+        warmup = self._num_warmup(0)
+
+        acts: dict = {}      # mb -> activation after its last completed chunk
+        done_fwd = [0] * acc  # chunks completed per microbatch
+        losses: List[Optional[Tensor]] = [None] * acc
+        done_bwd = [0] * acc
+
+        def fwd_step(k):
+            mb = self._micro_batch_id(k)
+            chunk = done_fwd[mb]
+            h = acts.get(mb, micro_x[mb])
+            for s in range(p):  # chunk c spans segments c·P+s for each stage s
+                h = self.pipeline.chunk_forward(s, chunk, h)
+            done_fwd[mb] += 1
+            if done_fwd[mb] == v:
+                out = h
+                loss = self._loss_fn(out, micro_y[mb]) if self._loss_fn else out
+                losses[mb] = loss[0] if isinstance(loss, tuple) else loss
+                acts.pop(mb, None)
+            else:
+                acts[mb] = h
+
+        def bwd_step(k):
+            mb = self._micro_batch_id(k)
+            done_bwd[mb] += 1
+            if done_bwd[mb] == v:  # final chunk-backward → real tape backward
+                scaled = losses[mb] * (1.0 / acc)
+                if scaler is not None:
+                    scaled = scaler.scale(scaled)
+                scaled.backward()
+
+        fwd_k = bwd_k = 0
+        for _ in range(warmup):
+            fwd_step(fwd_k)
+            fwd_k += 1
+        for _ in range(total - warmup):
+            fwd_step(fwd_k)
+            fwd_k += 1
+            bwd_step(bwd_k)
+            bwd_k += 1
+        while bwd_k < total:
+            bwd_step(bwd_k)
+            bwd_k += 1
+
+        with no_grad():
+            tot = losses[0].detach()
+            for l in losses[1:]:
+                tot = tot + l.detach()
+            return tot * (1.0 / acc)

@@ -77,6 +77,7 @@ class PipelineLayer(Layer):
             hcg = get_hybrid_communicate_group()
             num_stages = hcg.get_pipe_parallel_world_size() if hcg else 1
         self._num_stages = num_stages
+        self._num_virtual_pipeline_stages = num_virtual_pipeline_stages or 1
         self._loss_fn = loss_fn
         self._seg_method = seg_method
         self._shared: Dict[str, Layer] = {}
@@ -105,8 +106,13 @@ class PipelineLayer(Layer):
         self._segment()
 
     def _segment(self) -> None:
+        """Cut into ``num_stages × num_virtual_pipeline_stages`` segments.
+        With VPP (reference pp_layers.py `_num_virtual_pipeline_stages > 1`),
+        stage ``s`` owns the NON-contiguous segments ``s, s+P, s+2P, …`` —
+        chunk ``c`` of stage ``s`` is segment ``c·P + s`` (Megatron layout,
+        exposed via :meth:`get_chunk_layers`)."""
         n = len(self.run_function)
-        stages = self._num_stages
+        stages = self._num_stages * self._num_virtual_pipeline_stages
         if self._seg_method.startswith("layer:"):
             pattern = self._seg_method.split("layer:", 1)[1]
             idxs = [i for i, name in enumerate(self._desc_names) if re.search(pattern, name)]
@@ -127,8 +133,24 @@ class PipelineLayer(Layer):
         self.segment_parts = bounds
 
     def get_stage_layers(self, stage_id: int) -> List[Layer]:
+        if self._num_virtual_pipeline_stages > 1:
+            raise RuntimeError(
+                "with num_virtual_pipeline_stages > 1 a stage's layers are "
+                "non-contiguous chunks: use get_chunk_layers(stage, chunk) / "
+                "chunk_forward (PipelineParallelWithInterleave drives these)")
         lo, hi = self.segment_parts[stage_id], self.segment_parts[stage_id + 1]
         return list(self.run_function)[lo:hi]
+
+    def get_chunk_layers(self, stage_id: int, chunk_id: int) -> List[Layer]:
+        """Virtual chunk ``chunk_id`` of ``stage_id`` = segment c·P + s."""
+        seg = chunk_id * self._num_stages + stage_id
+        lo, hi = self.segment_parts[seg], self.segment_parts[seg + 1]
+        return list(self.run_function)[lo:hi]
+
+    def chunk_forward(self, stage_id: int, chunk_id: int, x):
+        for layer in self.get_chunk_layers(stage_id, chunk_id):
+            x = layer(x) if not isinstance(x, tuple) else layer(*x)
+        return x
 
     def stage_forward(self, stage_id: int, x):
         for layer in self.get_stage_layers(stage_id):

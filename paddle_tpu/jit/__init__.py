@@ -33,7 +33,8 @@ from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
 from ..nn.layer.layers import Layer
 from ..tensor.tensor import Tensor
 
-__all__ = ["to_static", "TrainStep", "not_to_static", "ignore_module", "save", "load"]
+__all__ = ["to_static", "TrainStep", "not_to_static", "ignore_module", "save",
+           "load", "InputSpec", "TranslatedLayer"]
 
 
 def _is_tensor(x) -> bool:
@@ -339,15 +340,146 @@ class TrainStep:
         return Tensor(loss)
 
 
+class InputSpec:
+    """Shape/dtype signature of one model input (reference
+    `python/paddle/static/input.py` InputSpec). ``None``/``-1`` dims are
+    DYNAMIC: the exported program is shape-polymorphic in them
+    (jax.export symbolic dimensions)."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(None if s is None or int(s) == -1 else int(s)
+                           for s in shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype!r}, name={self.name!r})"
+
+
+def _specs_to_sds(specs):
+    """[InputSpec | Tensor | ShapeDtypeStruct] → ShapeDtypeStructs, with
+    dynamic InputSpec dims lowered to jax.export symbolic dimensions (one
+    shared scope: the same symbol is NOT reused, each dynamic dim varies
+    independently)."""
+    from jax import export as jax_export
+    from ..framework import dtype as _dtype_mod
+
+    out = []
+    scope = jax_export.SymbolicScope()
+    counter = [0]
+
+    def dyn():
+        counter[0] += 1
+        return jax_export.symbolic_shape(f"d{counter[0]}", scope=scope)[0]
+
+    for spec in specs:
+        if isinstance(spec, InputSpec):
+            shape = tuple(dyn() if s is None else s for s in spec.shape)
+            out.append(jax.ShapeDtypeStruct(
+                shape, _dtype_mod.canonical_dtype(spec.dtype)))
+        elif isinstance(spec, Tensor):
+            out.append(jax.ShapeDtypeStruct(tuple(spec.shape), spec._value.dtype))
+        elif isinstance(spec, jax.ShapeDtypeStruct):
+            out.append(spec)
+        else:
+            arr = jnp.asarray(spec)
+            out.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
+    return out
+
+
 def save(layer, path: str, input_spec=None, **configs) -> None:
-    """jit.save: persists state_dict + (if possible) StableHLO of forward.
-    Full predictor-grade export lands with the serving milestone."""
+    """jit.save (reference `python/paddle/jit/api.py` save): persist
+
+    - ``{path}.pdiparams`` — the state_dict (always), and
+    - ``{path}.pdmodel`` — a serialized StableHLO program of the inference
+      forward with parameters frozen in (requires ``input_spec``; the
+      reference likewise needs specs or prior example inputs to concretize
+      the graph). The artifact is loadable WITHOUT the python model class —
+      `jit.load` runs it directly, the predictor-export contract.
+    """
     from ..framework.io import save as _save
 
-    _save(layer.state_dict() if isinstance(layer, Layer) else layer, path + ".pdiparams")
+    target = layer._fn if isinstance(layer, StaticFunction) else layer
+    base_layer = layer._layer if isinstance(layer, StaticFunction) else \
+        (layer if isinstance(layer, Layer) else None)
+    if base_layer is not None:
+        _save(base_layer.state_dict(), path + ".pdiparams")
+    elif not callable(target):
+        _save(target, path + ".pdiparams")
+        return
+
+    if input_spec is None:
+        if base_layer is None:
+            raise ValueError(
+                "jit.save of a plain function requires input_spec — there are "
+                "no parameters to persist and no signature to trace a graph from")
+        return  # params-only save; no graph without an input signature
+
+    from jax import export as jax_export
+
+    sds = _specs_to_sds(input_spec)
+    fwd = base_layer.forward if base_layer is not None else target
+    params, buffers = ([], [])
+    if base_layer is not None:
+        params = [p for _, p in base_layer.named_parameters()]
+        buffers = [b for _, b in base_layer.named_buffers()]
+    p_arrays = [p._value for p in params]
+    b_arrays = [b._value for b in buffers]
+    was_training = base_layer.training if base_layer is not None else False
+    if base_layer is not None:
+        base_layer.eval()
+    try:
+        def pure(*in_arrays):
+            with _StateSwap(params, p_arrays), _StateSwap(buffers, b_arrays), \
+                    key_scope(jax.random.PRNGKey(0)), no_grad():
+                out = fwd(*[Tensor(a) for a in in_arrays])
+            leaves, _ = jax.tree_util.tree_flatten(out, is_leaf=_is_tensor)
+            return tuple(l._value if isinstance(l, Tensor) else l for l in leaves)
+
+        exported = jax_export.export(jax.jit(pure))(*sds)
+        with open(path + ".pdmodel", "wb") as f:
+            f.write(exported.serialize())
+    finally:
+        if base_layer is not None and was_training:
+            base_layer.train()
+
+
+class TranslatedLayer(Layer):
+    """A loaded ``.pdmodel`` StableHLO program, callable like the original
+    layer (reference `translated_layer.py` TranslatedLayer). Parameters are
+    frozen inside the program; ``state_dict`` exposes the sidecar params."""
+
+    def __init__(self, exported, params: Optional[dict] = None):
+        super().__init__()
+        self._exported = exported
+        self._params_dict = params or {}
+        self.training = False
+
+    def forward(self, *args):
+        arrays = [a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+        out = self._exported.call(*arrays)
+        outs = tuple(Tensor(o) for o in out)
+        return outs[0] if len(outs) == 1 else outs
+
+    def state_dict(self, *a, **k):
+        return dict(self._params_dict)
 
 
 def load(path: str, **configs):
+    """jit.load: a ``.pdmodel`` becomes a runnable TranslatedLayer; with only
+    ``.pdiparams`` present, returns the state_dict (params-only artifact)."""
+    import os
+
     from ..framework.io import load as _load
 
-    return _load(path + ".pdiparams")
+    params = _load(path + ".pdiparams") if os.path.exists(path + ".pdiparams") else None
+    if os.path.exists(path + ".pdmodel"):
+        from jax import export as jax_export
+
+        with open(path + ".pdmodel", "rb") as f:
+            exported = jax_export.deserialize(f.read())
+        return TranslatedLayer(exported, params)
+    if params is None:
+        raise FileNotFoundError(
+            f"jit.load: neither {path}.pdmodel nor {path}.pdiparams exists")
+    return params

@@ -186,3 +186,77 @@ class TestJit:
         sched.step()
         step(x)  # different lr — same compiled fn (lr is a traced arg)
         assert o._step_count == 2
+
+
+class TestJitSaveLoad:
+    """jit.save → StableHLO export + TranslatedLayer load (reference
+    python/paddle/jit/api.py save/load)."""
+
+    def test_stablehlo_roundtrip(self, tmp_path):
+        import os
+
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        x = np.random.default_rng(0).standard_normal((2, 8)).astype(np.float32)
+        ref = net(paddle.to_tensor(x)).numpy()
+        path = str(tmp_path / "model")
+        paddle.jit.save(net, path, input_spec=[paddle.jit.InputSpec([2, 8], "float32")])
+        assert os.path.exists(path + ".pdmodel") and os.path.exists(path + ".pdiparams")
+        loaded = paddle.jit.load(path)
+        np.testing.assert_allclose(loaded(paddle.to_tensor(x)).numpy(), ref, rtol=1e-6)
+        assert set(loaded.state_dict()) == set(net.state_dict())
+
+    def test_export_freezes_params(self, tmp_path):
+        """Mutating the source net after save must not change the artifact."""
+        net = nn.Linear(4, 4)
+        x = np.ones((1, 4), np.float32)
+        ref = net(paddle.to_tensor(x)).numpy()
+        path = str(tmp_path / "frozen")
+        paddle.jit.save(net, path, input_spec=[paddle.jit.InputSpec([1, 4])])
+        net.weight.set_value(np.zeros((4, 4), np.float32))
+        loaded = paddle.jit.load(path)
+        np.testing.assert_allclose(loaded(paddle.to_tensor(x)).numpy(), ref, rtol=1e-6)
+
+    def test_save_restores_training_flag_and_dropout_off(self, tmp_path):
+        net = nn.Sequential(nn.Linear(8, 8), nn.Dropout(0.9))
+        net.train()
+        path = str(tmp_path / "dp")
+        paddle.jit.save(net, path, input_spec=[paddle.jit.InputSpec([4, 8])])
+        assert net.training  # restored
+        loaded = paddle.jit.load(path)
+        x = np.random.default_rng(1).standard_normal((4, 8)).astype(np.float32)
+        # exported graph is the eval graph: dropout is identity → deterministic
+        a = loaded(paddle.to_tensor(x)).numpy()
+        b = loaded(paddle.to_tensor(x)).numpy()
+        np.testing.assert_array_equal(a, b)
+        assert np.abs(a).sum() > 0
+
+    def test_params_only_save(self, tmp_path):
+        import os
+
+        net = nn.Linear(4, 2)
+        path = str(tmp_path / "ponly")
+        paddle.jit.save(net, path)  # no input_spec → params only
+        assert os.path.exists(path + ".pdiparams")
+        assert not os.path.exists(path + ".pdmodel")
+        sd = paddle.jit.load(path)
+        assert set(sd) == set(net.state_dict())
+
+    def test_dynamic_batch_export(self, tmp_path):
+        """InputSpec None/-1 dims → shape-polymorphic StableHLO."""
+        net = nn.Linear(8, 4)
+        path = str(tmp_path / "dyn")
+        paddle.jit.save(net, path, input_spec=[paddle.jit.InputSpec([-1, 8])])
+        loaded = paddle.jit.load(path)
+        for bs in (1, 5, 16):
+            x = np.random.default_rng(bs).standard_normal((bs, 8)).astype(np.float32)
+            np.testing.assert_allclose(loaded(paddle.to_tensor(x)).numpy(),
+                                       net(paddle.to_tensor(x)).numpy(),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_load_missing_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            paddle.jit.load(str(tmp_path / "nope"))
+
+    def test_save_plain_fn_without_spec_raises(self, tmp_path):
+        with pytest.raises(ValueError, match="requires input_spec"):
+            paddle.jit.save(lambda x: x, str(tmp_path / "fn"))

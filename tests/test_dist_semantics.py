@@ -242,3 +242,13 @@ class TestGroupShardedDrivesEngine:
             model, lambda m, x, t: F.mse_loss(m(x), t), opt, mesh_22,
             sharding_stage=0)
         assert step.sharding_stage == 0
+
+    def test_unbatched_send_batched_recv(self, mesh_22):
+        """Mixed pairing: send() staged earlier completes a batched irecv."""
+        g = mesh_22.get_data_parallel_group()
+        x = comm.scatter_stack(paddle.to_tensor(np.array([[4.0], [6.0]], "float32")), g)
+        buf = comm.scatter_stack(paddle.to_tensor(np.zeros((2, 1), "float32")), g)
+        comm.send(x, dst=g.rank + 1, group=g)
+        comm.batch_isend_irecv([comm.P2POp(comm.irecv, buf,
+                                           peer=(g.rank - 1) % g.nranks, group=g)])
+        np.testing.assert_allclose(buf.numpy().ravel(), [6.0, 4.0])

@@ -1,0 +1,215 @@
+"""Compiled-GPipe (shard_map over "pipe") tests — VERDICT round-1 item 5.
+
+Parity target: the reference's 1F1B pipeline runtime
+(`fleet/meta_parallel/pipeline_parallel.py:440`); here the schedule is
+compiled (GPipeLayers, engine.py) and must match plain sequential execution
+exactly — forward, backward, and multi-step training loss."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed.topology import build_mesh
+
+
+def make_blocks(n, width, seed=0):
+    rng = np.random.default_rng(seed)
+    blocks = []
+    for _ in range(n):
+        blk = nn.Sequential(nn.Linear(width, width), nn.Tanh())
+        blk[0].weight.set_value(rng.standard_normal((width, width)).astype(np.float32) * 0.3)
+        blk[0].bias.set_value(rng.standard_normal((width,)).astype(np.float32) * 0.1)
+        blocks.append(blk)
+    return blocks
+
+
+@pytest.fixture(scope="module")
+def pipe_mesh():
+    return build_mesh(dp=1, pp=2, sharding=1, sep=1, mp=1,
+                      devices=jax.devices()[:2])
+
+
+class TestGPipeLayers:
+    def test_forward_matches_sequential(self, pipe_mesh):
+        blocks = make_blocks(4, 16)
+        ref_blocks = make_blocks(4, 16)  # same seed → same weights
+        gp = dist.GPipeLayers(blocks, pipe_mesh, num_microbatches=4)
+        x = np.random.default_rng(1).standard_normal((8, 16)).astype(np.float32)
+        out = gp(paddle.to_tensor(x))
+        h = paddle.to_tensor(x)
+        for b in ref_blocks:
+            h = b(h)
+        np.testing.assert_allclose(out.numpy(), h.numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_backward_matches_sequential(self, pipe_mesh):
+        blocks = make_blocks(4, 16)
+        ref_blocks = make_blocks(4, 16)
+        gp = dist.GPipeLayers(blocks, pipe_mesh, num_microbatches=2)
+        x = np.random.default_rng(2).standard_normal((4, 16)).astype(np.float32)
+
+        out = gp(paddle.to_tensor(x, stop_gradient=False))
+        (out * out).mean().backward()
+
+        h = paddle.to_tensor(x, stop_gradient=False)
+        for b in ref_blocks:
+            h = b(h)
+        (h * h).mean().backward()
+
+        for name in gp._stack_names:
+            stacked_grad = gp._parameters[name.replace(".", "__")].grad.numpy()
+            per_block = np.stack([dict(b.named_parameters())[name].grad.numpy()
+                                  for b in ref_blocks])
+            np.testing.assert_allclose(stacked_grad, per_block, rtol=1e-4,
+                                       atol=1e-5, err_msg=name)
+
+    def test_training_loss_parity_vs_single_device(self, pipe_mesh):
+        """The VERDICT done-criterion: pp=2 training curve == sequential."""
+        tgt = np.random.default_rng(3).standard_normal((8, 16)).astype(np.float32)
+        x = np.random.default_rng(4).standard_normal((8, 16)).astype(np.float32)
+
+        gp = dist.GPipeLayers(make_blocks(4, 16), pipe_mesh, num_microbatches=4)
+        opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=gp.parameters())
+        pp_losses = []
+        for _ in range(5):
+            loss = F.mse_loss(gp(paddle.to_tensor(x)), paddle.to_tensor(tgt))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            pp_losses.append(float(loss.numpy()))
+
+        blocks = make_blocks(4, 16)
+        params = [p for b in blocks for p in b.parameters()]
+        opt2 = paddle.optimizer.SGD(learning_rate=0.05, parameters=params)
+        seq_losses = []
+        for _ in range(5):
+            h = paddle.to_tensor(x)
+            for b in blocks:
+                h = b(h)
+            loss = F.mse_loss(h, paddle.to_tensor(tgt))
+            loss.backward()
+            opt2.step()
+            opt2.clear_grad()
+            seq_losses.append(float(loss.numpy()))
+
+        np.testing.assert_allclose(pp_losses, seq_losses, rtol=1e-4)
+        assert pp_losses[-1] < pp_losses[0]
+
+    def test_more_layers_than_stages(self, pipe_mesh):
+        """6 layers over pp=2 → 3 layers per stage via the inner scan."""
+        blocks = make_blocks(6, 16, seed=7)
+        ref_blocks = make_blocks(6, 16, seed=7)
+        gp = dist.GPipeLayers(blocks, pipe_mesh, num_microbatches=2)
+        x = np.random.default_rng(5).standard_normal((4, 16)).astype(np.float32)
+        out = gp(paddle.to_tensor(x))
+        h = paddle.to_tensor(x)
+        for b in ref_blocks:
+            h = b(h)
+        np.testing.assert_allclose(out.numpy(), h.numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_errors(self, pipe_mesh):
+        with pytest.raises(ValueError, match="not divisible by pipe degree"):
+            dist.GPipeLayers(make_blocks(3, 16), pipe_mesh, num_microbatches=2)
+        gp = dist.GPipeLayers(make_blocks(2, 16), pipe_mesh, num_microbatches=3)
+        with pytest.raises(ValueError, match="not divisible by"):
+            gp(paddle.to_tensor(np.zeros((4, 16), np.float32)))
+
+    def test_gpipe_spmd_step_builder(self, pipe_mesh):
+        gp = dist.gpipe_spmd_step(make_blocks(2, 8), pipe_mesh, num_microbatches=2)
+        assert isinstance(gp, dist.GPipeLayers)
+        out = gp(paddle.to_tensor(np.ones((4, 8), np.float32)))
+        assert out.shape == [4, 8]
+
+
+class TestInterleavedVPP:
+    """PipelineParallelWithInterleave (reference pipeline_parallel.py:906)."""
+
+    def _build(self, acc=4, p=2, v=2, width=8, n_layers=8):
+        from paddle_tpu.distributed.meta_parallel import (
+            PipelineParallelWithInterleave)
+        from paddle_tpu.distributed.meta_parallel.pp_layers import PipelineLayer
+
+        layers = [nn.Linear(width, width) for _ in range(n_layers)]
+        pl = PipelineLayer(layers, num_stages=p, num_virtual_pipeline_stages=v,
+                           loss_fn=lambda out, y: F.mse_loss(out, y))
+        return PipelineParallelWithInterleave(pl, accumulate_steps=acc), layers
+
+    def test_chunk_segmentation(self):
+        from paddle_tpu.distributed.meta_parallel.pp_layers import PipelineLayer
+
+        layers = [nn.Linear(4, 4) for _ in range(8)]
+        pl = PipelineLayer(layers, num_stages=2, num_virtual_pipeline_stages=2)
+        # 8 layers / (2 stages × 2 chunks) = 2 layers per segment;
+        # chunk c of stage s = segment c*2+s
+        assert pl.get_chunk_layers(0, 0) == layers[0:2]   # segment 0
+        assert pl.get_chunk_layers(1, 0) == layers[2:4]   # segment 1
+        assert pl.get_chunk_layers(0, 1) == layers[4:6]   # segment 2
+        assert pl.get_chunk_layers(1, 1) == layers[6:8]   # segment 3
+        with pytest.raises(RuntimeError, match="non-contiguous"):
+            pl.get_stage_layers(0)
+
+    def test_interleave_schedule_stage0(self):
+        vpp, _ = self._build(acc=4, p=2, v=2)
+        sched = vpp.interleave_scheduler(0).split(";")[:-1]
+        # warmup = min((2-1)*2 + 1*2, 8) = 4 forward micro-steps, interleaving
+        # chunks: mb0c0, mb1c0, mb0c1, mb1c1; then 1F1B; backwards start at
+        # the LAST chunk (b1)
+        assert sched[:4] == ["f0_0", "f0_1", "f1_0", "f1_1"]
+        assert sched[4] == "f0_2" and sched[5] == "b1_0"
+        # totals: 8 forwards + 8 backwards
+        assert sum(e.startswith("f") for e in sched) == 8
+        assert sum(e.startswith("b") for e in sched) == 8
+
+    def test_warmup_shrinks_with_chunks(self):
+        """The point of VPP: stage-0 warmup (P-1)*2+(v-1)*P micro-steps of
+        1/v-size chunks < (P-1) full forwards... verify formula behavior."""
+        vpp, _ = self._build(acc=8, p=2, v=2)
+        assert vpp._num_warmup(0) == 4
+        assert vpp._num_warmup(1) == 2  # last stage warms up less
+
+    def test_training_parity_vs_sequential(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((8, 8)).astype(np.float32)
+        y = rng.standard_normal((8, 8)).astype(np.float32)
+        vpp, layers = self._build(acc=4, p=2, v=2)
+        loss = vpp.forward_backward_pipeline(paddle.to_tensor(x), paddle.to_tensor(y))
+
+        # sequential reference with identical weights
+        import copy
+        ref_layers = [nn.Linear(8, 8) for _ in range(8)]
+        for rl, l in zip(ref_layers, layers):
+            rl.weight.set_value(l.weight.numpy())
+            rl.bias.set_value(l.bias.numpy())
+        micro = np.split(x, 4)
+        micro_y = np.split(y, 4)
+        ref_losses = []
+        for mx, my in zip(micro, micro_y):
+            h = paddle.to_tensor(mx)
+            for l in ref_layers:
+                h = l(h)
+            ml = F.mse_loss(h, paddle.to_tensor(my))
+            (ml * 0.25).backward()
+            ref_losses.append(float(ml.numpy()))
+        np.testing.assert_allclose(float(loss.numpy()), np.mean(ref_losses),
+                                   rtol=1e-5)
+        for l, rl in zip(layers, ref_layers):
+            np.testing.assert_allclose(l.weight.grad.numpy(),
+                                       rl.weight.grad.numpy(), rtol=1e-4,
+                                       atol=1e-6)
+
+    def test_rejects_bad_config(self):
+        from paddle_tpu.distributed.meta_parallel import (
+            PipelineParallelWithInterleave)
+        from paddle_tpu.distributed.meta_parallel.pp_layers import PipelineLayer
+
+        pl = PipelineLayer([nn.Linear(4, 4) for _ in range(4)], num_stages=2)
+        with pytest.raises(ValueError, match="num_virtual_pipeline_stages"):
+            PipelineParallelWithInterleave(pl)
+        pl2 = PipelineLayer([nn.Linear(4, 4) for _ in range(8)], num_stages=2,
+                            num_virtual_pipeline_stages=2)
+        with pytest.raises(ValueError, match="multiple of the pipe degree"):
+            PipelineParallelWithInterleave(pl2, accumulate_steps=3)
