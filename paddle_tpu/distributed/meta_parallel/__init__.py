@@ -9,3 +9,4 @@ from .sequence_parallel import (AllGatherOp, ColumnSequenceParallelLinear, Gathe
                                 ReduceScatterOp, RowSequenceParallelLinear, ScatterOp,
                                 mark_as_sequence_parallel_parameter,
                                 register_sequence_parallel_allreduce_hooks)
+from .context_parallel import ring_attention, ulysses_attention  # noqa: F401

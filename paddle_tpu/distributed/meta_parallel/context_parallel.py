@@ -1,0 +1,177 @@
+"""Context (long-sequence) parallelism: ring attention + Ulysses.
+
+SURVEY §5.7 assigns the long-context story to the TPU build; the reference's
+closest machinery is Megatron-SP (`fleet/utils/sequence_parallel_utils.py`)
+plus its sep-parallel groups (`hybrid_communicate_group.get_sep_parallel_*`).
+Two complementary schemes over the "sep" mesh axis:
+
+- :func:`ulysses_attention` — DeepSpeed-Ulysses: attention needs the FULL
+  sequence per head, so swap which dim is sharded (seq → heads) with an
+  all-to-all, run ordinary (flash) attention on full sequences for the local
+  head subset, swap back. Expressed GSPMD-style: two sharding constraints;
+  XLA emits the all-to-alls over ICI. Head count must be divisible by the
+  sep degree.
+
+- :func:`ring_attention` — blockwise attention with the KV chunks rotating
+  around the sep ring (ppermute) and flash-style online-softmax
+  accumulation, so NO device ever holds the full sequence — the O(s) memory
+  per device becomes O(s/N): the scheme that scales context past HBM.
+  Causality is handled per block pair (self block = tril, blocks from the
+  future fully masked, blocks from the past unmasked). Backward is autodiff
+  through the scan: the reverse program rotates cotangents the opposite way
+  around the ring.
+
+Both operate on GLOBAL arrays [b, s, h, d] (paddle flash-attn layout) and
+are jit/eager callable; under a mesh whose "sep" axis shards the sequence
+dim, each step stays shard-local + collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...tensor.tensor import Tensor, apply_op
+from ..topology import get_hybrid_communicate_group
+
+__all__ = ["ring_attention", "ulysses_attention"]
+
+
+def _resolve_mesh(mesh: Optional[Mesh]) -> Mesh:
+    if mesh is not None:
+        return mesh
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        raise RuntimeError("context parallelism needs a mesh: pass mesh= or "
+                           "initialize fleet/hybrid groups first")
+    return hcg.mesh
+
+
+# ---------------------------------------------------------------------------
+# Ulysses
+# ---------------------------------------------------------------------------
+
+def ulysses_attention(q, k, v, mesh: Optional[Mesh] = None, sep_axis: str = "sep",
+                      is_causal: bool = False, scale: Optional[float] = None):
+    """[b, s, h, d] attention with seq sharded over ``sep_axis``: all-to-all
+    to head-sharded, full-sequence SDPA, all-to-all back (DeepSpeed-Ulysses;
+    the A2A pair is emitted by GSPMD from the two constraints)."""
+    mesh = _resolve_mesh(mesh)
+    n = mesh.shape[sep_axis]
+    q = q if isinstance(q, Tensor) else Tensor(q)
+    k = k if isinstance(k, Tensor) else Tensor(k)
+    v = v if isinstance(v, Tensor) else Tensor(v)
+    if q.shape[2] % n != 0:
+        raise ValueError(f"Ulysses needs heads ({q.shape[2]}) divisible by "
+                         f"sep degree ({n})")
+
+    seq_spec = P(None, sep_axis, None, None)
+    head_spec = P(None, None, sep_axis, None)
+
+    def fn(qv, kv, vv):
+        from ...ops.attention import sdpa_reference
+
+        def cons(x, spec):
+            try:
+                return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+            except (ValueError, TypeError):
+                return x  # eager single-device
+
+        # seq-sharded → head-sharded (A2A), attend over full seq, swap back
+        qh, kh, vh = (cons(x, head_spec) for x in (qv, kv, vv))
+        out = sdpa_reference(qh, kh, vh, is_causal=is_causal, scale=scale)
+        return cons(out, seq_spec)
+
+    return apply_op("ulysses_attention", fn, (q, k, v))
+
+
+# ---------------------------------------------------------------------------
+# ring attention
+# ---------------------------------------------------------------------------
+
+def ring_attention(q, k, v, mesh: Optional[Mesh] = None, sep_axis: str = "sep",
+                   causal: bool = False, scale: Optional[float] = None):
+    """Blockwise ring attention over the ``sep_axis`` ring (module docstring).
+
+    q/k/v: [b, s, h, d] global arrays, s divisible by the sep degree;
+    kv heads must equal q heads (use Ulysses or TP for GQA splits)."""
+    mesh = _resolve_mesh(mesh)
+    n = mesh.shape[sep_axis]
+    q = q if isinstance(q, Tensor) else Tensor(q)
+    k = k if isinstance(k, Tensor) else Tensor(k)
+    v = v if isinstance(v, Tensor) else Tensor(v)
+    b, s, h, d = q.shape
+    if k.shape[2] != h:
+        raise ValueError("ring_attention requires matching q/kv head counts")
+    if s % n != 0:
+        raise ValueError(f"sequence {s} not divisible by sep degree {n}")
+    sc = scale if scale is not None else 1.0 / float(d) ** 0.5
+    perm = [(r, (r + 1) % n) for r in range(n)]
+
+    def block_body(qc, kc, vc):
+        """One ring member: local chunks [b, c, h, d]."""
+        idx = jax.lax.axis_index(sep_axis)
+        c = qc.shape[1]
+        qf = qc.astype(jnp.float32) * sc
+
+        # accumulator carries become sep-varying inside the scan: declare so
+        acc0 = jax.lax.pcast(jnp.zeros(qc.shape, jnp.float32), (sep_axis,),
+                             to="varying")
+        m0 = jax.lax.pcast(jnp.full((b, h, c), -jnp.inf, jnp.float32),
+                           (sep_axis,), to="varying")
+        l0 = jax.lax.pcast(jnp.zeros((b, h, c), jnp.float32), (sep_axis,),
+                           to="varying")
+        # positions within a chunk (for the diagonal block's causal tril)
+        qpos = jnp.arange(c)
+
+        def step(carry, i):
+            acc, m_, l_, k_cur, v_cur = carry
+            # k_cur currently holds the chunk originally at ring position
+            # (idx - i) mod n
+            src = (idx - i) % n
+            logits = jnp.einsum("bqhd,bkhd->bhqk", qf, k_cur.astype(jnp.float32))
+            if causal:
+                # future block → all masked; self block → tril; past → open
+                block_rel = src - idx          # >0 ⇒ future, 0 ⇒ self, <0 ⇒ past
+                tril = qpos[:, None] >= qpos[None, :]
+                open_mask = jnp.where(block_rel > 0,
+                                      jnp.zeros((c, c), bool),
+                                      jnp.where(block_rel == 0, tril,
+                                                jnp.ones((c, c), bool)))
+                logits = jnp.where(open_mask[None, None], logits, -jnp.inf)
+            blk_max = jnp.max(logits, axis=-1)                    # [b, h, c]
+            new_m = jnp.maximum(m_, blk_max)
+            # rows with no finite entry yet keep m=-inf: make exp args 0 there
+            safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
+            p = jnp.exp(jnp.where(jnp.isneginf(logits), -jnp.inf,
+                                  logits - safe_m[..., None]))
+            corr = jnp.where(jnp.isneginf(m_), 0.0, jnp.exp(m_ - safe_m))
+            l_new = l_ * corr + jnp.sum(p, axis=-1)
+            pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32))
+            acc_new = acc * jnp.transpose(corr, (0, 2, 1))[..., None] + pv
+            k_next = jax.lax.ppermute(k_cur, sep_axis, perm)
+            v_next = jax.lax.ppermute(v_cur, sep_axis, perm)
+            return (acc_new, new_m, l_new, k_next, v_next), None
+
+        (acc, m_, l_, _, _), _ = jax.lax.scan(
+            step, (acc0, m0, l0, kc, vc), jnp.arange(n))
+        out = acc / jnp.transpose(jnp.maximum(l_, 1e-30), (0, 2, 1))[..., None]
+        return out.astype(qc.dtype)
+
+    if n == 1:
+        from ...ops.attention import sdpa_reference
+
+        return apply_op("ring_attention",
+                        lambda qv, kv, vv: sdpa_reference(qv, kv, vv,
+                                                          is_causal=causal,
+                                                          scale=scale),
+                        (q, k, v))
+
+    spec = P(None, sep_axis, None, None)
+    ring = jax.shard_map(block_body, mesh=mesh, axis_names={sep_axis},
+                         in_specs=(spec, spec, spec), out_specs=spec,
+                         check_vma=True)
+    return apply_op("ring_attention", ring, (q, k, v))

@@ -1,0 +1,8 @@
+"""High-level training API (reference `python/paddle/hapi/`): ``Model`` +
+``callbacks``. Exposed at top level as ``paddle.Model`` /
+``paddle.callbacks`` like the reference."""
+
+from . import callbacks  # noqa: F401
+from .model import Model  # noqa: F401
+
+__all__ = ["Model", "callbacks"]

@@ -1,0 +1,307 @@
+"""High-level ``paddle.Model`` API (reference `python/paddle/hapi/model.py`:
+Model:1538 with prepare:1674, fit, evaluate, predict, train_batch:1194,
+save:1356/load:1423).
+
+The reference keeps separate dygraph/static adapters; here there is one
+eager path (with the whole step optionally jit-compiled by the underlying
+layers) — the TPU build's static mode IS jit."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..metric import Metric
+from ..nn.layer.layers import Layer
+from ..tensor.tensor import Tensor
+from . import callbacks as cbks_mod
+
+__all__ = ["Model"]
+
+
+def _to_tensor_list(data) -> List[Tensor]:
+    if data is None:
+        return []
+    if isinstance(data, (list, tuple)):
+        return [d if isinstance(d, Tensor) else Tensor(np.asarray(d)) for d in data]
+    return [data if isinstance(data, Tensor) else Tensor(np.asarray(data))]
+
+
+class Model:
+    """Train/eval/predict loop wrapper around a Layer.
+
+    ``inputs``/``labels``: optional InputSpec lists; when omitted, a data
+    batch ``(x0, …, xn, y)`` is split with the LAST element as the label
+    (single-label convention; pass specs for other arities)."""
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs = list(inputs) if inputs is not None else None
+        self._labels = list(labels) if labels is not None else None
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+        self.mode = "train"
+
+    # -- setup -------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        if loss is not None and not (callable(loss) or isinstance(loss, Layer)):
+            raise TypeError("loss must be a callable or a loss Layer")
+        self._optimizer = optimizer
+        self._loss = loss
+        metrics = metrics or []
+        metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
+        for m in metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metrics must be paddle.metric.Metric, got {type(m)}")
+        self._metrics = list(metrics)
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    # -- batch-level -------------------------------------------------------
+    def _split_batch(self, data):
+        data = list(data) if isinstance(data, (list, tuple)) else [data]
+        if self._inputs is not None:
+            n_in = len(self._inputs)
+            return data[:n_in], data[n_in:]
+        if len(data) == 1:
+            return data, []
+        return data[:-1], data[-1:]
+
+    def train_batch(self, inputs, labels=None, update: bool = True):
+        self.network.train()
+        ins = _to_tensor_list(inputs)
+        lbs = _to_tensor_list(labels)
+        outputs = self.network(*ins)
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        if self._loss is not None:
+            loss = self._loss(*outs, *lbs)
+        else:
+            loss = outs[0]
+        # grad accumulation averages over the window (reference hapi scales
+        # the loss before backward)
+        accum = getattr(self, "_accumulate", 1)
+        (loss * (1.0 / accum) if accum > 1 else loss).backward()
+        if update and self._optimizer is not None:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = [float(np.ravel(loss.numpy())[0])]
+        for m in self._metrics:
+            m.update(*_as_np(m.compute(*outs, *lbs)))
+        return metrics if len(metrics) > 1 else metrics[0]
+
+    def eval_batch(self, inputs, labels=None):
+        from ..autograd import no_grad
+
+        self.network.eval()
+        with no_grad():
+            ins = _to_tensor_list(inputs)
+            lbs = _to_tensor_list(labels)
+            outputs = self.network(*ins)
+            outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+            loss_val = None
+            if self._loss is not None and lbs:
+                loss_val = float(np.ravel(self._loss(*outs, *lbs).numpy())[0])
+            for m in self._metrics:
+                m.update(*_as_np(m.compute(*outs, *lbs)))
+        return loss_val
+
+    def predict_batch(self, inputs):
+        from ..autograd import no_grad
+
+        self.network.eval()
+        with no_grad():
+            outputs = self.network(*_to_tensor_list(inputs))
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        return [o.numpy() for o in outs]
+
+    # -- loop-level --------------------------------------------------------
+    def _loader(self, data, batch_size, shuffle, drop_last, num_workers):
+        from ..io import DataLoader, Dataset
+
+        if data is None:
+            return None
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              drop_last=drop_last, num_workers=num_workers)
+        return data  # any iterable of batches
+
+    def fit(self, train_data=None, eval_data=None, batch_size: int = 1,
+            epochs: int = 1, eval_freq: int = 1, log_freq: int = 10,
+            save_dir: Optional[str] = None, save_freq: int = 1, verbose: int = 2,
+            drop_last: bool = False, shuffle: bool = True, num_workers: int = 0,
+            callbacks=None, accumulate_grad_batches: int = 1, num_iters=None):
+        if self._optimizer is None or self._loss is None:
+            raise RuntimeError("call prepare(optimizer, loss, ...) before fit()")
+        loader = self._loader(train_data, batch_size, shuffle, drop_last, num_workers)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks = cbks_mod.config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=steps, log_freq=log_freq,
+            verbose=verbose, save_freq=save_freq, save_dir=save_dir,
+            metrics=[n for m in self._metrics for n in _names(m)])
+        self.stop_training = False
+        self._accumulate = accumulate_grad_batches
+        logs = {}
+        cbks.on_train_begin({})
+        try:
+            for epoch in range(epochs):
+                if self.stop_training:
+                    break
+                cbks.on_epoch_begin(epoch, {})
+                for m in self._metrics:
+                    m.reset()
+                pending_grads = False
+                for step, batch in enumerate(loader):
+                    if num_iters is not None and step >= num_iters:
+                        break
+                    cbks.on_train_batch_begin(step, {})
+                    ins, lbs = self._split_batch(batch)
+                    update = (step + 1) % accumulate_grad_batches == 0
+                    loss = self.train_batch(ins, lbs, update=update)
+                    pending_grads = not update
+                    logs = {"loss": loss}
+                    for m in self._metrics:
+                        logs[_names(m)[0]] = m.accumulate()
+                    cbks.on_train_batch_end(step, logs)
+                    if self.stop_training:
+                        break
+                if pending_grads:  # flush the trailing partial window
+                    self._optimizer.step()
+                    self._optimizer.clear_grad()
+                cbks.on_epoch_end(epoch, logs)
+                if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                    eval_logs = self.evaluate(eval_data, batch_size=batch_size,
+                                              verbose=0, num_workers=num_workers)
+                    cbks.on_eval_end(eval_logs)
+        finally:
+            self._accumulate = 1
+        cbks.on_train_end(logs)
+        return self
+
+    def evaluate(self, eval_data, batch_size: int = 1, log_freq: int = 10,
+                 verbose: int = 2, num_workers: int = 0, callbacks=None,
+                 num_iters=None) -> dict:
+        loader = self._loader(eval_data, batch_size, False, False, num_workers)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        # verbose printing is handled below; callbacks get the hooks only
+        cbks = cbks_mod.config_callbacks(
+            callbacks, model=self, steps=steps, log_freq=log_freq,
+            verbose=0, mode="eval")
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        cbks.on_eval_begin({})
+        for step, batch in enumerate(loader):
+            if num_iters is not None and step >= num_iters:
+                break
+            cbks.on_eval_batch_begin(step, {})
+            ins, lbs = self._split_batch(batch)
+            lv = self.eval_batch(ins, lbs)
+            if lv is not None:
+                losses.append(lv)
+            cbks.on_eval_batch_end(step, {"loss": lv} if lv is not None else {})
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            logs[_names(m)[0]] = m.accumulate()
+        cbks.on_eval_end(logs)
+        if verbose:
+            print("Eval - " + " - ".join(f"{k}: {v}" for k, v in logs.items()))
+        return logs
+
+    def predict(self, test_data, batch_size: int = 1, num_workers: int = 0,
+                stack_outputs: bool = False, verbose: int = 1, callbacks=None,
+                num_iters=None) -> list:
+        loader = self._loader(test_data, batch_size, False, False, num_workers)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks = cbks_mod.config_callbacks(
+            callbacks, model=self, steps=steps, verbose=0, mode="predict")
+        outputs: List[List[np.ndarray]] = []
+        cbks.on_predict_begin({})
+        for step, batch in enumerate(loader):
+            if num_iters is not None and step >= num_iters:
+                break
+            cbks.on_predict_batch_begin(step, {})
+            ins, _ = self._split_batch(batch)
+            outputs.append(self.predict_batch(ins))
+            cbks.on_predict_batch_end(step, {})
+        n_out = len(outputs[0]) if outputs else 0
+        grouped = [[b[i] for b in outputs] for i in range(n_out)]
+        if stack_outputs:
+            grouped = [np.concatenate(g, axis=0) for g in grouped]
+        cbks.on_predict_end({})
+        return grouped
+
+    # -- persistence -------------------------------------------------------
+    def save(self, path: str, training: bool = True) -> None:
+        """training=True → params (+ optimizer state) checkpoint;
+        training=False → inference export via jit.save (needs ``inputs``
+        specs for the StableHLO program)."""
+        from ..framework.io import save as _save
+
+        if training:
+            _save(self.network.state_dict(), path + ".pdparams")
+            if self._optimizer is not None and hasattr(self._optimizer, "state_dict"):
+                _save(self._optimizer.state_dict(), path + ".pdopt")
+        else:
+            from ..jit import save as jit_save
+
+            if self._inputs is None:
+                raise ValueError(
+                    "Model.save(training=False) exports an inference program "
+                    "and needs input shapes: construct the Model with "
+                    "inputs=[InputSpec(...)] (as the reference requires)")
+            jit_save(self.network, path, input_spec=self._inputs)
+
+    def load(self, path: str, skip_mismatch: bool = False,
+             reset_optimizer: bool = False):
+        import os
+
+        from ..framework.io import load as _load
+
+        state = _load(path + ".pdparams")
+        current = self.network.state_dict()
+        if skip_mismatch:
+            state = {k: v for k, v in state.items()
+                     if k in current and tuple(np.shape(v)) == tuple(current[k].shape)}
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(opt_path):
+            self._optimizer.set_state_dict(_load(opt_path))
+        return self
+
+    def summary(self, input_size=None, dtype=None) -> dict:
+        """Parameter-count summary (reference hapi/model_summary.py)."""
+        rows = []
+        total = 0
+        trainable = 0
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.shape)) if p.shape else 1
+            total += n
+            if not p.stop_gradient:
+                trainable += n
+            rows.append((name, tuple(p.shape), n))
+        w = max([len(r[0]) for r in rows] + [10])
+        lines = [f"{'Param':<{w}}  {'Shape':<20} {'Count':>12}"]
+        lines += [f"{n:<{w}}  {str(s):<20} {c:>12,}" for n, s, c in rows]
+        lines.append(f"Total params: {total:,} (trainable {trainable:,})")
+        print("\n".join(lines))
+        return {"total_params": total, "trainable_params": trainable}
+
+
+def _names(m: Metric) -> List[str]:
+    n = m.name()
+    return list(n) if isinstance(n, (list, tuple)) else [n]
+
+
+def _as_np(x):
+    if isinstance(x, tuple):
+        return x
+    return (x,)

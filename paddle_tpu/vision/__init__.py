@@ -1,3 +1,5 @@
 """paddle_tpu.vision (reference: `python/paddle/vision`)."""
 
+from . import datasets  # noqa: F401
 from . import models  # noqa: F401
+from . import transforms  # noqa: F401

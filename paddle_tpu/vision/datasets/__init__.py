@@ -1,0 +1,192 @@
+"""Vision datasets (reference `python/paddle/vision/datasets/`: mnist.py,
+cifar.py, folder.py). File-format parity: the SAME on-disk artifacts the
+reference consumes (idx-gzip MNIST, pickled CIFAR tar.gz, class-per-folder
+image trees) load here — point ``image_path``/``data_file`` at files fetched
+by any means. No auto-download: this build runs with zero egress; a missing
+file raises with the expected layout in the message."""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder"]
+
+
+def _require(path: Optional[str], what: str, layout: str) -> str:
+    if path is None or not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{what}: file {path!r} not found. This build does no network "
+            f"downloads — provide the standard artifact ({layout}).")
+    return path
+
+
+class MNIST(Dataset):
+    """MNIST over the standard idx-gzip files (reference mnist.py:30).
+
+    ``image_path``/``label_path``: the ``*-images-idx3-ubyte.gz`` /
+    ``*-labels-idx1-ubyte.gz`` files. ``backend``: "cv2" → HWC uint8 numpy
+    images (reference default); "pil" unsupported (no PIL dependency)."""
+
+    NAME = "mnist"
+
+    def __init__(self, image_path: Optional[str] = None,
+                 label_path: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None, download: bool = False,
+                 backend: str = "cv2"):
+        if download and image_path is None:
+            raise NotImplementedError(
+                f"{type(self).__name__}: auto-download is unavailable (zero "
+                "egress); pass image_path/label_path to the local idx files")
+        self.mode = mode
+        self.transform = transform
+        image_path = _require(image_path, f"{type(self).__name__} images",
+                              "idx3-ubyte, gzipped")
+        label_path = _require(label_path, f"{type(self).__name__} labels",
+                              "idx1-ubyte, gzipped")
+        self.images, self.labels = self._parse(image_path, label_path)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+    def _parse(self, image_path: str, label_path: str):
+        with self._open(label_path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            if magic != 2049:
+                raise ValueError(f"bad idx1 magic {magic} in {label_path}")
+            labels = np.frombuffer(f.read(n), dtype=np.uint8).astype(np.int64)
+        with self._open(image_path) as f:
+            magic, n2, rows, cols = struct.unpack(">IIII", f.read(16))
+            if magic != 2051:
+                raise ValueError(f"bad idx3 magic {magic} in {image_path}")
+            images = np.frombuffer(f.read(n2 * rows * cols), dtype=np.uint8)
+            images = images.reshape(n2, rows, cols)
+        if n != n2:
+            raise ValueError(f"label/image count mismatch: {n} vs {n2}")
+        return images, labels
+
+    def __getitem__(self, idx: int):
+        img = self.images[idx][:, :, None]  # HWC
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([self.labels[idx]])
+
+    def __len__(self) -> int:
+        return len(self.labels)
+
+
+class FashionMNIST(MNIST):
+    """Same idx format, different artifact (reference mnist.py FashionMNIST)."""
+
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 over the standard python-version tar.gz
+    (reference cifar.py:32). ``data_file``: cifar-10-python.tar.gz."""
+
+    _batches_train = [f"data_batch_{i}" for i in range(1, 6)]
+    _batches_test = ["test_batch"]
+    _label_key = b"labels"
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None, download: bool = False,
+                 backend: str = "cv2"):
+        if download and data_file is None:
+            raise NotImplementedError(
+                f"{type(self).__name__}: auto-download is unavailable (zero "
+                "egress); pass data_file=<cifar python tar.gz>")
+        self.mode = mode
+        self.transform = transform
+        data_file = _require(data_file, type(self).__name__,
+                             "cifar-10-python.tar.gz layout")
+        names = self._batches_train if mode == "train" else self._batches_test
+        imgs: List[np.ndarray] = []
+        labels: List[int] = []
+        with tarfile.open(data_file, "r:*") as tar:
+            for member in tar.getmembers():
+                base = os.path.basename(member.name)
+                if base in names:
+                    d = pickle.load(tar.extractfile(member), encoding="bytes")
+                    imgs.append(np.asarray(d[b"data"], dtype=np.uint8))
+                    labels.extend(int(l) for l in d[self._label_key])
+        if not imgs:
+            raise ValueError(f"no {names} members found in {data_file}")
+        data = np.concatenate(imgs, axis=0).reshape(-1, 3, 32, 32)
+        self.data = np.transpose(data, (0, 2, 3, 1))  # HWC
+        self.labels = np.asarray(labels, dtype=np.int64)
+
+    def __getitem__(self, idx: int):
+        img = self.data[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([self.labels[idx]])
+
+    def __len__(self) -> int:
+        return len(self.labels)
+
+
+class Cifar100(Cifar10):
+    """CIFAR-100 python tar.gz (reference cifar.py Cifar100)."""
+
+    _batches_train = ["train"]
+    _batches_test = ["test"]
+    _label_key = b"fine_labels"
+
+
+class DatasetFolder(Dataset):
+    """Class-per-subfolder image tree (reference folder.py:42): each
+    subdirectory of ``root`` is a class; ``loader`` turns a path into a
+    sample (default: numpy load for .npy, raw bytes read otherwise)."""
+
+    def __init__(self, root: str, loader: Optional[Callable] = None,
+                 extensions: Optional[Tuple[str, ...]] = None,
+                 transform: Optional[Callable] = None,
+                 is_valid_file: Optional[Callable] = None):
+        root = _require(root, "DatasetFolder root", "class-per-subfolder tree")
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _default_loader
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise ValueError(f"no class subfolders under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples: List[Tuple[str, int]] = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                path = os.path.join(cdir, fname)
+                if is_valid_file is not None and not is_valid_file(path):
+                    continue
+                if extensions is not None and not fname.lower().endswith(
+                        tuple(e.lower() for e in extensions)):
+                    continue
+                self.samples.append((path, self.class_to_idx[c]))
+
+    def __getitem__(self, idx: int):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+
+def _default_loader(path: str):
+    if path.endswith(".npy"):
+        return np.load(path)
+    with open(path, "rb") as f:
+        return f.read()

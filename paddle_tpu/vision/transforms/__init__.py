@@ -1,0 +1,242 @@
+"""Image transforms (reference `python/paddle/vision/transforms/transforms.py`
++ `functional.py`). Numpy-array backend (HWC uint8/float) — the reference's
+cv2/PIL backends collapse to numpy here; tensors come out CHW float32 ready
+for the conv stack. Deterministic per-call randomness uses numpy's global
+RNG (seedable via np.random.seed, matching the reference's convention)."""
+
+from __future__ import annotations
+
+import numbers
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...tensor.tensor import Tensor
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Transpose", "Pad", "BrightnessTransform",
+           # functional
+           "to_tensor", "normalize", "resize", "hflip", "vflip", "crop",
+           "center_crop", "pad"]
+
+
+# ---------------------------------------------------------------------------
+# functional
+# ---------------------------------------------------------------------------
+
+def _as_hwc(img) -> np.ndarray:
+    if isinstance(img, Tensor):
+        img = img.numpy()
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return arr
+
+
+def to_tensor(img, data_format: str = "CHW") -> Tensor:
+    """HWC uint8 [0,255] (or float) → float32 tensor scaled to [0,1]."""
+    arr = _as_hwc(img)
+    if arr.dtype == np.uint8:
+        arr = arr.astype(np.float32) / 255.0
+    else:
+        arr = arr.astype(np.float32)
+    if data_format == "CHW":
+        arr = np.transpose(arr, (2, 0, 1))
+    return Tensor(arr)
+
+
+def normalize(img, mean, std, data_format: str = "CHW", to_rgb: bool = False):
+    tensor_in = isinstance(img, Tensor)
+    arr = img.numpy() if tensor_in else np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    shape = (-1, 1, 1) if data_format == "CHW" else (1, 1, -1)
+    out = (arr - mean.reshape(shape)) / std.reshape(shape)
+    return Tensor(out) if tensor_in else out
+
+
+def resize(img, size, interpolation: str = "bilinear") -> np.ndarray:
+    """size: int (short side) or (h, w). Bilinear/nearest via jax.image."""
+    import jax.image
+
+    arr = _as_hwc(img)
+    h, w = arr.shape[:2]
+    if isinstance(size, numbers.Number):
+        if h <= w:
+            nh, nw = int(size), int(size * w / h)
+        else:
+            nh, nw = int(size * h / w), int(size)
+    else:
+        nh, nw = int(size[0]), int(size[1])
+    method = {"bilinear": "linear", "nearest": "nearest", "bicubic": "cubic"}[interpolation]
+    out = np.asarray(jax.image.resize(arr.astype(np.float32), (nh, nw, arr.shape[2]),
+                                      method=method))
+    return out.astype(arr.dtype) if arr.dtype == np.uint8 else out
+
+
+def crop(img, top: int, left: int, height: int, width: int) -> np.ndarray:
+    arr = _as_hwc(img)
+    return arr[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size) -> np.ndarray:
+    arr = _as_hwc(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    top = max(0, (h - th) // 2)
+    left = max(0, (w - tw) // 2)
+    return crop(arr, top, left, th, tw)
+
+
+def hflip(img) -> np.ndarray:
+    return _as_hwc(img)[:, ::-1]
+
+
+def vflip(img) -> np.ndarray:
+    return _as_hwc(img)[::-1]
+
+
+def pad(img, padding, fill=0, padding_mode: str = "constant") -> np.ndarray:
+    arr = _as_hwc(img)
+    if isinstance(padding, numbers.Number):
+        pl = pr = pt = pb = int(padding)
+    elif len(padding) == 2:
+        (pl, pt), (pr, pb) = (padding[0], padding[1]), (padding[0], padding[1])
+    else:
+        pl, pt, pr, pb = padding
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(arr, ((pt, pb), (pl, pr), (0, 0)), mode=mode, **kw)
+
+
+# ---------------------------------------------------------------------------
+# transform classes
+# ---------------------------------------------------------------------------
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format: str = "CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize(BaseTransform):
+    """Scalar mean/std stay scalar (channel-count agnostic) — the reference
+    expands them to 3-vectors, which silently BROADCASTS a 1-channel image
+    to 3 channels; scalars normalize any channel count correctly."""
+
+    def __init__(self, mean=0.0, std=1.0, data_format: str = "CHW",
+                 to_rgb: bool = False, keys=None):
+        self.mean, self.std, self.data_format = mean, std, data_format
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation: str = "bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed: bool = False,
+                 fill=0, padding_mode: str = "constant", keys=None):
+        self.size = (int(size), int(size)) if isinstance(size, numbers.Number) \
+            else tuple(size)
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        arr = _as_hwc(img)
+        if self.padding is not None:
+            arr = pad(arr, self.padding, self.fill, self.padding_mode)
+        th, tw = self.size
+        h, w = arr.shape[:2]
+        if self.pad_if_needed and (h < th or w < tw):
+            arr = pad(arr, (0, 0, max(0, tw - w), max(0, th - h)), self.fill,
+                      self.padding_mode)
+            h, w = arr.shape[:2]
+        top = np.random.randint(0, h - th + 1)
+        left = np.random.randint(0, w - tw + 1)
+        return crop(arr, top, left, th, tw)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob: float = 0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return hflip(img) if np.random.random() < self.prob else _as_hwc(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob: float = 0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return vflip(img) if np.random.random() < self.prob else _as_hwc(img)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = tuple(order)
+
+    def _apply_image(self, img):
+        return np.transpose(_as_hwc(img), self.order)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode: str = "constant", keys=None):
+        self.padding, self.fill, self.padding_mode = padding, fill, padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value: float, keys=None):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _as_hwc(img)
+        arr = _as_hwc(img).astype(np.float32)
+        alpha = 1 + np.random.uniform(-self.value, self.value)
+        out = arr * alpha
+        if np.asarray(img).dtype == np.uint8:
+            return np.clip(out, 0, 255).astype(np.uint8)
+        return out

@@ -1,0 +1,295 @@
+"""hapi Model / callbacks / vision datasets+transforms tests (reference
+test strategy: test/legacy_test/test_model.py, test_datasets.py,
+test_transforms.py)."""
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.vision import datasets as vdatasets
+from paddle_tpu.vision import transforms as T
+
+
+class ToyData(Dataset):
+    """Linearly-separable 2-class problem."""
+
+    def __init__(self, n=64, seed=0):
+        rng = np.random.default_rng(seed)
+        self.x = rng.standard_normal((n, 8)).astype(np.float32)
+        self.y = (self.x[:, 0] > 0).astype(np.int64).reshape(-1, 1)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def make_model():
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    model = paddle.Model(net)
+    model.prepare(optimizer=paddle.optimizer.Adam(learning_rate=1e-2,
+                                                  parameters=net.parameters()),
+                  loss=nn.CrossEntropyLoss(), metrics=Accuracy())
+    return model
+
+
+class TestModel:
+    def test_fit_evaluate_predict(self, capsys):
+        model = make_model()
+        model.fit(ToyData(), epochs=12, batch_size=16, verbose=0)
+        logs = model.evaluate(ToyData(seed=1), batch_size=16, verbose=0)
+        assert logs["acc"] > 0.9
+        preds = model.predict(ToyData(seed=1), batch_size=16, stack_outputs=True)
+        assert preds[0].shape == (64, 2)
+
+    def test_train_batch_returns_loss_and_updates(self):
+        model = make_model()
+        x = np.random.default_rng(0).standard_normal((8, 8)).astype(np.float32)
+        y = np.zeros((8, 1), np.int64)
+        l0 = model.train_batch([x], [y])
+        l1 = model.train_batch([x], [y])
+        assert isinstance(l0, float) and l1 < l0
+
+    def test_fit_requires_prepare(self):
+        model = paddle.Model(nn.Linear(4, 2))
+        with pytest.raises(RuntimeError, match="prepare"):
+            model.fit(ToyData())
+
+    def test_save_load_roundtrip(self, tmp_path):
+        model = make_model()
+        model.fit(ToyData(), epochs=1, batch_size=32, verbose=0)
+        path = str(tmp_path / "ckpt")
+        model.save(path)
+        assert os.path.exists(path + ".pdparams") and os.path.exists(path + ".pdopt")
+        model2 = make_model()
+        model2.load(path)
+        x = np.ones((2, 8), np.float32)
+        np.testing.assert_allclose(model2.predict_batch([x])[0],
+                                   model.predict_batch([x])[0], rtol=1e-6)
+
+    def test_inference_export(self, tmp_path):
+        net = nn.Linear(8, 2)
+        model = paddle.Model(net, inputs=[paddle.jit.InputSpec([-1, 8])])
+        path = str(tmp_path / "infer")
+        model.save(path, training=False)
+        loaded = paddle.jit.load(path)
+        x = np.ones((3, 8), np.float32)
+        np.testing.assert_allclose(loaded(paddle.to_tensor(x)).numpy(),
+                                   net(paddle.to_tensor(x)).numpy(), rtol=1e-6)
+
+    def test_summary_counts(self, capsys):
+        model = make_model()
+        info = model.summary()
+        assert info["total_params"] == 8 * 16 + 16 + 16 * 2 + 2
+
+    def test_early_stopping(self):
+        model = make_model()
+        es = paddle.callbacks.EarlyStopping(monitor="acc", patience=0,
+                                            save_best_model=False, verbose=0)
+        model.fit(ToyData(), eval_data=ToyData(seed=1), epochs=50, batch_size=32,
+                  verbose=0, callbacks=[es])
+        assert model.stop_training  # converges fast → patience-0 stop fires
+
+
+class TestCallbacks:
+    def test_progbar_logs(self, capsys):
+        model = make_model()
+        model.fit(ToyData(), epochs=1, batch_size=32, verbose=2, log_freq=1)
+        out = capsys.readouterr().out
+        assert "Epoch 1/1" in out and "loss" in out
+
+    def test_model_checkpoint(self, tmp_path):
+        model = make_model()
+        model.fit(ToyData(), epochs=2, batch_size=32, verbose=0,
+                  save_dir=str(tmp_path))
+        assert os.path.exists(str(tmp_path / "final.pdparams"))
+        assert os.path.exists(str(tmp_path / "0.pdparams"))
+
+    def test_lr_scheduler_callback_steps(self):
+        net = nn.Linear(8, 2)
+        sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=2,
+                                              gamma=0.5)
+        opt = paddle.optimizer.SGD(learning_rate=sched, parameters=net.parameters())
+        model = paddle.Model(net)
+        model.prepare(optimizer=opt, loss=nn.CrossEntropyLoss())
+        model.fit(ToyData(n=8), epochs=1, batch_size=2, verbose=0)  # 4 steps
+        assert opt.get_lr() == pytest.approx(0.1 * 0.5 ** 2)
+
+
+class TestVisionDatasets:
+    def _write_mnist(self, tmp_path, n=10):
+        rng = np.random.default_rng(0)
+        imgs = rng.integers(0, 255, (n, 28, 28), dtype=np.uint8)
+        labels = rng.integers(0, 10, n, dtype=np.uint8)
+        ip = str(tmp_path / "imgs.gz")
+        lp = str(tmp_path / "labels.gz")
+        with gzip.open(ip, "wb") as f:
+            f.write(struct.pack(">IIII", 2051, n, 28, 28))
+            f.write(imgs.tobytes())
+        with gzip.open(lp, "wb") as f:
+            f.write(struct.pack(">II", 2049, n))
+            f.write(labels.tobytes())
+        return ip, lp, imgs, labels
+
+    def test_mnist_parses_idx(self, tmp_path):
+        ip, lp, imgs, labels = self._write_mnist(tmp_path)
+        ds = vdatasets.MNIST(image_path=ip, label_path=lp)
+        assert len(ds) == 10
+        img, lab = ds[3]
+        assert img.shape == (28, 28, 1)
+        np.testing.assert_array_equal(img[:, :, 0], imgs[3])
+        assert lab[0] == labels[3]
+
+    def test_mnist_with_transform(self, tmp_path):
+        ip, lp, _, _ = self._write_mnist(tmp_path)
+        ds = vdatasets.MNIST(image_path=ip, label_path=lp,
+                             transform=T.Compose([T.ToTensor()]))
+        img, _ = ds[0]
+        assert img.shape == [1, 28, 28]
+        assert float(img.numpy().max()) <= 1.0
+
+    def test_mnist_missing_file_raises(self):
+        with pytest.raises(FileNotFoundError, match="zero egress|not found"):
+            vdatasets.MNIST(image_path="/nope.gz", label_path="/nope2.gz")
+        with pytest.raises(NotImplementedError, match="download"):
+            vdatasets.MNIST(download=True)
+
+    def test_cifar10_parses_tar(self, tmp_path):
+        rng = np.random.default_rng(1)
+        path = str(tmp_path / "cifar-10-python.tar.gz")
+        with tarfile.open(path, "w:gz") as tar:
+            for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+                d = {b"data": rng.integers(0, 255, (4, 3072), dtype=np.uint8),
+                     b"labels": list(rng.integers(0, 10, 4))}
+                blob = pickle.dumps(d)
+                import io as _io
+
+                info = tarfile.TarInfo(f"cifar-10-batches-py/{name}")
+                info.size = len(blob)
+                tar.addfile(info, _io.BytesIO(blob))
+        train = vdatasets.Cifar10(data_file=path, mode="train")
+        test = vdatasets.Cifar10(data_file=path, mode="test")
+        assert len(train) == 20 and len(test) == 4
+        img, lab = train[0]
+        assert img.shape == (32, 32, 3) and 0 <= lab[0] < 10
+
+    def test_dataset_folder(self, tmp_path):
+        for cls in ("cat", "dog"):
+            os.makedirs(tmp_path / cls)
+            for i in range(3):
+                np.save(str(tmp_path / cls / f"{i}.npy"),
+                        np.full((4, 4), i, np.float32))
+        ds = vdatasets.DatasetFolder(str(tmp_path), extensions=(".npy",))
+        assert ds.classes == ["cat", "dog"]
+        assert len(ds) == 6
+        sample, target = ds[5]
+        assert target == 1 and sample.shape == (4, 4)
+
+
+class TestTransforms:
+    def test_to_tensor_and_normalize(self):
+        img = np.full((4, 4, 3), 255, np.uint8)
+        t = T.ToTensor()(img)
+        assert t.shape == [3, 4, 4] and float(t.numpy().max()) == 1.0
+        n = T.Normalize(mean=0.5, std=0.5)(t)
+        np.testing.assert_allclose(n.numpy(), np.ones((3, 4, 4)), rtol=1e-6)
+
+    def test_resize_modes(self):
+        img = np.random.default_rng(0).integers(0, 255, (8, 16, 3), dtype=np.uint8)
+        assert T.Resize((4, 4))(img).shape == (4, 4, 3)
+        assert T.Resize(4)(img).shape == (4, 8, 3)  # short side to 4
+
+    def test_crops_and_flips(self):
+        img = np.arange(4 * 6 * 1, dtype=np.uint8).reshape(4, 6, 1)
+        cc = T.CenterCrop(2)(img)
+        assert cc.shape == (2, 2, 1)
+        np.testing.assert_array_equal(T.RandomHorizontalFlip(prob=1.0)(img),
+                                      img[:, ::-1])
+        np.testing.assert_array_equal(T.RandomVerticalFlip(prob=0.0)(img), img)
+        rc = T.RandomCrop(3)(img)
+        assert rc.shape == (3, 3, 1)
+
+    def test_pad_modes(self):
+        img = np.ones((2, 2, 1), np.uint8)
+        assert T.Pad(1)(img).shape == (4, 4, 1)
+        assert T.Pad((1, 2))(img).shape == (6, 4, 1)
+
+    def test_compose_pipeline(self):
+        pipe = T.Compose([T.Resize((8, 8)), T.CenterCrop(4), T.ToTensor(),
+                          T.Normalize(mean=0.5, std=0.5)])
+        out = pipe(np.zeros((16, 16, 3), np.uint8))
+        assert out.shape == [3, 4, 4]
+        np.testing.assert_allclose(out.numpy(), -np.ones((3, 4, 4)), rtol=1e-6)
+
+
+class TestGradAccumulation:
+    def test_trailing_window_flushes_and_loss_scaled(self):
+        """accumulate_grad_batches: sum/k gradients, flush at epoch end."""
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((6, 4)).astype(np.float32)
+        y = rng.standard_normal((6, 1)).astype(np.float32)
+
+        def build():
+            net = nn.Linear(4, 1)
+            net.weight.set_value(np.ones((4, 1), np.float32))
+            net.bias.set_value(np.zeros((1,), np.float32))
+            m = paddle.Model(net)
+            m.prepare(optimizer=paddle.optimizer.SGD(learning_rate=0.1,
+                                                     parameters=net.parameters()),
+                      loss=nn.MSELoss())
+            return m, net
+
+        class Arr(Dataset):
+            def __getitem__(self, i):
+                return x[i], y[i]
+
+            def __len__(self):
+                return 6
+
+        # accumulate over k=4 with 3 batches of 2 → one partial window (3<4):
+        # must still apply exactly one optimizer step of mean-scaled grads
+        m1, n1 = build()
+        m1.fit(Arr(), epochs=1, batch_size=2, shuffle=False, verbose=0,
+               accumulate_grad_batches=4)
+        # reference: one step with (sum of 3 batch grads)/4
+        m2, n2 = build()
+        for i in range(0, 6, 2):
+            out = n2(paddle.to_tensor(x[i:i + 2]))
+            (F.mse_loss(out, paddle.to_tensor(y[i:i + 2])) * 0.25).backward()
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=n2.parameters())
+        opt.step()
+        np.testing.assert_allclose(n1.weight.numpy(), n2.weight.numpy(), rtol=1e-5)
+
+    def test_eval_callbacks_fire(self):
+        model = make_model()
+        seen = []
+
+        class Spy(paddle.callbacks.Callback):
+            def on_eval_begin(self, logs=None):
+                seen.append("begin")
+
+            def on_eval_batch_end(self, step, logs=None):
+                seen.append(("batch", step))
+
+            def on_eval_end(self, logs=None):
+                seen.append("end")
+
+        model.evaluate(ToyData(n=8), batch_size=4, verbose=0, callbacks=[Spy()])
+        assert seen[0] == "begin" and seen[-1] == "end"
+        assert ("batch", 1) in seen
+
+    def test_inference_export_without_specs_raises(self, tmp_path):
+        model = paddle.Model(nn.Linear(4, 2))
+        with pytest.raises(ValueError, match="InputSpec"):
+            model.save(str(tmp_path / "x"), training=False)
