@@ -181,3 +181,84 @@ class Event:
 
     def synchronize(self) -> None:
         synchronize()
+
+
+# ---------------------------------------------------------------------------
+# memory stats (reference: paddle/phi/core/memory/stats.h StatAllocator →
+# python/paddle/device/cuda/__init__.py max_memory_allocated:235 etc.)
+#
+# XLA owns the TPU allocator; per-device counters come from PJRT's
+# `memory_stats()` (bytes_in_use / peak_bytes_in_use / bytes_limit). The
+# reference's allocated-vs-reserved split does not exist (XLA's BFC arena IS
+# the reservation), so *_reserved reports the same arena counters. The CPU
+# backend exposes no stats → counters read 0 (documented, not an error).
+# ---------------------------------------------------------------------------
+
+def _mem_stats_raw(device=None) -> dict:
+    if device is None:
+        dev = current_device().jax_device
+    elif isinstance(device, Place):
+        dev = device.jax_device
+    elif isinstance(device, int):
+        dev = jax.devices()[device]
+    elif isinstance(device, str):
+        platform, idx = _parse(device)
+        platform = _PLATFORM_ALIASES.get(platform, platform)
+        matches = [d for d in jax.devices() if d.platform == platform]
+        if not matches:
+            raise ValueError(f"no {platform!r} devices visible for {device!r}")
+        dev = matches[idx]
+    else:
+        dev = device  # a raw jax.Device
+    stats = dev.memory_stats()  # None on backends without counters (CPU)
+    return stats or {}
+
+
+def memory_stats(device=None) -> dict:
+    """All PJRT memory counters for one device (empty dict on backends
+    without stats, e.g. CPU)."""
+    return dict(_mem_stats_raw(device))
+
+
+def memory_allocated(device=None) -> int:
+    """Bytes currently held by live arrays on the device."""
+    return int(_mem_stats_raw(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    """High-water mark of bytes_in_use since process start (PJRT peak; the
+    reference's reset_* has no XLA equivalent — the peak is monotonic)."""
+    return int(_mem_stats_raw(device).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None) -> int:
+    return memory_allocated(device)
+
+
+def max_memory_reserved(device=None) -> int:
+    return max_memory_allocated(device)
+
+
+def empty_cache() -> None:
+    """Parity no-op: XLA's arena is not user-flushable; buffers free when
+    their jax.Array is garbage-collected."""
+
+
+__all__ += ["memory_stats", "memory_allocated", "max_memory_allocated",
+            "memory_reserved", "max_memory_reserved", "empty_cache"]
+
+
+class cuda:
+    """Namespace shim so reference code calling paddle.device.cuda.* memory
+    APIs keeps working on TPU (same counters, XLA-backed)."""
+
+    memory_stats = staticmethod(memory_stats)
+    memory_allocated = staticmethod(memory_allocated)
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    memory_reserved = staticmethod(memory_reserved)
+    max_memory_reserved = staticmethod(max_memory_reserved)
+    empty_cache = staticmethod(empty_cache)
+
+    @staticmethod
+    def device_count() -> int:
+        return 0  # no CUDA devices on a TPU build (parity truthfulness)

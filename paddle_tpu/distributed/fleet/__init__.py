@@ -117,3 +117,5 @@ distributed_model = fleet.distributed_model
 distributed_optimizer = fleet.distributed_optimizer
 worker_index = fleet.worker_index
 worker_num = fleet.worker_num
+
+from . import elastic  # noqa: E402,F401

@@ -1,0 +1,130 @@
+"""Communication watchdog (reference
+`paddle/phi/core/distributed/comm_task_manager.h:37` CommTaskManager +
+`comm_task.h` — a loop thread that detects collectives exceeding their
+timeout and dumps diagnostics before the job is aborted).
+
+TPU-native: XLA owns collective scheduling, so a "hung collective" shows up
+as a device computation that never completes — the watchable unit is the
+host-side wait (``block_until_ready`` / a step call). :class:`CommWatchdog`
+arms a timer around such waits; on expiry it dumps all python thread stacks
+(the reference dumps comm task state) and invokes ``on_timeout`` — default
+logs; pass e.g. ``lambda info: os._exit(ELASTIC_EXIT_CODE)`` to feed the
+elastic restart path."""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["CommWatchdog"]
+
+
+class _Watch:
+    __slots__ = ("name", "started", "deadline")
+
+    def __init__(self, name: str, timeout: float):
+        self.name = name
+        self.started = time.time()
+        self.deadline = self.started + timeout
+
+
+class CommWatchdog:
+    """Arm/disarm a timeout around communication waits.
+
+    ``with watchdog.watch("all_reduce"): tensor._value.block_until_ready()``
+
+    One monitor thread serves all watches (reference keeps one loop thread
+    for all comm tasks). ``on_timeout(info)`` fires ONCE per expired watch
+    with ``{"name", "elapsed", "stacks"}``."""
+
+    def __init__(self, timeout: float = 120.0,
+                 on_timeout: Optional[Callable[[dict], None]] = None,
+                 poll_interval: float = 0.5):
+        self.timeout = timeout
+        self.on_timeout = on_timeout or self._default_handler
+        self.poll_interval = poll_interval
+        self._watches: Dict[int, _Watch] = {}
+        self._fired: set = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.timeout_count = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "CommWatchdog":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="paddle-tpu-comm-watchdog")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    # -- watches -----------------------------------------------------------
+    def watch(self, name: str = "comm", timeout: Optional[float] = None):
+        """Context manager arming one watch."""
+        wd = self
+
+        class _Ctx:
+            def __enter__(self_ctx):
+                self_ctx._id = wd._arm(name, timeout)
+                return self_ctx
+
+            def __exit__(self_ctx, *exc):
+                wd._disarm(self_ctx._id)
+
+        return _Ctx()
+
+    def _arm(self, name: str, timeout: Optional[float]) -> int:
+        self.start()
+        w = _Watch(name, timeout if timeout is not None else self.timeout)
+        wid = id(w)
+        with self._lock:
+            self._watches[wid] = w
+        return wid
+
+    def _disarm(self, wid: int) -> None:
+        with self._lock:
+            self._watches.pop(wid, None)
+            self._fired.discard(wid)
+
+    # -- monitor -----------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            now = time.time()
+            expired: List[tuple] = []
+            with self._lock:
+                for wid, w in self._watches.items():
+                    if now > w.deadline and wid not in self._fired:
+                        self._fired.add(wid)
+                        expired.append((wid, w))
+            for wid, w in expired:
+                self.timeout_count += 1
+                info = {"name": w.name, "elapsed": now - w.started,
+                        "stacks": self._all_stacks()}
+                try:
+                    self.on_timeout(info)
+                except Exception:
+                    traceback.print_exc()
+
+    @staticmethod
+    def _all_stacks() -> str:
+        out = []
+        for tid, frame in sys._current_frames().items():
+            out.append(f"--- thread {tid} ---\n" +
+                       "".join(traceback.format_stack(frame)))
+        return "\n".join(out)
+
+    @staticmethod
+    def _default_handler(info: dict) -> None:
+        print(f"[comm watchdog] '{info['name']}' exceeded timeout "
+              f"({info['elapsed']:.1f}s elapsed); thread stacks:\n"
+              f"{info['stacks']}", file=sys.stderr)

@@ -155,9 +155,13 @@ class LRScheduler(Callback):
 
     def on_train_batch_end(self, step, logs=None):
         if self.by_step:
-            s = self._sched()
-            if s is not None:
-                s.step()
+            # step the schedule per OPTIMIZER step, not per micro-batch:
+            # with grad accumulation only every k-th batch updates
+            accum = getattr(self.model, "_accumulate", 1) or 1
+            if (step + 1) % accum == 0:
+                s = self._sched()
+                if s is not None:
+                    s.step()
 
     def on_epoch_end(self, epoch, logs=None):
         if self.by_epoch:

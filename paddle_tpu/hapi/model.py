@@ -176,9 +176,10 @@ class Model:
                     self._optimizer.clear_grad()
                 cbks.on_epoch_end(epoch, logs)
                 if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                    eval_logs = self.evaluate(eval_data, batch_size=batch_size,
-                                              verbose=0, num_workers=num_workers)
-                    cbks.on_eval_end(eval_logs)
+                    # user callbacks ride along: they get the full eval
+                    # lifecycle (on_eval_begin/batch/end) from evaluate()
+                    self.evaluate(eval_data, batch_size=batch_size, verbose=0,
+                                  num_workers=num_workers, callbacks=callbacks)
         finally:
             self._accumulate = 1
         cbks.on_train_end(logs)

@@ -72,7 +72,9 @@ def resize(img, size, interpolation: str = "bilinear") -> np.ndarray:
     method = {"bilinear": "linear", "nearest": "nearest", "bicubic": "cubic"}[interpolation]
     out = np.asarray(jax.image.resize(arr.astype(np.float32), (nh, nw, arr.shape[2]),
                                       method=method))
-    return out.astype(arr.dtype) if arr.dtype == np.uint8 else out
+    if arr.dtype == np.uint8:
+        return np.clip(np.rint(out), 0, 255).astype(np.uint8)
+    return out
 
 
 def crop(img, top: int, left: int, height: int, width: int) -> np.ndarray:

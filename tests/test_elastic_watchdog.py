@@ -1,0 +1,168 @@
+"""Elastic manager, comm watchdog, memory stats tests (reference
+fleet/elastic/manager.py, comm_task_manager.h, device memory stats)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import CommWatchdog
+from paddle_tpu.distributed.fleet.elastic import (ELASTIC_EXIT_CODE,
+                                                  ElasticLevel, ElasticManager,
+                                                  ElasticStatus, FileStore)
+
+
+class TestFileStore:
+    def test_put_get_delete_keys(self, tmp_path):
+        st = FileStore(str(tmp_path))
+        st.put("job/nodes/a", {"x": 1})
+        assert st.get("job/nodes/a") == {"x": 1}
+        st.put("job/world", ["a"])
+        assert sorted(st.keys("job/nodes/")) == ["job/nodes/a"]
+        st.delete("job/nodes/a")
+        assert st.get("job/nodes/a") is None
+
+    def test_age_and_touch(self, tmp_path):
+        st = FileStore(str(tmp_path))
+        st.put("k", 1)
+        assert st.age("k") < 5
+        assert st.age("missing") == float("inf")
+
+
+def mk_manager(tmp_path, host, np=1, ttl=60.0, **kw):
+    return ElasticManager(FileStore(str(tmp_path)), job_id="j", np=np,
+                          host=host, ttl=ttl, **kw)
+
+
+class TestElasticManager:
+    def test_np_parsing_and_level(self, tmp_path):
+        m = mk_manager(tmp_path, "h0", np="2:4")
+        assert (m.np_min, m.np_max) == (2, 4)
+        assert m.elastic_level == ElasticLevel.ELASTIC
+        m2 = mk_manager(tmp_path, "h1", np=2)
+        assert m2.elastic_level == ElasticLevel.FAULT_TOLERANCE
+        m.exit()
+        m2.exit()
+
+    def test_registration_and_membership(self, tmp_path):
+        m0 = mk_manager(tmp_path, "h0", np=2)
+        assert m0.hosts() == ["h0"]
+        assert not m0.ready()
+        assert m0.watch_once() == ElasticStatus.HOLD  # under-provisioned
+        m1 = mk_manager(tmp_path, "h1", np=2)
+        assert m0.hosts() == ["h0", "h1"]
+        assert m0.ready()
+        # quorum reached, no world committed yet → (re)start
+        assert m0.watch_once() == ElasticStatus.RESTART
+        m0.commit_world()
+        assert m0.watch_once() == ElasticStatus.HOLD  # steady
+        m0.exit()
+        m1.exit()
+
+    def test_peer_death_triggers_restart_and_pre_hook(self, tmp_path):
+        saved = []
+        m0 = mk_manager(tmp_path, "h0", np="1:2", ttl=0.6,
+                        pre_hook=lambda: saved.append("ckpt"))
+        m1 = mk_manager(tmp_path, "h1", np="1:2", ttl=0.6)
+        m0.commit_world()
+        assert m0.watch_once() == ElasticStatus.HOLD
+        m1.exit()  # peer leaves (deletes its node key)
+        status = m0.watch(interval=0.1, max_wait=5)
+        assert status == ElasticStatus.RESTART
+        assert saved == ["ckpt"]
+        m0.exit()
+
+    def test_scale_out_triggers_restart(self, tmp_path):
+        m0 = mk_manager(tmp_path, "h0", np="1:4")
+        m0.commit_world()
+        assert m0.watch_once() == ElasticStatus.HOLD
+        m1 = mk_manager(tmp_path, "h1", np="1:4")
+        assert m0.watch_once() == ElasticStatus.RESTART
+        # after restart the world is recommitted → steady again
+        m0.commit_world()
+        assert m0.watch_once() == ElasticStatus.HOLD
+        m0.exit()
+        m1.exit()
+
+    def test_completed_flag(self, tmp_path):
+        m0 = mk_manager(tmp_path, "h0", np=1)
+        m0.commit_world()
+        m0.exit(completed=True)
+        m1 = mk_manager(tmp_path, "h1", np=1)
+        assert m1.watch_once() == ElasticStatus.COMPLETED
+        m1.exit()
+
+    def test_hold_timeout_errors(self, tmp_path):
+        m0 = mk_manager(tmp_path, "h0", np=3, timeout=0.5)
+        m0.commit_world()
+        assert m0.watch(interval=0.1) == ElasticStatus.ERROR
+        m0.exit()
+
+    def test_stale_heartbeat_counts_as_dead(self, tmp_path):
+        st = FileStore(str(tmp_path))
+        m0 = ElasticManager(st, job_id="j", np="1:2", host="h0", ttl=0.5)
+        # fake peer that never heartbeats: backdate its mtime
+        st.put("j/nodes/ghost", {"host": "ghost"})
+        path = st._path("j/nodes/ghost")
+        old = time.time() - 10
+        os.utime(path, (old, old))
+        assert m0.hosts() == ["h0"]  # ghost is stale
+        m0.exit()
+
+
+class TestCommWatchdog:
+    def test_timeout_fires_with_stacks(self):
+        fired = []
+        wd = CommWatchdog(timeout=0.3, poll_interval=0.05,
+                          on_timeout=fired.append)
+        with wd.watch("slow_allreduce"):
+            time.sleep(0.8)
+        wd.stop()
+        assert len(fired) == 1
+        assert fired[0]["name"] == "slow_allreduce"
+        assert fired[0]["elapsed"] >= 0.3
+        assert "thread" in fired[0]["stacks"]
+        assert wd.timeout_count == 1
+
+    def test_fast_op_does_not_fire(self):
+        fired = []
+        wd = CommWatchdog(timeout=5.0, poll_interval=0.05,
+                          on_timeout=fired.append)
+        with wd.watch("fast"):
+            x = paddle.to_tensor(np.ones(4, np.float32))
+            (x + x).numpy()
+        wd.stop()
+        assert fired == []
+
+    def test_per_watch_timeout_override(self):
+        fired = []
+        wd = CommWatchdog(timeout=100.0, poll_interval=0.05,
+                          on_timeout=fired.append)
+        with wd.watch("custom", timeout=0.2):
+            time.sleep(0.6)
+        wd.stop()
+        assert len(fired) == 1
+
+    def test_fires_once_per_watch(self):
+        fired = []
+        wd = CommWatchdog(timeout=0.1, poll_interval=0.02,
+                          on_timeout=fired.append)
+        with wd.watch("op"):
+            time.sleep(0.5)
+        wd.stop()
+        assert len(fired) == 1
+
+
+class TestMemoryStats:
+    def test_cpu_counters_read_zero(self):
+        # CPU PJRT exposes no stats: documented zero, not an error
+        assert paddle.device.memory_allocated() == 0
+        assert paddle.device.max_memory_allocated() == 0
+        assert paddle.device.memory_stats() == {}
+        paddle.device.empty_cache()  # no-op must not raise
+
+    def test_cuda_shim(self):
+        assert paddle.device.cuda.device_count() == 0
+        assert paddle.device.cuda.max_memory_allocated() == 0
