@@ -204,10 +204,13 @@ def _mem_stats_raw(device=None) -> dict:
     elif isinstance(device, str):
         platform, idx = _parse(device)
         platform = _PLATFORM_ALIASES.get(platform, platform)
-        matches = [d for d in jax.devices() if d.platform == platform]
-        if not matches:
-            raise ValueError(f"no {platform!r} devices visible for {device!r}")
-        dev = matches[idx]
+        try:
+            devices = jax.devices(platform)  # any backend, not just default
+        except RuntimeError as e:
+            raise ValueError(f"no {platform!r} backend for {device!r}: {e}") from None
+        if idx >= len(devices):
+            raise ValueError(f"{device!r}: only {len(devices)} {platform} device(s)")
+        dev = devices[idx]
     else:
         dev = device  # a raw jax.Device
     stats = dev.memory_stats()  # None on backends without counters (CPU)

@@ -183,7 +183,6 @@ class ElasticManager:
                     self.pre_hook()
                 return status
             live = self.hosts()
-            world = self.store.get(self._world_key) or []
             if len(live) < self.np_min:
                 hold_since = hold_since or time.time()
                 if time.time() - hold_since > self.timeout:

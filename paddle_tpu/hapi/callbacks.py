@@ -153,17 +153,34 @@ class LRScheduler(Callback):
         lr = getattr(opt, "_learning_rate", None)
         return lr if isinstance(lr, Sched) else None
 
+    def _sync_to_optimizer(self):
+        """Advance the schedule by however many OPTIMIZER steps ran since the
+        last sync — exact under grad accumulation (only every k-th batch
+        updates) and the end-of-epoch partial-window flush."""
+        s = self._sched()
+        if s is None:
+            return
+        opt = getattr(self.model, "_optimizer", None)
+        cur = getattr(opt, "_step_count", None)
+        if cur is None:
+            s.step()
+            return
+        last = getattr(self, "_last_opt_steps", cur - 1)
+        for _ in range(max(0, cur - last)):
+            s.step()
+        self._last_opt_steps = cur
+
+    def on_train_begin(self, logs=None):
+        opt = getattr(self.model, "_optimizer", None)
+        self._last_opt_steps = getattr(opt, "_step_count", 0)
+
     def on_train_batch_end(self, step, logs=None):
         if self.by_step:
-            # step the schedule per OPTIMIZER step, not per micro-batch:
-            # with grad accumulation only every k-th batch updates
-            accum = getattr(self.model, "_accumulate", 1) or 1
-            if (step + 1) % accum == 0:
-                s = self._sched()
-                if s is not None:
-                    s.step()
+            self._sync_to_optimizer()
 
     def on_epoch_end(self, epoch, logs=None):
+        if self.by_step:
+            self._sync_to_optimizer()  # catch the partial-window flush
         if self.by_epoch:
             s = self._sched()
             if s is not None:

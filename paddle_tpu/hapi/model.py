@@ -176,10 +176,11 @@ class Model:
                     self._optimizer.clear_grad()
                 cbks.on_epoch_end(epoch, logs)
                 if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                    # user callbacks ride along: they get the full eval
-                    # lifecycle (on_eval_begin/batch/end) from evaluate()
+                    # run eval through fit's OWN callback list so user
+                    # callbacks get the eval lifecycle with their params
+                    # (save_dir etc.) intact and the fit ProgBar prints it
                     self.evaluate(eval_data, batch_size=batch_size, verbose=0,
-                                  num_workers=num_workers, callbacks=callbacks)
+                                  num_workers=num_workers, _cbks=cbks)
         finally:
             self._accumulate = 1
         cbks.on_train_end(logs)
@@ -187,13 +188,16 @@ class Model:
 
     def evaluate(self, eval_data, batch_size: int = 1, log_freq: int = 10,
                  verbose: int = 2, num_workers: int = 0, callbacks=None,
-                 num_iters=None) -> dict:
+                 num_iters=None, _cbks=None) -> dict:
         loader = self._loader(eval_data, batch_size, False, False, num_workers)
         steps = len(loader) if hasattr(loader, "__len__") else None
-        # verbose printing is handled below; callbacks get the hooks only
-        cbks = cbks_mod.config_callbacks(
-            callbacks, model=self, steps=steps, log_freq=log_freq,
-            verbose=0, mode="eval")
+        if _cbks is not None:
+            cbks = _cbks  # in-fit eval: reuse fit's list, params untouched
+        else:
+            # verbose printing is handled below; callbacks get the hooks only
+            cbks = cbks_mod.config_callbacks(
+                callbacks, model=self, steps=steps, log_freq=log_freq,
+                verbose=0, mode="eval")
         for m in self._metrics:
             m.reset()
         losses = []

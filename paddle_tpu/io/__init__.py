@@ -300,9 +300,9 @@ def get_worker_info():
 def default_collate_fn(batch: List[Any]):
     sample = batch[0]
     if isinstance(sample, Tensor):
-        return Tensor(np.stack([np.asarray(b._value) for b in batch]))
+        return Tensor(_stack_np([np.asarray(b._value) for b in batch]))
     if isinstance(sample, np.ndarray):
-        return Tensor(np.stack(batch))
+        return Tensor(_stack_np(batch))
     if isinstance(sample, (int, float, np.number)):
         return Tensor(np.asarray(batch))
     if isinstance(sample, (list, tuple)):
@@ -311,6 +311,15 @@ def default_collate_fn(batch: List[Any]):
     if isinstance(sample, dict):
         return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
     return batch
+
+
+def _stack_np(arrays):
+    """np.stack with the parallel C++ collate for big batches (io/native.py;
+    the reference's C++ reader does the same fan-in off the GIL)."""
+    from .native import native_stack
+
+    out = native_stack(arrays)
+    return out if out is not None else np.stack(arrays)
 
 
 class DataLoader:

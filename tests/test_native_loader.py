@@ -1,0 +1,137 @@
+"""Native C++ loader core tests (paddle_tpu/lib/native_loader.cpp via
+paddle_tpu/io/native.py): blocking ring queue semantics + parallel collate.
+Reference equivalents: paddle/fluid/reader/blocking_queue.h tests."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io import native
+from paddle_tpu.io.native import NativeRingQueue, QueueClosed, native_stack
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="g++ unavailable; native path disabled")
+
+
+class TestRingQueue:
+    def test_fifo_roundtrip(self):
+        q = NativeRingQueue(capacity=4)
+        q.push(b"alpha")
+        q.push(b"beta")
+        assert len(q) == 2
+        assert q.pop() == b"alpha"
+        assert q.pop() == b"beta"
+        q.close()
+
+    def test_binary_payloads_of_varying_size(self):
+        q = NativeRingQueue(capacity=2)
+        small = b"x"
+        big = np.arange(100000, dtype=np.int64).tobytes()
+        q.push(small)
+        q.push(big)
+        assert q.pop() == small
+        assert q.pop() == big
+
+    def test_pop_timeout(self):
+        q = NativeRingQueue(capacity=1)
+        t0 = time.time()
+        with pytest.raises(TimeoutError):
+            q.pop(timeout=0.2)
+        assert time.time() - t0 >= 0.15
+
+    def test_push_blocks_until_pop(self):
+        q = NativeRingQueue(capacity=1)
+        q.push(b"first")
+        popped = []
+
+        def consumer():
+            time.sleep(0.2)
+            popped.append(q.pop())
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        t0 = time.time()
+        q.push(b"second")  # must block ~0.2s until consumer drains
+        assert time.time() - t0 >= 0.1
+        t.join()
+        assert popped == [b"first"]
+        assert q.pop() == b"second"
+
+    def test_close_wakes_consumer(self):
+        q = NativeRingQueue(capacity=2)
+
+        def closer():
+            time.sleep(0.1)
+            q.close()
+
+        threading.Thread(target=closer).start()
+        with pytest.raises(QueueClosed):
+            q.pop()  # would block forever without close
+
+    def test_close_drains_remaining(self):
+        q = NativeRingQueue(capacity=4)
+        q.push(b"left-over")
+        q.close()
+        assert q.pop() == b"left-over"  # drain after close
+        with pytest.raises(QueueClosed):
+            q.pop()
+        with pytest.raises(QueueClosed):
+            q.push(b"nope")
+
+    def test_producer_consumer_threads(self):
+        q = NativeRingQueue(capacity=3)
+        n = 200
+        got = []
+
+        def producer():
+            for i in range(n):
+                q.push(str(i).encode())
+            q.close()
+
+        def consumer():
+            while True:
+                try:
+                    got.append(int(q.pop()))
+                except QueueClosed:
+                    return
+
+        tp = threading.Thread(target=producer)
+        tc = threading.Thread(target=consumer)
+        tp.start()
+        tc.start()
+        tp.join()
+        tc.join()
+        assert got == list(range(n))  # ordered, none lost
+
+
+class TestNativeStack:
+    def test_matches_np_stack(self, monkeypatch):
+        monkeypatch.setattr(native, "NATIVE_STACK_MIN_BYTES", 0)
+        rng = np.random.default_rng(0)
+        arrays = [rng.standard_normal((16, 32)).astype(np.float32) for _ in range(8)]
+        out = native_stack(arrays)
+        assert out is not None
+        np.testing.assert_array_equal(out, np.stack(arrays))
+
+    def test_declines_small_and_heterogeneous(self):
+        small = [np.zeros(4, np.float32)] * 4
+        assert native_stack(small) is None  # below threshold
+        hetero = [np.zeros((2, 2), np.float32), np.zeros((3, 2), np.float32)]
+        assert native_stack(hetero) is None
+
+    def test_large_batch_through_collate_fn(self):
+        from paddle_tpu.io import default_collate_fn
+
+        arrays = [np.full((256, 1024), i, np.float32) for i in range(8)]  # 8 MiB
+        out = default_collate_fn(arrays)
+        assert out.shape == [8, 256, 1024]
+        np.testing.assert_array_equal(out.numpy(), np.stack(arrays))
+
+    def test_non_contiguous_inputs(self, monkeypatch):
+        monkeypatch.setattr(native, "NATIVE_STACK_MIN_BYTES", 0)
+        base = np.arange(64, dtype=np.float32).reshape(8, 8)
+        views = [base[:, ::2] for _ in range(4)]  # strided views
+        out = native_stack(views)
+        np.testing.assert_array_equal(out, np.stack(views))
