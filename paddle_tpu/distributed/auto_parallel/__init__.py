@@ -26,7 +26,8 @@ from ...tensor.tensor import Tensor
 
 __all__ = ["ProcessMesh", "Shard", "Replicate", "Partial", "Placement", "shard_tensor",
            "dtensor_from_fn", "reshard", "shard_layer", "shard_optimizer",
-           "get_mesh", "set_mesh", "to_partition_spec", "sharding_of", "shard_constraint"]
+           "get_mesh", "set_mesh", "to_partition_spec", "sharding_of", "shard_constraint",
+           "Engine"]
 
 
 class Placement:
@@ -331,3 +332,5 @@ def shard_optimizer(optimizer, shard_fn: Optional[Callable] = None):
                 if hasattr(v, "sharding"):
                     st[k] = shard_fn(k, p, v)
     return optimizer
+
+from .engine_api import Engine  # noqa: E402,F401

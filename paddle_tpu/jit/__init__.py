@@ -249,6 +249,13 @@ class TrainStep:
                           for p in self._params]
         self._compiled = jax.jit(self._step,
                                  donate_argnums=(0, 1) if donate else ())
+        # FLAGS_check_nan_inf variant: same step + per-grad finite flags
+        # (covers the compiled path the eager apply_op hook can't see —
+        # reference nan_inf_utils_detail checks inside every kernel launch).
+        # NO donation: on a detected NaN we raise BEFORE rebinding state, and
+        # the old params/opt-state must still be alive.
+        self._compiled_checked = jax.jit(
+            functools.partial(self._step, check_numerics=True))
 
     # -- functional pieces -------------------------------------------------
     def _clip_grads(self, grads):
@@ -276,7 +283,8 @@ class TrainStep:
             return [jnp.clip(g, clip.min, clip.max) for g in grads]
         raise NotImplementedError(f"clip {type(clip)} in TrainStep")
 
-    def _step(self, param_arrays, opt_states, buffer_arrays, key, lr, batch_arrays):
+    def _step(self, param_arrays, opt_states, buffer_arrays, key, lr, batch_arrays,
+              check_numerics: bool = False):
         masters = [st.pop("@master", None) for st in opt_states]
         compute_params = [m if m is not None else p
                           for m, p in zip(masters, param_arrays)]
@@ -290,6 +298,10 @@ class TrainStep:
             return loss_t._value.astype(jnp.float32), new_buf
 
         (loss, new_buf), grads = jax.value_and_grad(loss_of, has_aux=True)(compute_params)
+        finite = None
+        if check_numerics:
+            finite = jnp.stack([jnp.isfinite(loss)] +
+                               [jnp.all(jnp.isfinite(g)) for g in grads])
         grads = self._clip_grads(grads)
         new_params, new_states = [], []
         for i, (p_arr, g, st) in enumerate(zip(compute_params, grads, opt_states)):
@@ -306,6 +318,8 @@ class TrainStep:
                 np_ = np_.astype(param_arrays[i].dtype)
             new_params.append(np_)
             new_states.append(ns)
+        if check_numerics:
+            return loss, new_params, new_states, new_buf, finite
         return loss, new_params, new_states, new_buf
 
     # -- state marshalling -------------------------------------------------
@@ -319,13 +333,26 @@ class TrainStep:
         return states
 
     def __call__(self, *batch) -> Tensor:
+        from ..framework.flags import get_flags
+
         states = self._opt_states()
         param_arrays = [p._value for p in self._params]
         buffer_arrays = [b._value for b in self._buffers]
         batch_arrays = [b._value if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        loss, new_params, new_states, new_buf = self._compiled(
-            param_arrays, states, buffer_arrays, next_key(), lr, batch_arrays)
+        if get_flags("check_nan_inf")["check_nan_inf"]:
+            loss, new_params, new_states, new_buf, finite = self._compiled_checked(
+                param_arrays, states, buffer_arrays, next_key(), lr, batch_arrays)
+            flags = list(map(bool, finite))
+            if not all(flags):
+                bad = (["loss"] if not flags[0] else []) + [
+                    self._param_names[i] for i, ok in enumerate(flags[1:]) if not ok]
+                raise RuntimeError(
+                    "check_nan_inf: non-finite values in compiled train step "
+                    f"(gradients of: {', '.join(bad)})")
+        else:
+            loss, new_params, new_states, new_buf = self._compiled(
+                param_arrays, states, buffer_arrays, next_key(), lr, batch_arrays)
         for p, arr, st in zip(self._params, new_params, new_states):
             mw = st.pop("@master", None)
             if mw is not None:

@@ -36,6 +36,11 @@ def _maybe_check_nan(name: str, vals) -> None:
     if not get_flags("check_nan_inf")["check_nan_inf"]:
         return
     for v in vals if isinstance(vals, (tuple, list)) else (vals,):
+        if _is_tracer(v):
+            # inside a traced (jit) region there is no concrete value to
+            # inspect — the compiled-path check lives in TrainStep's
+            # check_numerics variant (jit/__init__.py)
+            continue
         if jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating):
             arr = np.asarray(v)
             if not np.isfinite(arr).all():

