@@ -42,6 +42,8 @@ from . import profiler  # noqa: E402
 from . import hapi  # noqa: E402
 from .hapi import Model  # noqa: E402
 from .hapi import callbacks  # noqa: E402
+from . import distribution  # noqa: E402
+from . import fft  # noqa: E402
 from .framework.io import load, save  # noqa: E402
 
 

@@ -48,7 +48,14 @@ class Engine:
 
         hcg = get_hybrid_communicate_group()
         if hcg is None:
-            fleet.init(is_collective=True, strategy=self._strategy)
+            strategy = self._strategy
+            if strategy is None:
+                # no strategy → pure data parallel over every visible device
+                strategy = fleet.DistributedStrategy()
+                strategy.hybrid_configs = {"dp_degree": -1, "mp_degree": 1,
+                                           "pp_degree": 1, "sharding_degree": 1,
+                                           "sep_degree": 1}
+            fleet.init(is_collective=True, strategy=strategy)
             hcg = get_hybrid_communicate_group()
         return hcg
 
@@ -77,14 +84,14 @@ class Engine:
         return self
 
     # -- loops -------------------------------------------------------------
-    def _loader(self, data, batch_size, shuffle):
+    def _loader(self, data, batch_size, shuffle, collate_fn=None):
         from ...io import DataLoader, Dataset, DistributedBatchSampler
 
         if data is None or not isinstance(data, (Dataset,)):
             return data
         sampler = DistributedBatchSampler(data, batch_size=batch_size,
                                           shuffle=shuffle)
-        return DataLoader(data, batch_sampler=sampler)
+        return DataLoader(data, batch_sampler=sampler, collate_fn=collate_fn)
 
     def fit(self, train_data=None, train_sample_split=None, batch_size: int = 1,
             epochs: int = 1, steps_per_epoch: Optional[int] = None,
@@ -92,7 +99,9 @@ class Engine:
             save_freq: int = 1, valid_data=None, valid_freq: int = 1,
             collate_fn=None, callbacks=None, verbose: int = 1):
         self.prepare(mode="train")
-        loader = self._loader(train_data, batch_size, shuffle=True)
+        if callbacks is not None:
+            raise NotImplementedError("Engine callbacks: use hapi.Model for the callback stack")
+        loader = self._loader(train_data, batch_size, shuffle=True, collate_fn=collate_fn)
         # metrics are computed by evaluate(): the fused train step does not
         # fetch intermediate outputs (that's what makes it one XLA program)
         for epoch in range(epochs):
@@ -118,7 +127,9 @@ class Engine:
                  verbose: int = 1) -> dict:
         from ...autograd import no_grad
 
-        loader = self._loader(valid_data, batch_size, shuffle=False)
+        if callbacks is not None:
+            raise NotImplementedError("Engine callbacks: use hapi.Model for the callback stack")
+        loader = self._loader(valid_data, batch_size, shuffle=False, collate_fn=collate_fn)
         self._model.eval()
         for m in self._metrics:
             m.reset()
@@ -148,7 +159,9 @@ class Engine:
                 verbose: int = 0) -> List[np.ndarray]:
         from ...autograd import no_grad
 
-        loader = self._loader(test_data, batch_size, shuffle=False)
+        if callbacks is not None:
+            raise NotImplementedError("Engine callbacks: use hapi.Model for the callback stack")
+        loader = self._loader(test_data, batch_size, shuffle=False, collate_fn=collate_fn)
         self._model.eval()
         outs = []
         with no_grad():
@@ -184,6 +197,13 @@ class Engine:
         from ..checkpoint import load_state_dict
 
         state = dict(self._model.state_dict())
+        if not strict:
+            # load only the intersection with the checkpoint's saved keys
+            import pickle
+
+            with open(os.path.join(path, "metadata"), "rb") as f:
+                saved = set(pickle.load(f).state_dict_metadata)
+            state = {k: v for k, v in state.items() if k in saved}
         load_state_dict(state, path)
         self._model.set_state_dict(state)
         opt_path = os.path.join(path, "optimizer.pdopt")

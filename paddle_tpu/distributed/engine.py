@@ -98,6 +98,18 @@ class DistributedTrainStep(TrainStep):
             out_shardings=(None, self._param_shardings, self._state_shardings,
                            self._buffer_shardings),
         )
+        # the check_nan_inf variant must pin the SAME shardings (else XLA is
+        # free to re-lay state out and the next unchecked step rejects it);
+        # still no donation — state must survive a raise
+        import functools as _ft
+
+        self._compiled_checked = jax.jit(
+            _ft.partial(self._step, check_numerics=True),
+            in_shardings=(self._param_shardings, self._state_shardings,
+                          self._buffer_shardings, None, None, self._batch_shardings_holder),
+            out_shardings=(None, self._param_shardings, self._state_shardings,
+                           self._buffer_shardings, None),
+        )
 
     # -- sharding rules ---------------------------------------------------
     def _param_spec(self, p: Tensor) -> P:

@@ -120,21 +120,32 @@ class DataParallel(Layer):
         return self._layers.parameters(include_sublayers)
 
     def _grad_buckets(self):
-        """Group params-with-grads into ~comm_buffer_size-MB buckets in
-        reverse parameter order (grads become ready back-to-front during
-        backward — the reference buckets the same way, reducer.h:88)."""
-        bucket, size, out = [], 0, []
+        """Group params-with-grads into ~comm_buffer_size-MB buckets, PER
+        GRAD DTYPE (the reference fuses per-dtype so bf16 buckets transfer
+        as bf16, reducer.h:88), in reverse parameter order (grads become
+        ready back-to-front during backward)."""
+        by_dtype: dict = {}
+        order: list = []
         for p in reversed(self._layers.parameters()):
             if p._grad is None:
                 continue
-            nbytes = int(np.prod(p._grad.shape) or 1) * p._grad._value.dtype.itemsize
-            if bucket and size + nbytes > self._comm_buffer_bytes:
+            dt = str(p._grad._value.dtype)
+            if dt not in by_dtype:
+                by_dtype[dt] = []
+                order.append(dt)
+            by_dtype[dt].append(p)
+        out = []
+        for dt in order:
+            bucket, size = [], 0
+            for p in by_dtype[dt]:
+                nbytes = int(np.prod(p._grad.shape) or 1) * p._grad._value.dtype.itemsize
+                if bucket and size + nbytes > self._comm_buffer_bytes:
+                    out.append(bucket)
+                    bucket, size = [], 0
+                bucket.append(p)
+                size += nbytes
+            if bucket:
                 out.append(bucket)
-                bucket, size = [], 0
-            bucket.append(p)
-            size += nbytes
-        if bucket:
-            out.append(bucket)
         return out
 
     def apply_collective_grads(self) -> None:
@@ -170,11 +181,8 @@ class DataParallel(Layer):
         mesh = group.mesh
         sharding = NamedSharding(mesh, P(group.axes))
         for bucket in self._grad_buckets():
-            # pack in the widest grad dtype so f64 grads don't truncate
-            acc_dt = np.result_type(np.float32,
-                                    *[np.dtype(str(p._grad._value.dtype))
-                                      for p in bucket])
-            flats = [jnp.ravel(p._grad._value).astype(acc_dt) for p in bucket]
+            # buckets are single-dtype: transfer in the grads' native dtype
+            flats = [jnp.ravel(p._grad._value) for p in bucket]
             sizes = [int(f.shape[0]) for f in flats]
             local = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
             gshape = (group.nranks, int(local.shape[0]))

@@ -1,0 +1,500 @@
+"""Probability distributions (reference `python/paddle/distribution/`:
+distribution.py:36 Distribution base, normal.py, uniform.py, categorical.py,
+bernoulli.py, beta.py, dirichlet.py, exponential.py, laplace.py, gamma.py,
+kl.py kl_divergence/register_kl).
+
+TPU-native: sampling draws from the framework PRNG (`framework.random`
+threaded keys — works eagerly and under jit via key_scope); log_prob/entropy
+are pure jnp through apply_op, so densities are differentiable and
+reparameterized samples (``rsample``) carry gradients to the parameters."""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.random import next_key
+from ..tensor.tensor import Tensor, apply_op
+from ..tensor._op_utils import ensure_tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Beta", "Dirichlet", "Exponential", "Gamma", "Laplace",
+           "kl_divergence", "register_kl"]
+
+
+def _shape(sample_shape) -> Tuple[int, ...]:
+    if sample_shape is None:
+        return ()
+    if isinstance(sample_shape, (int,)):
+        return (int(sample_shape),)
+    return tuple(int(s) for s in sample_shape)
+
+
+class Distribution:
+    """Base class (reference distribution.py:36)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self) -> Tuple[int, ...]:
+        return self._batch_shape
+
+    @property
+    def event_shape(self) -> Tuple[int, ...]:
+        return self._event_shape
+
+    @property
+    def mean(self) -> Tensor:
+        raise NotImplementedError
+
+    @property
+    def variance(self) -> Tensor:
+        raise NotImplementedError
+
+    def sample(self, shape=()) -> Tensor:
+        """Non-differentiable draw (stop_gradient=True, as the reference)."""
+        out = self.rsample(shape)
+        out.stop_gradient = True
+        return Tensor(out._value, stop_gradient=True)
+
+    def rsample(self, shape=()) -> Tensor:
+        raise NotImplementedError
+
+    def log_prob(self, value) -> Tensor:
+        raise NotImplementedError
+
+    def prob(self, value) -> Tensor:
+        lp = self.log_prob(value)
+        return apply_op("exp", jnp.exp, (lp,))
+
+    def entropy(self) -> Tensor:
+        raise NotImplementedError
+
+    def kl_divergence(self, other: "Distribution") -> Tensor:
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    """Gaussian (reference normal.py)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = ensure_tensor(loc).astype("float32")
+        self.scale = ensure_tensor(scale).astype("float32")
+        super().__init__(tuple(jnp.broadcast_shapes(self.loc.shape, self.scale.shape)))
+
+    @property
+    def mean(self) -> Tensor:
+        return self.loc
+
+    @property
+    def variance(self) -> Tensor:
+        return apply_op("square", jnp.square, (self.scale,))
+
+    @property
+    def stddev(self) -> Tensor:
+        return self.scale
+
+    def rsample(self, shape=()) -> Tensor:
+        shape = _shape(shape) + self.batch_shape
+        eps = jax.random.normal(next_key(), shape, jnp.float32)
+        return apply_op("normal_rsample", lambda l, s: l + s * eps,
+                        (self.loc, self.scale))
+
+    def log_prob(self, value) -> Tensor:
+        value = ensure_tensor(value)
+
+        def fn(v, l, s):
+            var = jnp.square(s)
+            return -jnp.square(v - l) / (2 * var) - jnp.log(s) \
+                - 0.5 * math.log(2 * math.pi)
+
+        return apply_op("normal_log_prob", fn, (value, self.loc, self.scale))
+
+    def entropy(self) -> Tensor:
+        return apply_op("normal_entropy",
+                        lambda s: 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s),
+                        (self.scale,))
+
+    def cdf(self, value) -> Tensor:
+        value = ensure_tensor(value)
+        return apply_op("normal_cdf",
+                        lambda v, l, s: 0.5 * (1 + jax.lax.erf((v - l) / (s * math.sqrt(2)))),
+                        (value, self.loc, self.scale))
+
+
+class Uniform(Distribution):
+    """U[low, high) (reference uniform.py)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = ensure_tensor(low).astype("float32")
+        self.high = ensure_tensor(high).astype("float32")
+        super().__init__(tuple(jnp.broadcast_shapes(self.low.shape, self.high.shape)))
+
+    @property
+    def mean(self) -> Tensor:
+        return apply_op("uniform_mean", lambda lo, hi: (lo + hi) / 2,
+                        (self.low, self.high))
+
+    @property
+    def variance(self) -> Tensor:
+        return apply_op("uniform_var", lambda lo, hi: jnp.square(hi - lo) / 12,
+                        (self.low, self.high))
+
+    def rsample(self, shape=()) -> Tensor:
+        shape = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(next_key(), shape, jnp.float32)
+        return apply_op("uniform_rsample", lambda lo, hi: lo + (hi - lo) * u,
+                        (self.low, self.high))
+
+    def log_prob(self, value) -> Tensor:
+        value = ensure_tensor(value)
+
+        def fn(v, lo, hi):
+            inside = jnp.logical_and(v >= lo, v < hi)
+            return jnp.where(inside, -jnp.log(hi - lo), -jnp.inf)
+
+        return apply_op("uniform_log_prob", fn, (value, self.low, self.high))
+
+    def entropy(self) -> Tensor:
+        return apply_op("uniform_entropy", lambda lo, hi: jnp.log(hi - lo),
+                        (self.low, self.high))
+
+
+class Categorical(Distribution):
+    """Categorical over unnormalized ``logits`` (reference categorical.py
+    takes logits that are normalized internally)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = ensure_tensor(logits).astype("float32")
+        super().__init__(tuple(self.logits.shape[:-1]))
+        self._n = self.logits.shape[-1]
+
+    @property
+    def probs_t(self) -> Tensor:
+        return apply_op("softmax", lambda lg: jax.nn.softmax(lg, -1), (self.logits,))
+
+    def sample(self, shape=()) -> Tensor:
+        shape = _shape(shape)
+        key = next_key()
+        out = jax.random.categorical(key, self.logits._value,
+                                     shape=shape + self.batch_shape)
+        return Tensor(out, stop_gradient=True)
+
+    def log_prob(self, value) -> Tensor:
+        idx = ensure_tensor(value)._value.astype(jnp.int32)
+
+        def fn(lg):
+            logp = jax.nn.log_softmax(lg, -1)
+            return jnp.take_along_axis(logp, idx[..., None], -1)[..., 0]
+
+        return apply_op("categorical_log_prob", fn, (self.logits,))
+
+    def probs(self, value=None) -> Tensor:
+        if value is None:
+            return self.probs_t
+        return self.prob(value)
+
+    def entropy(self) -> Tensor:
+        def fn(lg):
+            logp = jax.nn.log_softmax(lg, -1)
+            return -jnp.sum(jnp.exp(logp) * logp, -1)
+
+        return apply_op("categorical_entropy", fn, (self.logits,))
+
+
+class Bernoulli(Distribution):
+    """Bernoulli over probability ``probs`` (reference bernoulli.py:50)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = ensure_tensor(probs).astype("float32")
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self) -> Tensor:
+        return self.probs
+
+    @property
+    def variance(self) -> Tensor:
+        return apply_op("bern_var", lambda p: p * (1 - p), (self.probs,))
+
+    def sample(self, shape=()) -> Tensor:
+        shape = _shape(shape) + self.batch_shape
+        out = jax.random.bernoulli(next_key(), self.probs._value, shape)
+        return Tensor(out.astype(jnp.float32), stop_gradient=True)
+
+    def log_prob(self, value) -> Tensor:
+        value = ensure_tensor(value)
+
+        def fn(v, p):
+            eps = 1e-7
+            pc = jnp.clip(p, eps, 1 - eps)
+            return v * jnp.log(pc) + (1 - v) * jnp.log1p(-pc)
+
+        return apply_op("bern_log_prob", fn, (value, self.probs))
+
+    def entropy(self) -> Tensor:
+        def fn(p):
+            eps = 1e-7
+            pc = jnp.clip(p, eps, 1 - eps)
+            return -(pc * jnp.log(pc) + (1 - pc) * jnp.log1p(-pc))
+
+        return apply_op("bern_entropy", fn, (self.probs,))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = ensure_tensor(alpha).astype("float32")
+        self.beta = ensure_tensor(beta).astype("float32")
+        super().__init__(tuple(jnp.broadcast_shapes(self.alpha.shape, self.beta.shape)))
+
+    @property
+    def mean(self) -> Tensor:
+        return apply_op("beta_mean", lambda a, b: a / (a + b), (self.alpha, self.beta))
+
+    @property
+    def variance(self) -> Tensor:
+        return apply_op("beta_var",
+                        lambda a, b: a * b / (jnp.square(a + b) * (a + b + 1)),
+                        (self.alpha, self.beta))
+
+    def rsample(self, shape=()) -> Tensor:
+        shape = _shape(shape) + self.batch_shape
+        key = next_key()
+
+        def fn(a, b):
+            return jax.random.beta(key, a, b, shape)
+
+        return apply_op("beta_rsample", fn, (self.alpha, self.beta))
+
+    def log_prob(self, value) -> Tensor:
+        value = ensure_tensor(value)
+
+        def fn(v, a, b):
+            lbeta = (jax.lax.lgamma(a) + jax.lax.lgamma(b) - jax.lax.lgamma(a + b))
+            return (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - lbeta
+
+        return apply_op("beta_log_prob", fn, (value, self.alpha, self.beta))
+
+    def entropy(self) -> Tensor:
+        def fn(a, b):
+            from jax.scipy.special import digamma
+
+            lbeta = (jax.lax.lgamma(a) + jax.lax.lgamma(b) - jax.lax.lgamma(a + b))
+            return (lbeta - (a - 1) * digamma(a) - (b - 1) * digamma(b)
+                    + (a + b - 2) * digamma(a + b))
+
+        return apply_op("beta_entropy", fn, (self.alpha, self.beta))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = ensure_tensor(concentration).astype("float32")
+        shape = tuple(self.concentration.shape)
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self) -> Tensor:
+        return apply_op("dir_mean", lambda c: c / jnp.sum(c, -1, keepdims=True),
+                        (self.concentration,))
+
+    def rsample(self, shape=()) -> Tensor:
+        key = next_key()
+        shape = _shape(shape) + self.batch_shape
+
+        def fn(c):
+            return jax.random.dirichlet(key, c, shape)
+
+        return apply_op("dir_rsample", fn, (self.concentration,))
+
+    def log_prob(self, value) -> Tensor:
+        value = ensure_tensor(value)
+
+        def fn(v, c):
+            lnorm = jnp.sum(jax.lax.lgamma(c), -1) - jax.lax.lgamma(jnp.sum(c, -1))
+            return jnp.sum((c - 1) * jnp.log(v), -1) - lnorm
+
+        return apply_op("dir_log_prob", fn, (value, self.concentration))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = ensure_tensor(rate).astype("float32")
+        super().__init__(tuple(self.rate.shape))
+
+    @property
+    def mean(self) -> Tensor:
+        return apply_op("exp_mean", lambda r: 1.0 / r, (self.rate,))
+
+    @property
+    def variance(self) -> Tensor:
+        return apply_op("exp_var", lambda r: 1.0 / jnp.square(r), (self.rate,))
+
+    def rsample(self, shape=()) -> Tensor:
+        shape = _shape(shape) + self.batch_shape
+        e = jax.random.exponential(next_key(), shape, jnp.float32)
+        return apply_op("exp_rsample", lambda r: e / r, (self.rate,))
+
+    def log_prob(self, value) -> Tensor:
+        value = ensure_tensor(value)
+        return apply_op("exp_log_prob",
+                        lambda v, r: jnp.where(v >= 0, jnp.log(r) - r * v, -jnp.inf),
+                        (value, self.rate))
+
+    def entropy(self) -> Tensor:
+        return apply_op("exp_entropy", lambda r: 1.0 - jnp.log(r), (self.rate,))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = ensure_tensor(concentration).astype("float32")
+        self.rate = ensure_tensor(rate).astype("float32")
+        super().__init__(tuple(jnp.broadcast_shapes(self.concentration.shape,
+                                                    self.rate.shape)))
+
+    @property
+    def mean(self) -> Tensor:
+        return apply_op("gamma_mean", lambda c, r: c / r,
+                        (self.concentration, self.rate))
+
+    @property
+    def variance(self) -> Tensor:
+        return apply_op("gamma_var", lambda c, r: c / jnp.square(r),
+                        (self.concentration, self.rate))
+
+    def rsample(self, shape=()) -> Tensor:
+        key = next_key()
+        shape = _shape(shape) + self.batch_shape
+
+        def fn(c, r):
+            return jax.random.gamma(key, c, shape) / r
+
+        return apply_op("gamma_rsample", fn, (self.concentration, self.rate))
+
+    def log_prob(self, value) -> Tensor:
+        value = ensure_tensor(value)
+
+        def fn(v, c, r):
+            return (c * jnp.log(r) + (c - 1) * jnp.log(v) - r * v
+                    - jax.lax.lgamma(c))
+
+        return apply_op("gamma_log_prob", fn, (value, self.concentration, self.rate))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = ensure_tensor(loc).astype("float32")
+        self.scale = ensure_tensor(scale).astype("float32")
+        super().__init__(tuple(jnp.broadcast_shapes(self.loc.shape, self.scale.shape)))
+
+    @property
+    def mean(self) -> Tensor:
+        return self.loc
+
+    @property
+    def variance(self) -> Tensor:
+        return apply_op("lap_var", lambda s: 2 * jnp.square(s), (self.scale,))
+
+    def rsample(self, shape=()) -> Tensor:
+        shape = _shape(shape) + self.batch_shape
+        u = jax.random.laplace(next_key(), shape, jnp.float32)
+        return apply_op("lap_rsample", lambda l, s: l + s * u, (self.loc, self.scale))
+
+    def log_prob(self, value) -> Tensor:
+        value = ensure_tensor(value)
+        return apply_op("lap_log_prob",
+                        lambda v, l, s: -jnp.abs(v - l) / s - jnp.log(2 * s),
+                        (value, self.loc, self.scale))
+
+    def entropy(self) -> Tensor:
+        return apply_op("lap_entropy", lambda s: 1 + jnp.log(2 * s), (self.scale,))
+
+
+# ---------------------------------------------------------------------------
+# KL divergence registry (reference kl.py register_kl/kl_divergence)
+# ---------------------------------------------------------------------------
+
+_KL_REGISTRY: Dict[Tuple[Type, Type], callable] = {}
+
+
+def register_kl(cls_p: Type, cls_q: Type):
+    def deco(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    for (cp, cq), fn in _KL_REGISTRY.items():
+        if isinstance(p, cp) and isinstance(q, cq):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__}); "
+        "add one with @register_kl")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p: Normal, q: Normal) -> Tensor:
+    def fn(pl, ps, ql, qs):
+        vr = jnp.square(ps / qs)
+        return 0.5 * (vr + jnp.square((pl - ql) / qs) - 1 - jnp.log(vr))
+
+    return apply_op("kl_normal", fn, (p.loc, p.scale, q.loc, q.scale))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p: Uniform, q: Uniform) -> Tensor:
+    def fn(plo, phi, qlo, qhi):
+        inside = jnp.logical_and(qlo <= plo, phi <= qhi)
+        return jnp.where(inside, jnp.log((qhi - qlo) / (phi - plo)), jnp.inf)
+
+    return apply_op("kl_uniform", fn, (p.low, p.high, q.low, q.high))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p: Categorical, q: Categorical) -> Tensor:
+    def fn(pl, ql):
+        lp = jax.nn.log_softmax(pl, -1)
+        lq = jax.nn.log_softmax(ql, -1)
+        return jnp.sum(jnp.exp(lp) * (lp - lq), -1)
+
+    return apply_op("kl_categorical", fn, (p.logits, q.logits))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p: Bernoulli, q: Bernoulli) -> Tensor:
+    def fn(pp, qp):
+        eps = 1e-7
+        pp = jnp.clip(pp, eps, 1 - eps)
+        qp = jnp.clip(qp, eps, 1 - eps)
+        return pp * (jnp.log(pp) - jnp.log(qp)) + \
+            (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp))
+
+    return apply_op("kl_bernoulli", fn, (p.probs, q.probs))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p: Exponential, q: Exponential) -> Tensor:
+    return apply_op("kl_exponential",
+                    lambda pr, qr: jnp.log(pr / qr) + qr / pr - 1,
+                    (p.rate, q.rate))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p: Beta, q: Beta) -> Tensor:
+    def fn(pa, pb, qa, qb):
+        from jax.scipy.special import digamma
+
+        def lbeta(a, b):
+            return jax.lax.lgamma(a) + jax.lax.lgamma(b) - jax.lax.lgamma(a + b)
+
+        return (lbeta(qa, qb) - lbeta(pa, pb)
+                + (pa - qa) * digamma(pa) + (pb - qb) * digamma(pb)
+                + (qa - pa + qb - pb) * digamma(pa + pb))
+
+    return apply_op("kl_beta", fn, (p.alpha, p.beta, q.alpha, q.beta))

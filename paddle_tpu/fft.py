@@ -1,0 +1,87 @@
+"""paddle.fft parity (reference `python/paddle/fft.py` → pocketfft kernels).
+On TPU the FFTs are XLA's native ducted FFT ops (jnp.fft), differentiable
+through apply_op like every other op. ``norm``: "backward" (default),
+"ortho", "forward" — paddle's conventions match numpy's."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from .tensor.tensor import Tensor, apply_op
+from .tensor._op_utils import ensure_tensor
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+           "fft2", "ifft2", "rfft2", "irfft2",
+           "fftn", "ifftn", "rfftn", "irfftn",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _wrap1(name, jfn):
+    def op(x, n: Optional[int] = None, axis: int = -1, norm: str = "backward",
+           name=None) -> Tensor:
+        x = ensure_tensor(x)
+        return apply_op(name, lambda v: jfn(v, n=n, axis=axis, norm=norm), (x,))
+
+    op.__name__ = name
+    op.__doc__ = f"paddle.fft.{name} (reference fft.py; jnp.fft.{name} on XLA)."
+    return op
+
+
+def _wrapn(name, jfn, s_kw="s"):
+    def op(x, s: Optional[Sequence[int]] = None, axes=None, norm: str = "backward",
+           name=None) -> Tensor:
+        x = ensure_tensor(x)
+        kwargs = {s_kw: s, "axes": axes, "norm": norm}
+        return apply_op(name, lambda v: jfn(v, **kwargs), (x,))
+
+    op.__name__ = name
+    return op
+
+
+fft = _wrap1("fft", jnp.fft.fft)
+ifft = _wrap1("ifft", jnp.fft.ifft)
+rfft = _wrap1("rfft", jnp.fft.rfft)
+irfft = _wrap1("irfft", jnp.fft.irfft)
+hfft = _wrap1("hfft", jnp.fft.hfft)
+ihfft = _wrap1("ihfft", jnp.fft.ihfft)
+
+fftn = _wrapn("fftn", jnp.fft.fftn)
+ifftn = _wrapn("ifftn", jnp.fft.ifftn)
+rfftn = _wrapn("rfftn", jnp.fft.rfftn)
+irfftn = _wrapn("irfftn", jnp.fft.irfftn)
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None) -> Tensor:
+    return fftn(x, s=s, axes=axes, norm=norm)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None) -> Tensor:
+    return ifftn(x, s=s, axes=axes, norm=norm)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None) -> Tensor:
+    return rfftn(x, s=s, axes=axes, norm=norm)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None) -> Tensor:
+    return irfftn(x, s=s, axes=axes, norm=norm)
+
+
+def fftfreq(n: int, d: float = 1.0, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.fft.fftfreq(n, d).astype(dtype or jnp.float32))
+
+
+def rfftfreq(n: int, d: float = 1.0, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype or jnp.float32))
+
+
+def fftshift(x, axes=None, name=None) -> Tensor:
+    return apply_op("fftshift", lambda v: jnp.fft.fftshift(v, axes),
+                    (ensure_tensor(x),))
+
+
+def ifftshift(x, axes=None, name=None) -> Tensor:
+    return apply_op("ifftshift", lambda v: jnp.fft.ifftshift(v, axes),
+                    (ensure_tensor(x),))

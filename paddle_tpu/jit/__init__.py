@@ -312,6 +312,7 @@ class TrainStep:
             np_, ns = self.optimizer._update_rule(
                 p_arr, g.astype(p_arr.dtype), st, lr * self._lr_mults[i],
                 param_meta=self._params[i])
+            ns = {**st, **ns}  # keep untouched slots: stable state pytree
             if masters[i] is not None:
                 ns = dict(ns)
                 ns["@master"] = np_

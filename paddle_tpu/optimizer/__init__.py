@@ -124,8 +124,12 @@ class Optimizer:
             lr_mult = getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
             pv = self._master(p)
             gv = g._value.astype(pv.dtype)
-            new_p, new_state = self._update_rule(pv, gv, self._state_for(p),
+            st = self._state_for(p)
+            new_p, new_state = self._update_rule(pv, gv, st,
                                                  base_lr * lr_mult, param_meta=p)
+            # rules may return only the slots they touched; untouched keys
+            # (e.g. "@t") must survive so the state pytree keeps its shape
+            new_state = {**st, **new_state}
             if self._multi_precision and p._value.dtype in (jnp.bfloat16, jnp.float16):
                 self._master_weights[id(p)] = new_p
                 p._value = new_p.astype(p._value.dtype)

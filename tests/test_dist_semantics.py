@@ -300,3 +300,57 @@ class TestAutoParallelEngine:
             Engine().prepare()
         with pytest.raises(RuntimeError, match="optimizer"):
             Engine(nn.Linear(2, 2), nn.MSELoss()).prepare()
+
+
+class TestDistStepStateStability:
+    """Round-trip stability bugs found by review: checked-variant sharding
+    drift and optimizer slots with partial update-rule returns."""
+
+    def test_nan_check_step_keeps_shardings(self, mesh_22):
+        from paddle_tpu.distributed.engine import DistributedTrainStep
+
+        net = nn.Linear(16, 16)
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=net.parameters())
+        step = DistributedTrainStep(net, lambda m, x, y: F.mse_loss(m(x), y),
+                                    opt, mesh_22, sharding_stage=1)
+        x = paddle.to_tensor(np.ones((8, 16), np.float32))
+        step(x, x)
+        paddle.set_flags({"check_nan_inf": True})
+        try:
+            step(x, x)  # checked variant must pin the same shardings
+        finally:
+            paddle.set_flags({"check_nan_inf": False})
+        step(x, x)  # unchecked again: no sharding mismatch
+
+    def test_momentum_multi_step(self, mesh_22):
+        from paddle_tpu.distributed.engine import DistributedTrainStep
+
+        net = nn.Linear(8, 8)
+        opt = paddle.optimizer.Momentum(learning_rate=0.01,
+                                        parameters=net.parameters())
+        step = DistributedTrainStep(net, lambda m, x, y: F.mse_loss(m(x), y),
+                                    opt, mesh_22, sharding_stage=1)
+        x = paddle.to_tensor(np.ones((8, 8), np.float32))
+        l0 = float(step(x, x * 0).numpy())
+        l1 = float(step(x, x * 0).numpy())  # was: pytree '@t' key crash
+        l2 = float(step(x, x * 0).numpy())
+        assert l2 < l0
+
+    def test_engine_default_strategy_multidevice(self):
+        """Engine() with NO strategy must work on a multi-device host."""
+        from paddle_tpu.distributed.auto_parallel import Engine
+        from paddle_tpu.distributed.topology import (
+            get_hybrid_communicate_group, set_hybrid_communicate_group)
+
+        saved = get_hybrid_communicate_group()
+        set_hybrid_communicate_group(None)
+        try:
+            net = nn.Linear(8, 8)
+            opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=net.parameters())
+            eng = Engine(net, nn.MSELoss(), opt)
+            eng.prepare()
+            assert eng._train_step.mesh.shape["data"] == 8  # dp over all
+        finally:
+            set_hybrid_communicate_group(saved)

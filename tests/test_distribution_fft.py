@@ -1,0 +1,186 @@
+"""paddle.distribution + paddle.fft tests (reference test strategy:
+test/distribution/test_distribution_*.py parameterized moment/log_prob
+checks vs scipy; test/legacy_test/test_fft.py vs numpy.fft)."""
+
+import numpy as np
+import pytest
+from scipy import stats
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import (Bernoulli, Beta, Categorical, Dirichlet,
+                                     Exponential, Gamma, Laplace, Normal,
+                                     Uniform, kl_divergence, register_kl)
+
+
+def t(x):
+    return paddle.to_tensor(np.asarray(x, np.float32))
+
+
+class TestMomentsAndLogProb:
+    """log_prob/mean/variance vs scipy closed forms."""
+
+    CASES = [
+        (lambda: Normal(t(1.5), t(2.0)), stats.norm(1.5, 2.0), 0.7),
+        (lambda: Uniform(t(-1.0), t(3.0)), stats.uniform(-1.0, 4.0), 0.5),
+        (lambda: Exponential(t(2.0)), stats.expon(scale=0.5), 0.3),
+        (lambda: Beta(t(2.0), t(3.0)), stats.beta(2.0, 3.0), 0.4),
+        (lambda: Gamma(t(3.0), t(2.0)), stats.gamma(3.0, scale=0.5), 1.2),
+        (lambda: Laplace(t(0.5), t(1.5)), stats.laplace(0.5, 1.5), 0.9),
+    ]
+
+    @pytest.mark.parametrize("make,ref,point", CASES,
+                             ids=["normal", "uniform", "exponential", "beta",
+                                  "gamma", "laplace"])
+    def test_log_prob_matches_scipy(self, make, ref, point):
+        d = make()
+        got = float(d.log_prob(t(point)).numpy())
+        assert got == pytest.approx(ref.logpdf(point), rel=1e-4)
+
+    @pytest.mark.parametrize("make,ref,point", CASES,
+                             ids=["normal", "uniform", "exponential", "beta",
+                                  "gamma", "laplace"])
+    def test_moments(self, make, ref, point):
+        d = make()
+        assert float(d.mean.numpy()) == pytest.approx(ref.mean(), rel=1e-5)
+        if hasattr(d, "variance"):
+            assert float(d.variance.numpy()) == pytest.approx(ref.var(), rel=1e-5)
+
+    def test_sample_statistics(self):
+        paddle.seed(0)
+        d = Normal(t(2.0), t(0.5))
+        s = d.sample([20000]).numpy()
+        assert s.mean() == pytest.approx(2.0, abs=0.02)
+        assert s.std() == pytest.approx(0.5, abs=0.02)
+        assert d.sample([3, 4]).shape == [3, 4]
+
+    def test_rsample_carries_gradient(self):
+        paddle.seed(0)
+        loc = t(0.0)
+        loc.stop_gradient = False
+        d = Normal(loc, t(1.0))
+        s = d.rsample([64])
+        s.mean().backward()
+        assert loc.grad is not None
+        assert float(loc.grad.numpy()) == pytest.approx(1.0, rel=1e-5)
+
+    def test_entropy_normal_uniform(self):
+        d = Normal(t(0.0), t(2.0))
+        assert float(d.entropy().numpy()) == pytest.approx(stats.norm(0, 2).entropy(),
+                                                           rel=1e-5)
+        u = Uniform(t(0.0), t(4.0))
+        assert float(u.entropy().numpy()) == pytest.approx(np.log(4.0), rel=1e-5)
+
+    def test_uniform_log_prob_outside_support(self):
+        u = Uniform(t(0.0), t(1.0))
+        assert float(u.log_prob(t(2.0)).numpy()) == -np.inf
+
+
+class TestCategoricalBernoulliDirichlet:
+    def test_categorical_log_prob_entropy(self):
+        logits = np.log(np.array([0.2, 0.3, 0.5], np.float32))
+        c = Categorical(t(logits))
+        np.testing.assert_allclose(c.probs_t.numpy(), [0.2, 0.3, 0.5], rtol=1e-5)
+        assert float(c.log_prob(paddle.to_tensor(np.array(2))).numpy()) == \
+            pytest.approx(np.log(0.5), rel=1e-5)
+        assert float(c.entropy().numpy()) == pytest.approx(
+            stats.entropy([0.2, 0.3, 0.5]), rel=1e-4)
+
+    def test_categorical_sampling_frequencies(self):
+        paddle.seed(0)
+        c = Categorical(t(np.log([0.1, 0.9])))
+        s = c.sample([10000]).numpy()
+        assert s.mean() == pytest.approx(0.9, abs=0.02)
+
+    def test_bernoulli(self):
+        b = Bernoulli(t(0.3))
+        assert float(b.mean.numpy()) == pytest.approx(0.3)
+        assert float(b.variance.numpy()) == pytest.approx(0.21)
+        assert float(b.log_prob(t(1.0)).numpy()) == pytest.approx(np.log(0.3), rel=1e-4)
+        assert float(b.entropy().numpy()) == pytest.approx(
+            stats.bernoulli(0.3).entropy(), rel=1e-4)
+
+    def test_dirichlet(self):
+        conc = np.array([1.0, 2.0, 3.0], np.float32)
+        d = Dirichlet(t(conc))
+        np.testing.assert_allclose(d.mean.numpy(), conc / conc.sum(), rtol=1e-5)
+        x = np.array([0.2, 0.3, 0.5], np.float32)
+        assert float(d.log_prob(t(x)).numpy()) == pytest.approx(
+            stats.dirichlet(conc).logpdf(x), rel=1e-4)
+        paddle.seed(0)
+        s = d.rsample([1000]).numpy()
+        np.testing.assert_allclose(s.sum(-1), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(s.mean(0), conc / conc.sum(), atol=0.02)
+
+
+class TestKL:
+    def test_normal_normal(self):
+        p, q = Normal(t(0.0), t(1.0)), Normal(t(1.0), t(2.0))
+        expect = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+        assert float(kl_divergence(p, q).numpy()) == pytest.approx(expect, rel=1e-5)
+        assert float(kl_divergence(p, p).numpy()) == pytest.approx(0.0, abs=1e-7)
+
+    def test_categorical_vs_scipy(self):
+        p = Categorical(t(np.log([0.3, 0.7])))
+        q = Categorical(t(np.log([0.5, 0.5])))
+        expect = stats.entropy([0.3, 0.7], [0.5, 0.5])
+        assert float(kl_divergence(p, q).numpy()) == pytest.approx(expect, rel=1e-4)
+
+    def test_montecarlo_agreement_beta(self):
+        paddle.seed(0)
+        p, q = Beta(t(2.0), t(5.0)), Beta(t(3.0), t(3.0))
+        analytic = float(kl_divergence(p, q).numpy())
+        s = p.sample([50000])
+        mc = float((p.log_prob(s) - q.log_prob(s)).mean().numpy())
+        assert analytic == pytest.approx(mc, abs=0.02)
+
+    def test_unregistered_raises(self):
+        with pytest.raises(NotImplementedError, match="register_kl"):
+            kl_divergence(Normal(t(0.0), t(1.0)), Uniform(t(0.0), t(1.0)))
+
+    def test_register_custom(self):
+        class MyDist(Normal):
+            pass
+
+        @register_kl(MyDist, Uniform)
+        def _kl(p, q):
+            return t(42.0)
+
+        assert float(kl_divergence(MyDist(t(0.0), t(1.0)),
+                                   Uniform(t(0.0), t(1.0))).numpy()) == 42.0
+
+
+class TestFFT:
+    def test_fft_ifft_roundtrip_matches_numpy(self):
+        x = np.random.default_rng(0).standard_normal(16).astype(np.float32)
+        got = paddle.fft.fft(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, np.fft.fft(x), rtol=1e-4, atol=1e-5)
+        back = paddle.fft.ifft(paddle.to_tensor(got)).numpy()
+        np.testing.assert_allclose(back.real, x, rtol=1e-4, atol=1e-5)
+
+    def test_rfft_irfft(self):
+        x = np.random.default_rng(1).standard_normal(16).astype(np.float32)
+        got = paddle.fft.rfft(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, np.fft.rfft(x), rtol=1e-4, atol=1e-5)
+        back = paddle.fft.irfft(paddle.to_tensor(got), n=16).numpy()
+        np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-5)
+
+    def test_fft2_norm_ortho(self):
+        x = np.random.default_rng(2).standard_normal((4, 8)).astype(np.float32)
+        got = paddle.fft.fft2(paddle.to_tensor(x), norm="ortho").numpy()
+        np.testing.assert_allclose(got, np.fft.fft2(x, norm="ortho"),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_fftfreq_shift(self):
+        np.testing.assert_allclose(paddle.fft.fftfreq(8, d=0.5).numpy(),
+                                   np.fft.fftfreq(8, 0.5), rtol=1e-6)
+        x = np.arange(8, dtype=np.float32)
+        np.testing.assert_allclose(paddle.fft.fftshift(paddle.to_tensor(x)).numpy(),
+                                   np.fft.fftshift(x))
+
+    def test_fft_grad(self):
+        x = paddle.to_tensor(np.random.default_rng(3).standard_normal(8)
+                             .astype(np.float32), stop_gradient=False)
+        y = paddle.fft.rfft(x)
+        (y.abs() ** 2).sum().backward()
+        assert x.grad is not None
+        assert np.isfinite(x.grad.numpy()).all()
