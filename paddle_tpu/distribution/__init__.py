@@ -430,9 +430,18 @@ def register_kl(cls_p: Type, cls_q: Type):
 
 
 def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    """Dispatch to the MOST SPECIFIC registered pair (reference kl.py uses
+    total_ordering on subclass distance): a KL registered for a subclass
+    beats the superclass entry regardless of registration order."""
+    best = None
+    best_score = None
     for (cp, cq), fn in _KL_REGISTRY.items():
         if isinstance(p, cp) and isinstance(q, cq):
-            return fn(p, q)
+            score = type(p).__mro__.index(cp) + type(q).__mro__.index(cq)
+            if best_score is None or score < best_score:
+                best, best_score = fn, score
+    if best is not None:
+        return best(p, q)
     raise NotImplementedError(
         f"no KL registered for ({type(p).__name__}, {type(q).__name__}); "
         "add one with @register_kl")

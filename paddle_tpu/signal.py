@@ -1,0 +1,153 @@
+"""paddle.signal — STFT/iSTFT (reference `python/paddle/signal.py`, built on
+frame/overlap_add + fft). Here: framing via strided gather + paddle.fft,
+differentiable end to end."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .tensor.tensor import Tensor, apply_op
+from .tensor._op_utils import ensure_tensor
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length: int, hop_length: int, axis: int = -1, name=None) -> Tensor:
+    """Slice overlapping frames (reference signal.py:33). Layouts match
+    paddle: ``axis=-1``: [..., seq] → [..., frame_length, num_frames];
+    ``axis=0``: [seq, ...] → [num_frames, frame_length, ...]."""
+    x = ensure_tensor(x)
+    if axis not in (-1, x.ndim - 1, 0):
+        raise NotImplementedError("frame: axis must be first or last")
+    first = axis == 0 and x.ndim >= 1
+
+    def fn(v):
+        if first:
+            v = jnp.moveaxis(v, 0, -1) if v.ndim > 1 else v
+        n = v.shape[-1]
+        num = 1 + (n - frame_length) // hop_length
+        starts = jnp.arange(num) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]  # [num, flen]
+        out = v[..., idx]                      # [..., num, flen]
+        if first:
+            # → [num, flen, ...] (paddle's axis=0 layout)
+            out = jnp.moveaxis(jnp.moveaxis(out, -2, 0), -1, 1)
+            return out
+        return jnp.swapaxes(out, -1, -2)       # [..., flen, num]
+
+    return apply_op("frame", fn, (x,))
+
+
+def overlap_add(x, hop_length: int, axis: int = -1, name=None) -> Tensor:
+    """Inverse of frame (reference signal.py:156): ``axis=-1`` consumes
+    [..., frame_length, num_frames]; ``axis=0`` consumes
+    [num_frames, frame_length, ...]. One scatter-add op (no per-frame loop)."""
+    x = ensure_tensor(x)
+    if axis not in (-1, x.ndim - 1, 0):
+        raise NotImplementedError("overlap_add: axis must be first or last")
+    first = axis == 0
+
+    def fn(v):
+        if first:
+            # [num, flen, ...] → [..., flen, num]
+            v = jnp.moveaxis(jnp.moveaxis(v, 0, -1), 0, -2)
+        flen, num = v.shape[-2], v.shape[-1]
+        n = (num - 1) * hop_length + flen
+        frames = jnp.swapaxes(v, -1, -2)                 # [..., num, flen]
+        starts = jnp.arange(num) * hop_length
+        pos = (starts[:, None] + jnp.arange(flen)[None, :]).reshape(-1)
+        flat = frames.reshape(frames.shape[:-2] + (num * flen,))
+        out = jnp.zeros(v.shape[:-2] + (n,), v.dtype).at[..., pos].add(flat)
+        if first:
+            out = jnp.moveaxis(out, -1, 0)
+        return out
+
+    return apply_op("overlap_add", fn, (x,))
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None, center: bool = True,
+         pad_mode: str = "reflect", normalized: bool = False,
+         onesided: bool = True, name=None) -> Tensor:
+    """Short-time Fourier transform (reference signal.py:243). Returns
+    [..., n_fft//2+1 (or n_fft), num_frames] complex."""
+    from . import fft as _fft
+
+    x = ensure_tensor(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    if window is not None:
+        w = ensure_tensor(window)._value
+    else:
+        w = jnp.ones((win_length,), jnp.float32)
+    if win_length < n_fft:  # center-pad the window to n_fft (paddle behavior)
+        pad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (pad, n_fft - win_length - pad))
+
+    def prep(v):
+        if center:
+            v = jnp.pad(v, [(0, 0)] * (v.ndim - 1) + [(n_fft // 2, n_fft // 2)],
+                        mode=pad_mode)
+        return v
+
+    padded = apply_op("stft_pad", prep, (x,))
+    frames = frame(padded, n_fft, hop_length, axis=-1)   # [..., n_fft, num]
+    windowed = apply_op("stft_window", lambda f: f * w[..., :, None], (frames,))
+    spec = _fft.rfft(windowed, axis=-2) if onesided else \
+        _fft.fft(windowed, axis=-2)
+    if normalized:
+        spec = apply_op("stft_norm", lambda s: s / jnp.sqrt(float(n_fft)), (spec,))
+    return spec
+
+
+def istft(x, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None, center: bool = True,
+          normalized: bool = False, onesided: bool = True,
+          length: Optional[int] = None, return_complex: bool = False,
+          name=None) -> Tensor:
+    """Inverse STFT with window-envelope normalization (reference
+    signal.py:377)."""
+    from . import fft as _fft
+
+    x = ensure_tensor(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        w = ensure_tensor(window)._value
+    else:
+        w = jnp.ones((win_length,), jnp.float32)
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (pad, n_fft - win_length - pad))
+
+    if normalized:
+        x = apply_op("istft_denorm", lambda s: s * jnp.sqrt(float(n_fft)), (x,))
+    if onesided:
+        if return_complex:
+            raise ValueError("return_complex=True requires onesided=False "
+                             "(as the reference)")
+        frames = _fft.irfft(x, n=n_fft, axis=-2)
+    elif return_complex:
+        frames = apply_op("istft_ifft_c", lambda s: jnp.fft.ifft(s, axis=-2), (x,))
+    else:
+        frames = apply_op("istft_ifft", lambda s: jnp.fft.ifft(s, axis=-2).real, (x,))
+    windowed = apply_op("istft_window", lambda f: f * w[..., :, None], (frames,))
+    y = overlap_add(windowed, hop_length)
+    # normalize by the summed squared-window envelope
+    num = x.shape[-1]
+    env_frames = jnp.broadcast_to((w * w)[:, None], (n_fft, num))
+    env = overlap_add(Tensor(env_frames), hop_length)
+
+    def trim(v, e):
+        e = jnp.where(e > 1e-11, e, 1.0)
+        out = v / e
+        if center:
+            out = out[..., n_fft // 2: out.shape[-1] - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    return apply_op("istft_trim", trim, (y, env))

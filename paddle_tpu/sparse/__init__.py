@@ -1,0 +1,209 @@
+"""paddle.sparse — COO/CSR sparse tensors (reference `python/paddle/sparse/`:
+creation.py sparse_coo_tensor/sparse_csr_tensor, unary/binary ops, nn ops;
+backed by `paddle/phi/kernels/sparse/` C++/CUDA kernels).
+
+TPU-native: XLA has no sparse formats in-core; the community-standard path
+is jax.experimental.sparse's BCOO (batched-COO) which lowers sparse matmul
+to gather/segment-sum XLA programs. SparseTensor here wraps BCOO, keeps
+paddle's API names (indices/values/to_dense/matmul/...), and CSR is stored
+as converted COO with the crows view materialized on demand — on TPU there
+is no kernel-level CSR advantage, the MXU wants the dense-ified form
+anyway, so dense conversion boundaries are explicit."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..tensor.tensor import Tensor, apply_op
+from ..tensor._op_utils import ensure_tensor
+
+__all__ = ["SparseTensor", "sparse_coo_tensor", "sparse_csr_tensor",
+           "is_same_shape", "matmul", "add", "multiply", "relu", "masked_matmul"]
+
+
+class SparseTensor:
+    """COO sparse tensor over jax BCOO. ``indices``: [ndim, nnz] (paddle
+    layout); ``values``: [nnz]."""
+
+    def __init__(self, bcoo: jsparse.BCOO, fmt: str = "coo",
+                 values_t: Optional[Tensor] = None):
+        self._bcoo = bcoo
+        self._fmt = fmt
+        # tape-connected values (set by differentiable producers like
+        # masked_matmul) so values() keeps the autograd edge
+        self._values_t = values_t
+
+    # -- paddle surface ----------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    def nnz(self) -> int:
+        return int(self._bcoo.nse)
+
+    def indices(self) -> Tensor:
+        return Tensor(self._bcoo.indices.T)  # [ndim, nnz] paddle layout
+
+    def values(self) -> Tensor:
+        if self._values_t is not None:
+            return self._values_t
+        return Tensor(self._bcoo.data)
+
+    def _row_sorted(self) -> jsparse.BCOO:
+        """Row-major-sorted view; CSR-format tensors are stored sorted
+        already, COO gets sorted on demand so the (crows, cols, values)
+        triple is internally consistent."""
+        return self._bcoo if self._fmt == "csr" else _sort_rows(self._bcoo)
+
+    def crows(self) -> Tensor:
+        """CSR row-pointer view (2-D only; consistent with cols())."""
+        if len(self._bcoo.shape) != 2:
+            raise ValueError("crows() requires a 2-D sparse tensor")
+        rows = np.asarray(self._row_sorted().indices[:, 0])
+        counts = np.bincount(rows, minlength=self._bcoo.shape[0])
+        return Tensor(jnp.asarray(np.concatenate([[0], np.cumsum(counts)])))
+
+    def cols(self) -> Tensor:
+        if len(self._bcoo.shape) != 2:
+            raise ValueError("cols() requires a 2-D sparse tensor")
+        return Tensor(self._row_sorted().indices[:, 1])
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_coo(self, sparse_dim: Optional[int] = None) -> "SparseTensor":
+        return SparseTensor(self._bcoo, "coo")
+
+    def to_sparse_csr(self) -> "SparseTensor":
+        if len(self._bcoo.shape) != 2:
+            raise ValueError("CSR requires a 2-D tensor")
+        return SparseTensor(_sort_rows(self._bcoo), "csr")
+
+    def is_sparse_coo(self) -> bool:
+        return self._fmt == "coo"
+
+    def is_sparse_csr(self) -> bool:
+        return self._fmt == "csr"
+
+    def coalesce(self) -> "SparseTensor":
+        return SparseTensor(self._bcoo.sum_duplicates(), self._fmt)
+
+    def matmul(self, other) -> Tensor:
+        return matmul(self, other)
+
+    def __repr__(self):
+        return (f"SparseTensor(format={self._fmt}, shape={self.shape}, "
+                f"nnz={self.nnz()})")
+
+
+def _sort_rows(b: jsparse.BCOO) -> jsparse.BCOO:
+    order = np.lexsort(np.asarray(b.indices).T[::-1])
+    return jsparse.BCOO((b.data[jnp.asarray(order)],
+                         b.indices[jnp.asarray(order)]), shape=b.shape)
+
+
+def sparse_coo_tensor(indices, values, shape: Optional[Sequence[int]] = None,
+                      dtype=None, place=None, stop_gradient: bool = True
+                      ) -> SparseTensor:
+    """Build COO from [ndim, nnz] indices + [nnz] values (reference
+    creation.py:35)."""
+    idx = ensure_tensor(indices)._value.astype(jnp.int32).T  # → [nnz, ndim]
+    vals = ensure_tensor(values)._value
+    if dtype is not None:
+        from ..framework import dtype as _dt
+
+        vals = vals.astype(_dt.canonical_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in np.asarray(idx).max(axis=0))
+    b = jsparse.BCOO((vals, idx), shape=tuple(int(s) for s in shape))
+    return SparseTensor(b, "coo")
+
+
+def sparse_csr_tensor(crows, cols, values, shape: Sequence[int], dtype=None,
+                      place=None, stop_gradient: bool = True) -> SparseTensor:
+    """Build CSR from row pointers + cols + values (reference creation.py:129);
+    stored as sorted COO (module docstring)."""
+    crows_np = np.asarray(ensure_tensor(crows)._value)
+    cols_v = ensure_tensor(cols)._value
+    vals = ensure_tensor(values)._value
+    rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
+    idx = jnp.stack([jnp.asarray(rows, jnp.int32),
+                     cols_v.astype(jnp.int32)], axis=1)
+    b = jsparse.BCOO((vals, idx), shape=tuple(int(s) for s in shape))
+    return SparseTensor(b, "csr")
+
+
+def is_same_shape(x: SparseTensor, y: SparseTensor) -> bool:
+    return x.shape == y.shape
+
+
+def matmul(x: SparseTensor, y, name=None) -> Tensor:
+    """sparse @ dense → dense (reference sparse/matmul.py; BCOO dot lowers
+    to gather + segment-sum on XLA). Differentiable w.r.t. both the sparse
+    values and the dense operand (the GNN training path)."""
+    if not isinstance(x, SparseTensor):
+        raise TypeError("matmul expects a SparseTensor lhs")
+    y_t = y if isinstance(y, Tensor) else ensure_tensor(y)
+    data_t = Tensor(x._bcoo.data)
+    idx, shape = x._bcoo.indices, x._bcoo.shape
+
+    def fn(data, yv):
+        return jsparse.BCOO((data, idx), shape=shape) @ yv
+
+    return apply_op("sparse_matmul", fn, (data_t, y_t))
+
+
+def masked_matmul(x, y, mask: SparseTensor, name=None) -> SparseTensor:
+    """dense @ dense sampled at mask's sparsity (reference masked_matmul —
+    SDDMM): computes only the nnz entries; differentiable w.r.t. x and y."""
+    x_t = x if isinstance(x, Tensor) else ensure_tensor(x)
+    y_t = y if isinstance(y, Tensor) else ensure_tensor(y)
+    idx = mask._bcoo.indices
+    rows, cols = idx[:, 0], idx[:, 1]
+
+    def fn(xv, yv):
+        return jnp.einsum("nk,nk->n", xv[rows, :], yv[:, cols].T)
+
+    vals = apply_op("sparse_sddmm", fn, (x_t, y_t))
+    return SparseTensor(jsparse.BCOO((vals._value, idx), shape=mask._bcoo.shape),
+                        mask._fmt, values_t=vals)
+
+
+def add(x: SparseTensor, y: SparseTensor, name=None) -> SparseTensor:
+    if tuple(x._bcoo.shape) != tuple(y._bcoo.shape):
+        raise ValueError(f"sparse.add: shape mismatch {x.shape} vs {y.shape}")
+    out = jsparse.BCOO.sum_duplicates(
+        jsparse.BCOO((jnp.concatenate([x._bcoo.data, y._bcoo.data]),
+                      jnp.concatenate([x._bcoo.indices, y._bcoo.indices])),
+                     shape=x._bcoo.shape))
+    return SparseTensor(out, x._fmt)
+
+
+def multiply(x: SparseTensor, y: SparseTensor, name=None) -> SparseTensor:
+    """Elementwise product (sparse∘sparse). Computed through dense (XLA
+    fuses; sparsity of the result == intersection)."""
+    dense = x._bcoo.todense() * y._bcoo.todense()
+    return from_dense(Tensor(dense))
+
+
+def relu(x: SparseTensor, name=None) -> SparseTensor:
+    """Elementwise relu on the stored values (reference sparse/nn/functional)."""
+    return SparseTensor(jsparse.BCOO((jax.nn.relu(x._bcoo.data), x._bcoo.indices),
+                                     shape=x._bcoo.shape), x._fmt)
+
+
+def from_dense(x, name=None) -> SparseTensor:
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return SparseTensor(jsparse.BCOO.fromdense(v), "coo")
+
+
+__all__.append("from_dense")

@@ -1,0 +1,38 @@
+"""paddle.static — parity SHIM, deliberately thin (reference
+`python/paddle/static/`): this build has no separate static-graph mode;
+whole-graph compilation is ``paddle.jit.to_static`` (SURVEY §7: XLA/jaxpr
+subsumes Program/PIR). What ports cleanly is kept; Program-building APIs
+raise with a pointer to the jit path."""
+
+from ..jit import InputSpec  # noqa: F401  (the one static API everyone uses)
+
+__all__ = ["InputSpec", "Program", "program_guard", "default_main_program",
+           "default_startup_program", "name_scope"]
+
+_MSG = ("paddle_tpu has no static Program graphs: decorate with "
+        "paddle.jit.to_static (whole-step XLA compilation) instead — "
+        "see SURVEY.md §3.3 for the mapping")
+
+
+class Program:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_MSG)
+
+
+def program_guard(*a, **k):
+    raise NotImplementedError(_MSG)
+
+
+def default_main_program():
+    raise NotImplementedError(_MSG)
+
+
+def default_startup_program():
+    raise NotImplementedError(_MSG)
+
+
+def name_scope(prefix=None):
+    """No-op context (names don't exist in jaxpr-land)."""
+    import contextlib
+
+    return contextlib.nullcontext()

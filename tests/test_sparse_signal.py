@@ -1,0 +1,203 @@
+"""paddle.sparse + paddle.signal tests (reference test/legacy_test/
+test_sparse_*.py, test_stft_op.py vs scipy/numpy references)."""
+
+import numpy as np
+import pytest
+from scipy import signal as sp_signal
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse as S
+
+
+def rand_dense(shape, density=0.3, seed=0):
+    rng = np.random.default_rng(seed)
+    d = rng.standard_normal(shape).astype(np.float32)
+    mask = rng.random(shape) < density
+    return d * mask
+
+
+class TestSparseCreation:
+    def test_coo_roundtrip(self):
+        dense = rand_dense((4, 6))
+        st = S.from_dense(paddle.to_tensor(dense))
+        assert st.is_sparse_coo()
+        assert st.nnz() == int((dense != 0).sum())
+        np.testing.assert_allclose(st.to_dense().numpy(), dense)
+
+    def test_sparse_coo_tensor_from_indices(self):
+        idx = np.array([[0, 1, 2], [1, 0, 2]])
+        vals = np.array([1.0, 2.0, 3.0], np.float32)
+        st = S.sparse_coo_tensor(paddle.to_tensor(idx), paddle.to_tensor(vals),
+                                 shape=[3, 3])
+        expect = np.zeros((3, 3), np.float32)
+        expect[0, 1], expect[1, 0], expect[2, 2] = 1, 2, 3
+        np.testing.assert_allclose(st.to_dense().numpy(), expect)
+        np.testing.assert_array_equal(st.indices().numpy(), idx)
+        np.testing.assert_allclose(st.values().numpy(), vals)
+
+    def test_csr_tensor_and_views(self):
+        crows = np.array([0, 2, 3, 5])
+        cols = np.array([0, 2, 1, 0, 2])
+        vals = np.arange(1, 6, dtype=np.float32)
+        st = S.sparse_csr_tensor(paddle.to_tensor(crows), paddle.to_tensor(cols),
+                                 paddle.to_tensor(vals), shape=[3, 3])
+        assert st.is_sparse_csr()
+        expect = np.array([[1, 0, 2], [0, 3, 0], [4, 0, 5]], np.float32)
+        np.testing.assert_allclose(st.to_dense().numpy(), expect)
+        np.testing.assert_array_equal(st.crows().numpy(), crows)
+        np.testing.assert_array_equal(st.cols().numpy(), cols)
+
+    def test_coo_to_csr(self):
+        dense = rand_dense((5, 5), seed=2)
+        csr = S.from_dense(paddle.to_tensor(dense)).to_sparse_csr()
+        np.testing.assert_allclose(csr.to_dense().numpy(), dense)
+
+
+class TestSparseOps:
+    def test_spmm_matches_dense(self):
+        a = rand_dense((4, 8), seed=1)
+        b = np.random.default_rng(2).standard_normal((8, 3)).astype(np.float32)
+        out = S.matmul(S.from_dense(paddle.to_tensor(a)), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5, atol=1e-6)
+
+    def test_add_multiply_relu(self):
+        a, b = rand_dense((4, 4), seed=3), rand_dense((4, 4), seed=4)
+        sa, sb = S.from_dense(paddle.to_tensor(a)), S.from_dense(paddle.to_tensor(b))
+        np.testing.assert_allclose(S.add(sa, sb).to_dense().numpy(), a + b,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(S.multiply(sa, sb).to_dense().numpy(), a * b,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(S.relu(sa).to_dense().numpy(),
+                                   np.maximum(a, 0), rtol=1e-6)
+
+    def test_masked_matmul_sddmm(self):
+        x = np.random.default_rng(5).standard_normal((4, 6)).astype(np.float32)
+        y = np.random.default_rng(6).standard_normal((6, 4)).astype(np.float32)
+        mask = S.from_dense(paddle.to_tensor(rand_dense((4, 4), 0.5, seed=7)))
+        out = S.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y), mask)
+        full = x @ y
+        dense_mask = (mask.to_dense().numpy() != 0)
+        np.testing.assert_allclose(out.to_dense().numpy(), full * dense_mask,
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestSignal:
+    def test_frame_overlap_add_roundtrip(self):
+        x = np.arange(16, dtype=np.float32)
+        f = paddle.signal.frame(paddle.to_tensor(x), frame_length=4, hop_length=4)
+        assert f.shape == [4, 4]
+        back = paddle.signal.overlap_add(f, hop_length=4)
+        np.testing.assert_allclose(back.numpy(), x)
+
+    def test_stft_matches_scipy(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal(256).astype(np.float32)
+        n_fft, hop = 64, 16
+        win = np.hanning(n_fft).astype(np.float32)
+        got = paddle.signal.stft(paddle.to_tensor(x[None]), n_fft=n_fft,
+                                 hop_length=hop, window=paddle.to_tensor(win),
+                                 center=False).numpy()[0]
+        _, _, ref = sp_signal.stft(x, window=win, nperseg=n_fft,
+                                   noverlap=n_fft - hop, boundary=None,
+                                   padded=False)
+        ref = ref * win.sum()  # scipy normalizes by window sum
+        assert got.shape == ref.shape
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-3)
+
+    def test_stft_istft_roundtrip(self):
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal(512).astype(np.float32)
+        win = paddle.to_tensor(np.hanning(128).astype(np.float32))
+        spec = paddle.signal.stft(paddle.to_tensor(x[None]), n_fft=128,
+                                  hop_length=32, window=win)
+        back = paddle.signal.istft(spec, n_fft=128, hop_length=32, window=win,
+                                   length=512).numpy()[0]
+        np.testing.assert_allclose(back, x, rtol=1e-3, atol=1e-4)
+
+    def test_stft_grad_flows(self):
+        x = paddle.to_tensor(np.random.default_rng(2).standard_normal(128)
+                             .astype(np.float32), stop_gradient=False)
+        spec = paddle.signal.stft(x.reshape([1, -1]), n_fft=32, hop_length=16)
+        (spec.abs() ** 2).sum().backward()
+        assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+
+class TestStaticShim:
+    def test_input_spec_reexport(self):
+        assert paddle.static.InputSpec is paddle.jit.InputSpec
+
+    def test_program_apis_point_to_jit(self):
+        with pytest.raises(NotImplementedError, match="to_static"):
+            paddle.static.Program()
+        with pytest.raises(NotImplementedError, match="to_static"):
+            paddle.static.default_main_program()
+        with paddle.static.name_scope("x"):
+            pass  # no-op ok
+
+
+class TestReviewRegressions:
+    def test_sparse_matmul_grad_flows(self):
+        a = rand_dense((4, 6), seed=8)
+        y = paddle.to_tensor(np.random.default_rng(9).standard_normal((6, 3))
+                             .astype(np.float32), stop_gradient=False)
+        out = S.matmul(S.from_dense(paddle.to_tensor(a)), y)
+        out.sum().backward()
+        assert y.grad is not None
+        # d(sum(A@Y))/dY = A^T @ ones
+        np.testing.assert_allclose(y.grad.numpy(),
+                                   a.T @ np.ones((4, 3), np.float32),
+                                   rtol=1e-5)
+
+    def test_masked_matmul_grad_flows(self):
+        x = paddle.to_tensor(np.random.default_rng(10).standard_normal((3, 4))
+                             .astype(np.float32), stop_gradient=False)
+        y = np.random.default_rng(11).standard_normal((4, 3)).astype(np.float32)
+        mask = S.from_dense(paddle.to_tensor(np.eye(3, dtype=np.float32)))
+        st = S.masked_matmul(x, paddle.to_tensor(y), mask)
+        st.values().sum().backward()  # values() keeps the tape edge
+        assert x.grad is not None
+        # d/dx of sum_i (x@y)[i,i] = y^T rows scattered at mask rows = y.T
+        np.testing.assert_allclose(x.grad.numpy(), y.T, rtol=1e-5)
+
+    def test_add_shape_mismatch_raises(self):
+        a = S.from_dense(paddle.to_tensor(np.eye(3, dtype=np.float32)))
+        b = S.from_dense(paddle.to_tensor(np.eye(4, dtype=np.float32)))
+        with pytest.raises(ValueError, match="shape mismatch"):
+            S.add(a, b)
+
+    def test_crows_cols_consistent_for_unsorted_coo(self):
+        idx = np.array([[1, 0], [0, 1]])  # deliberately unsorted rows
+        vals = np.array([5.0, 7.0], np.float32)
+        st = S.sparse_coo_tensor(paddle.to_tensor(idx), paddle.to_tensor(vals),
+                                 shape=[2, 2])
+        crows = st.crows().numpy()
+        cols = st.cols().numpy()
+        # decode (crows, cols) and check against the dense truth
+        dense = st.to_dense().numpy()
+        k = 0
+        for r in range(2):
+            for _ in range(crows[r + 1] - crows[r]):
+                assert dense[r, cols[k]] != 0
+                k += 1
+
+    def test_frame_axis0_paddle_layout(self):
+        x = np.arange(16, dtype=np.float32)
+        f = paddle.signal.frame(paddle.to_tensor(x), 4, 4, axis=0).numpy()
+        np.testing.assert_array_equal(f[0], [0, 1, 2, 3])  # rows are frames
+        np.testing.assert_array_equal(f[3], [12, 13, 14, 15])
+        back = paddle.signal.overlap_add(paddle.to_tensor(f), 4, axis=0).numpy()
+        np.testing.assert_allclose(back, x)
+
+    def test_kl_subclass_dispatch(self):
+        from paddle_tpu.distribution import Normal, kl_divergence, register_kl
+
+        class SpecialNormal(Normal):
+            pass
+
+        @register_kl(SpecialNormal, SpecialNormal)
+        def _kl_special(p, q):
+            return paddle.to_tensor(np.float32(123.0))
+
+        got = kl_divergence(SpecialNormal(paddle.to_tensor(0.0), paddle.to_tensor(1.0)),
+                            SpecialNormal(paddle.to_tensor(0.0), paddle.to_tensor(1.0)))
+        assert float(got.numpy()) == 123.0
