@@ -435,9 +435,17 @@ def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
     beats the superclass entry regardless of registration order."""
     best = None
     best_score = None
+    def depth(t, c):
+        # virtual subclasses (abc.register) match isinstance but are not in
+        # the MRO: treat them as least specific instead of crashing
+        try:
+            return t.__mro__.index(c)
+        except ValueError:
+            return len(t.__mro__)
+
     for (cp, cq), fn in _KL_REGISTRY.items():
         if isinstance(p, cp) and isinstance(q, cq):
-            score = type(p).__mro__.index(cp) + type(q).__mro__.index(cq)
+            score = depth(type(p), cp) + depth(type(q), cq)
             if best_score is None or score < best_score:
                 best, best_score = fn, score
     if best is not None:

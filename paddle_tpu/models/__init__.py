@@ -2,7 +2,7 @@
 paddle_tpu.vision.models."""
 
 from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel, llama2_7b, llama2_13b,  # noqa: F401
-                    llama2_70b, llama_tiny)
+                    llama2_70b, llama_moe_tiny, llama_tiny, mixtral_8x7b)
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel, gpt2_small, gpt3_1p3b, gpt_tiny  # noqa: F401
 from .ernie import (ErnieConfig, ErnieForMaskedLM, ErnieForSequenceClassification,  # noqa: F401
                     ErnieModel, ernie3_base, ernie_tiny)

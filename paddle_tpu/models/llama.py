@@ -24,7 +24,7 @@ from ..tensor.manipulation import reshape
 from ..tensor.tensor import Tensor, apply_op
 
 __all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama_tiny", "llama2_7b",
-           "llama2_13b", "llama2_70b"]
+           "llama2_13b", "llama2_70b", "llama_moe_tiny", "mixtral_8x7b"]
 
 
 @dataclass
@@ -41,6 +41,13 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     initializer_range: float = 0.02
     recompute: bool = False  # rematerialize each decoder layer (jax.checkpoint)
+    # MoE (reference capability: incubate/distributed/models/moe): replace the
+    # dense MLP with an ExpertParallelMLP in every `moe_every`-th layer
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
+    moe_every: int = 1
+    moe_expert_axes: tuple = None  # mesh axes to shard the expert dim over
 
     @property
     def head_dim(self) -> int:
@@ -68,6 +75,26 @@ def llama2_7b(**kw) -> LlamaConfig:
 def llama2_13b(**kw) -> LlamaConfig:
     base = dict(hidden_size=5120, intermediate_size=13824, num_hidden_layers=40,
                 num_attention_heads=40, num_key_value_heads=40)
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+def llama_moe_tiny(**kw) -> LlamaConfig:
+    """Test-scale MoE config: 4 experts, top-2, every layer."""
+    base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+                max_position_embeddings=128, moe_num_experts=4, moe_top_k=2)
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+def mixtral_8x7b(**kw) -> LlamaConfig:
+    """Mixtral-8x7B-shaped MoE ladder rung (8 experts, top-2; the MoE
+    analogue of BASELINE.md's llama2 ladder)."""
+    base = dict(vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+                num_hidden_layers=32, num_attention_heads=32,
+                num_key_value_heads=8, max_position_embeddings=4096,
+                moe_num_experts=8, moe_top_k=2)
     base.update(kw)
     return LlamaConfig(**base)
 
@@ -171,10 +198,19 @@ class LlamaMLP(nn.Layer):
 
 
 class LlamaDecoderLayer(nn.Layer):
-    def __init__(self, config: LlamaConfig):
+    def __init__(self, config: LlamaConfig, use_moe: bool = False):
         super().__init__()
         self.self_attn = LlamaAttention(config)
-        self.mlp = LlamaMLP(config)
+        if use_moe:
+            from ..incubate.distributed.models.moe import ExpertParallelMLP
+
+            self.mlp = ExpertParallelMLP(
+                config.hidden_size, config.intermediate_size,
+                num_experts=config.moe_num_experts, top_k=config.moe_top_k,
+                capacity_factor=config.moe_capacity_factor,
+                activation="swiglu", expert_axes=config.moe_expert_axes)
+        else:
+            self.mlp = LlamaMLP(config)
         self.input_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
         self.post_attention_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
 
@@ -191,8 +227,11 @@ class LlamaModel(nn.Layer):
         self.embed_tokens = nn.Embedding(
             config.vocab_size, config.hidden_size,
             weight_attr=nn.initializer.Normal(0.0, config.initializer_range))
-        self.layers = nn.LayerList([LlamaDecoderLayer(config)
-                                    for _ in range(config.num_hidden_layers)])
+        self.layers = nn.LayerList([
+            LlamaDecoderLayer(config,
+                              use_moe=(config.moe_num_experts > 0 and
+                                       i % config.moe_every == 0))
+            for i in range(config.num_hidden_layers)])
         self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
         cos, sin = _rope_tables(config.head_dim, config.max_position_embeddings,
                                 config.rope_theta)
@@ -214,7 +253,14 @@ class LlamaModel(nn.Layer):
             from ..distributed.fleet_utils import recompute
 
             for layer in self.layers:
-                x = recompute(layer, x, cos, sin, attn_mask, position_offset)
+                if getattr(layer.mlp, "l_aux", "absent") != "absent":
+                    # MoE layers run un-checkpointed: the router's l_aux
+                    # side-channel cannot escape a jax.checkpoint region
+                    # (dense layers still rematerialize — they hold the
+                    # bulk of the activation memory)
+                    x = layer(x, cos, sin, attn_mask, position_offset)
+                else:
+                    x = recompute(layer, x, cos, sin, attn_mask, position_offset)
         else:
             for layer in self.layers:
                 x = layer(x, cos, sin, attn_mask, position_offset)
@@ -244,8 +290,22 @@ class LlamaForCausalLM(nn.Layer):
             loss = F.cross_entropy(
                 reshape(logits, [-1, self.config.vocab_size]),
                 reshape(labels, [-1]))
+            if self.config.moe_num_experts > 0:
+                loss = loss + 0.01 * self.moe_aux_loss()
             return loss, logits
         return logits
+
+    def moe_aux_loss(self):
+        """Sum of the routers' load-balance losses from the last forward
+        (GShard aux loss; weighted 0.01 into the training loss)."""
+        aux = None
+        for layer in self.llama.layers:
+            la = getattr(layer.mlp, "l_aux", None)
+            if la is not None:
+                aux = la if aux is None else aux + la
+        if aux is None:
+            raise RuntimeError("moe_aux_loss: no MoE layers or no forward yet")
+        return aux
 
     def num_params(self) -> int:
         return sum(p.size for p in self.parameters())

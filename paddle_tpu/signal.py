@@ -27,6 +27,9 @@ def frame(x, frame_length: int, hop_length: int, axis: int = -1, name=None) -> T
         if first:
             v = jnp.moveaxis(v, 0, -1) if v.ndim > 1 else v
         n = v.shape[-1]
+        if n < frame_length:
+            raise ValueError(f"frame: signal length {n} < frame_length "
+                             f"{frame_length} (as the reference asserts)")
         num = 1 + (n - frame_length) // hop_length
         starts = jnp.arange(num) * hop_length
         idx = starts[:, None] + jnp.arange(frame_length)[None, :]  # [num, flen]
@@ -67,6 +70,23 @@ def overlap_add(x, hop_length: int, axis: int = -1, name=None) -> Tensor:
     return apply_op("overlap_add", fn, (x,))
 
 
+def _prep_window(window, win_length: int, n_fft: int) -> Tensor:
+    """Default-ones window, center-padded to n_fft, AS A TENSOR so a
+    learnable window stays on the tape (shared by stft and istft — the
+    padding rule must never diverge between them)."""
+    if win_length > n_fft:
+        raise ValueError(f"win_length {win_length} > n_fft {n_fft}")
+    if window is not None:
+        w = ensure_tensor(window)
+    else:
+        w = Tensor(jnp.ones((win_length,), jnp.float32))
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        w = apply_op("window_pad",
+                     lambda wv: jnp.pad(wv, (pad, n_fft - win_length - pad)), (w,))
+    return w
+
+
 def stft(x, n_fft: int, hop_length: Optional[int] = None,
          win_length: Optional[int] = None, window=None, center: bool = True,
          pad_mode: str = "reflect", normalized: bool = False,
@@ -78,14 +98,7 @@ def stft(x, n_fft: int, hop_length: Optional[int] = None,
     x = ensure_tensor(x)
     hop_length = hop_length or n_fft // 4
     win_length = win_length or n_fft
-
-    if window is not None:
-        w = ensure_tensor(window)._value
-    else:
-        w = jnp.ones((win_length,), jnp.float32)
-    if win_length < n_fft:  # center-pad the window to n_fft (paddle behavior)
-        pad = (n_fft - win_length) // 2
-        w = jnp.pad(w, (pad, n_fft - win_length - pad))
+    w = _prep_window(window, win_length, n_fft)
 
     def prep(v):
         if center:
@@ -95,7 +108,8 @@ def stft(x, n_fft: int, hop_length: Optional[int] = None,
 
     padded = apply_op("stft_pad", prep, (x,))
     frames = frame(padded, n_fft, hop_length, axis=-1)   # [..., n_fft, num]
-    windowed = apply_op("stft_window", lambda f: f * w[..., :, None], (frames,))
+    windowed = apply_op("stft_window", lambda f, wv: f * wv[..., :, None],
+                        (frames, w))
     spec = _fft.rfft(windowed, axis=-2) if onesided else \
         _fft.fft(windowed, axis=-2)
     if normalized:
@@ -115,13 +129,7 @@ def istft(x, n_fft: int, hop_length: Optional[int] = None,
     x = ensure_tensor(x)
     hop_length = hop_length or n_fft // 4
     win_length = win_length or n_fft
-    if window is not None:
-        w = ensure_tensor(window)._value
-    else:
-        w = jnp.ones((win_length,), jnp.float32)
-    if win_length < n_fft:
-        pad = (n_fft - win_length) // 2
-        w = jnp.pad(w, (pad, n_fft - win_length - pad))
+    w = _prep_window(window, win_length, n_fft)
 
     if normalized:
         x = apply_op("istft_denorm", lambda s: s * jnp.sqrt(float(n_fft)), (x,))
@@ -134,12 +142,15 @@ def istft(x, n_fft: int, hop_length: Optional[int] = None,
         frames = apply_op("istft_ifft_c", lambda s: jnp.fft.ifft(s, axis=-2), (x,))
     else:
         frames = apply_op("istft_ifft", lambda s: jnp.fft.ifft(s, axis=-2).real, (x,))
-    windowed = apply_op("istft_window", lambda f: f * w[..., :, None], (frames,))
+    windowed = apply_op("istft_window", lambda f, wv: f * wv[..., :, None],
+                        (frames, w))
     y = overlap_add(windowed, hop_length)
     # normalize by the summed squared-window envelope
     num = x.shape[-1]
-    env_frames = jnp.broadcast_to((w * w)[:, None], (n_fft, num))
-    env = overlap_add(Tensor(env_frames), hop_length)
+    env_frames = apply_op(
+        "istft_env",
+        lambda wv: jnp.broadcast_to((wv * wv)[:, None], (n_fft, num)), (w,))
+    env = overlap_add(env_frames, hop_length)
 
     def trim(v, e):
         e = jnp.where(e > 1e-11, e, 1.0)

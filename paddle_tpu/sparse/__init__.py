@@ -153,7 +153,8 @@ def matmul(x: SparseTensor, y, name=None) -> Tensor:
     if not isinstance(x, SparseTensor):
         raise TypeError("matmul expects a SparseTensor lhs")
     y_t = y if isinstance(y, Tensor) else ensure_tensor(y)
-    data_t = Tensor(x._bcoo.data)
+    # keep the tape edge when the values came from a differentiable producer
+    data_t = x._values_t if x._values_t is not None else Tensor(x._bcoo.data)
     idx, shape = x._bcoo.indices, x._bcoo.shape
 
     def fn(data, yv):
@@ -190,13 +191,20 @@ def add(x: SparseTensor, y: SparseTensor, name=None) -> SparseTensor:
 
 def multiply(x: SparseTensor, y: SparseTensor, name=None) -> SparseTensor:
     """Elementwise product (sparse∘sparse). Computed through dense (XLA
-    fuses; sparsity of the result == intersection)."""
+    fuses; sparsity of the result == intersection); format follows x."""
     dense = x._bcoo.todense() * y._bcoo.todense()
-    return from_dense(Tensor(dense))
+    out = from_dense(Tensor(dense))
+    return out.to_sparse_csr() if x.is_sparse_csr() else out
 
 
 def relu(x: SparseTensor, name=None) -> SparseTensor:
-    """Elementwise relu on the stored values (reference sparse/nn/functional)."""
+    """Elementwise relu on the stored values (reference sparse/nn/functional);
+    differentiable when the values carry a tape edge."""
+    if x._values_t is not None:
+        vals = apply_op("sparse_relu", jax.nn.relu, (x._values_t,))
+        return SparseTensor(jsparse.BCOO((vals._value, x._bcoo.indices),
+                                         shape=x._bcoo.shape), x._fmt,
+                            values_t=vals)
     return SparseTensor(jsparse.BCOO((jax.nn.relu(x._bcoo.data), x._bcoo.indices),
                                      shape=x._bcoo.shape), x._fmt)
 

@@ -201,3 +201,41 @@ class TestReviewRegressions:
         got = kl_divergence(SpecialNormal(paddle.to_tensor(0.0), paddle.to_tensor(1.0)),
                             SpecialNormal(paddle.to_tensor(0.0), paddle.to_tensor(1.0)))
         assert float(got.numpy()) == 123.0
+
+
+class TestSecondReviewRegressions:
+    def test_sddmm_spmm_chain_backprop(self):
+        """masked_matmul -> matmul chain carries gradients end to end."""
+        x = paddle.to_tensor(np.random.default_rng(12).standard_normal((3, 4))
+                             .astype(np.float32), stop_gradient=False)
+        y = paddle.to_tensor(np.random.default_rng(13).standard_normal((4, 3))
+                             .astype(np.float32))
+        z = paddle.to_tensor(np.ones((3, 2), np.float32))
+        mask = S.from_dense(paddle.to_tensor(np.eye(3, dtype=np.float32)))
+        st = S.masked_matmul(x, y, mask)
+        out = S.matmul(S.relu(st), z)
+        out.sum().backward()
+        assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+    def test_multiply_keeps_csr_format(self):
+        a = S.from_dense(paddle.to_tensor(np.eye(3, dtype=np.float32))).to_sparse_csr()
+        assert S.multiply(a, a).is_sparse_csr()
+
+    def test_frame_too_short_raises(self):
+        with pytest.raises(ValueError, match="frame_length"):
+            paddle.signal.frame(paddle.to_tensor(np.zeros(4, np.float32)), 8, 2)
+
+    def test_stft_window_gradient(self):
+        w = paddle.to_tensor(np.hanning(32).astype(np.float32),
+                             stop_gradient=False)
+        x = paddle.to_tensor(np.random.default_rng(14).standard_normal(128)
+                             .astype(np.float32))
+        spec = paddle.signal.stft(x.reshape([1, -1]), n_fft=32, hop_length=16,
+                                  window=w)
+        (spec.abs() ** 2).sum().backward()
+        assert w.grad is not None and np.abs(w.grad.numpy()).sum() > 0
+
+    def test_oversized_window_raises(self):
+        with pytest.raises(ValueError, match="win_length"):
+            paddle.signal.stft(paddle.to_tensor(np.zeros(64, np.float32)),
+                               n_fft=16, win_length=32)
