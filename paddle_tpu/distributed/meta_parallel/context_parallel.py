@@ -64,12 +64,17 @@ def ulysses_attention(q, k, v, mesh: Optional[Mesh] = None, sep_axis: str = "sep
     q = q if isinstance(q, Tensor) else Tensor(q)
     k = k if isinstance(k, Tensor) else Tensor(k)
     v = v if isinstance(v, Tensor) else Tensor(v)
-    if q.shape[2] % n != 0:
-        raise ValueError(f"Ulysses needs heads ({q.shape[2]}) divisible by "
-                         f"sep degree ({n})")
+    if q.shape[2] % n != 0 or k.shape[2] % n != 0:
+        raise ValueError(
+            f"Ulysses needs q heads ({q.shape[2]}) AND kv heads ({k.shape[2]}) "
+            f"divisible by the sep degree ({n}) — the head-sharded phase "
+            "splits both")
 
-    seq_spec = P(None, sep_axis, None, None)
-    head_spec = P(None, None, sep_axis, None)
+    _U = P.UNCONSTRAINED
+    # only the swapped dim is pinned: batch/head/feature dims keep whatever
+    # sharding the surrounding program gives them (dp/tp must survive)
+    seq_spec = P(_U, sep_axis, _U, _U)
+    head_spec = P(_U, _U, sep_axis, _U)
 
     def fn(qv, kv, vv):
         from ...ops.attention import sdpa_reference
@@ -78,7 +83,12 @@ def ulysses_attention(q, k, v, mesh: Optional[Mesh] = None, sep_axis: str = "sep
             try:
                 return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
             except (ValueError, TypeError):
-                return x  # eager single-device
+                # eager path: UNCONSTRAINED is jit-only; pin only the sep dim
+                concrete = P(*[None if s_ is P.UNCONSTRAINED else s_ for s_ in spec])
+                try:
+                    return jax.device_put(x, NamedSharding(mesh, concrete))
+                except (ValueError, TypeError):
+                    return x
 
         # seq-sharded → head-sharded (A2A), attend over full seq, swap back
         qh, kh, vh = (cons(x, head_spec) for x in (qv, kv, vv))

@@ -1,0 +1,112 @@
+"""paddle.geometric — graph ops (reference `python/paddle/geometric/`:
+math.py segment_sum/mean/max/min, message_passing/send_recv.py send_u_recv,
+send_ue_recv; CUDA kernels `paddle/phi/kernels/gpu/graph_send_recv_*`).
+
+TPU-native: every op is a gather + ``jax.ops.segment_*`` — XLA's sorted
+segment reductions — so message passing jits and differentiates like any
+dense op; ``num_segments``/``out_size`` must be static (pass it; defaulting
+to max(id)+1 forces a host sync, which is done eagerly once here)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor.tensor import Tensor, apply_op
+from ..tensor._op_utils import ensure_tensor
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+           "send_u_recv", "send_ue_recv"]
+
+
+def _ids(x) -> jnp.ndarray:
+    return (x._value if isinstance(x, Tensor) else jnp.asarray(x)).astype(jnp.int32)
+
+
+def _num_segments(ids, given: Optional[int]) -> int:
+    if given is not None:
+        return int(given)
+    return int(np.asarray(ids).max()) + 1 if ids.size else 0
+
+
+def _segment(name, reducer, fill):
+    def op(data, segment_ids, name=None, num_segments: Optional[int] = None) -> Tensor:
+        data = ensure_tensor(data)
+        ids = _ids(segment_ids)
+        n = _num_segments(ids, num_segments)
+
+        def fn(v):
+            out = reducer(v, ids, num_segments=n)
+            if fill is not None:
+                # jax fills empty segments with ±inf for max/min; paddle
+                # fills 0
+                out = jnp.where(jnp.isfinite(out), out, jnp.zeros_like(out))
+            return out
+
+        return apply_op(name, fn, (data,))
+
+    op.__name__ = name
+    op.__doc__ = f"paddle.geometric.{name} (reference math.py; jax.ops on XLA)."
+    return op
+
+
+segment_sum = _segment("segment_sum", jax.ops.segment_sum, None)
+segment_max = _segment("segment_max", jax.ops.segment_max, 0)
+segment_min = _segment("segment_min", jax.ops.segment_min, 0)
+
+
+def segment_mean(data, segment_ids, name=None, num_segments: Optional[int] = None) -> Tensor:
+    data = ensure_tensor(data)
+    ids = _ids(segment_ids)
+    n = _num_segments(ids, num_segments)
+
+    def fn(v):
+        s = jax.ops.segment_sum(v, ids, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((v.shape[0],), v.dtype), ids,
+                                  num_segments=n)
+        shape = (n,) + (1,) * (v.ndim - 1)
+        return s / jnp.maximum(cnt, 1).reshape(shape)
+
+    return apply_op("segment_mean", fn, (data,))
+
+
+_POOLS = {"sum": segment_sum, "mean": segment_mean, "max": segment_max,
+          "min": segment_min}
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
+                out_size: Optional[int] = None, name=None) -> Tensor:
+    """Gather source-node features along edges and reduce at destinations
+    (reference send_recv.py:31): ``out[d] = reduce over edges e with
+    dst[e]==d of x[src[e]]``."""
+    if reduce_op not in _POOLS:
+        raise ValueError(f"reduce_op must be one of {sorted(_POOLS)}")
+    x = ensure_tensor(x)
+    src = _ids(src_index)
+    dst = _ids(dst_index)
+    n_out = out_size if out_size is not None else x.shape[0]
+    gathered = apply_op("send_u", lambda v: v[src], (x,))
+    return _POOLS[reduce_op](gathered, dst, num_segments=n_out)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
+                 reduce_op: str = "sum", out_size: Optional[int] = None,
+                 name=None) -> Tensor:
+    """Like send_u_recv but the message combines node features with EDGE
+    features first (reference send_recv.py:156): message_op ∈ add/sub/mul/div."""
+    combos = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+              "div": jnp.divide}
+    if message_op not in combos:
+        raise ValueError(f"message_op must be one of {sorted(combos)}")
+    if reduce_op not in _POOLS:
+        raise ValueError(f"reduce_op must be one of {sorted(_POOLS)}")
+    x = ensure_tensor(x)
+    y = ensure_tensor(y)
+    src = _ids(src_index)
+    dst = _ids(dst_index)
+    n_out = out_size if out_size is not None else x.shape[0]
+    msg = apply_op("send_ue", lambda v, e: combos[message_op](v[src], e), (x, y))
+    return _POOLS[reduce_op](msg, dst, num_segments=n_out)

@@ -48,6 +48,10 @@ class LlamaConfig:
     moe_capacity_factor: float = 2.0
     moe_every: int = 1
     moe_expert_axes: tuple = None  # mesh axes to shard the expert dim over
+    # >0: compute the LM loss via the chunked fused linear+CE (never
+    # materializes the full [tokens, vocab] logits; see
+    # F.fused_linear_cross_entropy) — the HBM lever for big-vocab heads
+    fused_ce_chunk: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -282,6 +286,19 @@ class LlamaForCausalLM(nn.Layer):
 
     def forward(self, input_ids, labels=None, attn_mask=None):
         hidden = self.llama(input_ids, attn_mask)
+        if labels is not None and self.config.fused_ce_chunk > 0:
+            # chunked fused linear+CE: the full [tokens, vocab] logits are
+            # NEVER materialized (so no logits to return — paddle-style
+            # training loops read only the loss here)
+            flat_h = reshape(hidden, [-1, self.config.hidden_size])
+            head_w = self.lm_head.weight if self.lm_head is not None \
+                else self.llama.embed_tokens.weight.T  # tied embeddings
+            loss = F.fused_linear_cross_entropy(
+                flat_h, head_w, reshape(labels, [-1]),
+                chunk_size=self.config.fused_ce_chunk)
+            if self.config.moe_num_experts > 0:
+                loss = loss + 0.01 * self.moe_aux_loss()
+            return loss, None
         if self.lm_head is not None:
             logits = self.lm_head(hidden)
         else:

@@ -35,9 +35,10 @@ class HybridLlamaAttention(nn.Layer):
     """TP attention: heads sharded over "model" (q/k/v column-parallel,
     output row-parallel)."""
 
-    def __init__(self, config: LlamaConfig):
+    def __init__(self, config: LlamaConfig, context_parallel: str = "none"):
         super().__init__()
         self.config = config
+        self.context_parallel = context_parallel  # "none" | "ring" | "ulysses"
         h, kv, d = config.num_attention_heads, config.num_key_value_heads, config.head_dim
         init = nn.initializer.Normal(0.0, config.initializer_range)
         self.q_proj = ColumnParallelLinear(config.hidden_size, h * d, weight_attr=init,
@@ -58,7 +59,27 @@ class HybridLlamaAttention(nn.Layer):
         k = reshape(self.k_proj(x), [b, s, cfg.num_key_value_heads, cfg.head_dim])
         v = reshape(self.v_proj(x), [b, s, cfg.num_key_value_heads, cfg.head_dim])
         q, k = apply_rotary_pos_emb(q, k, cos, sin)
-        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask, is_causal=True)
+        if self.context_parallel != "none":
+            # long-context path (§5.7): the seq dim rides the "sep" axis; the
+            # ring never materializes the full sequence on one device
+            from ..distributed.meta_parallel.context_parallel import (
+                ring_attention, ulysses_attention)
+
+            if attn_mask is not None:
+                raise NotImplementedError(
+                    "context-parallel attention supports causal masking only")
+            if self.context_parallel == "ring":
+                if cfg.num_key_value_heads != cfg.num_attention_heads:
+                    # GQA: ring needs matched head counts; expand via Ulysses
+                    # or TP instead
+                    raise ValueError("ring attention requires kv heads == q "
+                                     "heads (use context_parallel='ulysses')")
+                out = ring_attention(q, k, v, causal=True)
+            else:
+                out = ulysses_attention(q, k, v, is_causal=True)
+        else:
+            out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                                 is_causal=True)
         return self.o_proj(reshape(out, [b, s, cfg.num_attention_heads * cfg.head_dim]))
 
 
@@ -81,9 +102,9 @@ class HybridLlamaMLP(nn.Layer):
 
 
 class HybridLlamaDecoderLayer(nn.Layer):
-    def __init__(self, config: LlamaConfig):
+    def __init__(self, config: LlamaConfig, context_parallel: str = "none"):
         super().__init__()
-        self.self_attn = HybridLlamaAttention(config)
+        self.self_attn = HybridLlamaAttention(config, context_parallel)
         self.mlp = HybridLlamaMLP(config)
         self.input_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
         self.post_attention_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
@@ -95,17 +116,44 @@ class HybridLlamaDecoderLayer(nn.Layer):
 
 
 class LlamaForCausalLMHybrid(nn.Layer):
-    def __init__(self, config: LlamaConfig, hcg: HybridCommunicateGroup):
+    """``context_parallel``: "none" | "ring" | "ulysses" — how attention
+    handles a seq dim sharded over "sep" (auto-picks ring when sep>1 and
+    head counts allow, else ulysses, when left at "auto")."""
+
+    def __init__(self, config: LlamaConfig, hcg: HybridCommunicateGroup,
+                 context_parallel: str = "auto"):
         super().__init__()
         self.config = config
         self.hcg = hcg
+        sep = hcg.mesh.shape.get("sep", 1)
+        if context_parallel == "auto":
+            if sep > 1:
+                gqa = config.num_key_value_heads != config.num_attention_heads
+                context_parallel = "ulysses" if gqa else "ring"
+            else:
+                context_parallel = "none"
+        if context_parallel not in ("none", "ring", "ulysses"):
+            raise ValueError(f"context_parallel={context_parallel!r}: must be "
+                             "'auto', 'none', 'ring' or 'ulysses'")
+        if context_parallel == "ulysses" and config.num_key_value_heads % sep != 0:
+            raise ValueError(
+                f"ulysses needs kv heads ({config.num_key_value_heads}) divisible "
+                f"by the sep degree ({sep}); lower sep or use ring attention "
+                "(requires kv heads == q heads)")
+        self.context_parallel = context_parallel
+        if config.fused_ce_chunk > 0:
+            raise ValueError(
+                "fused_ce_chunk is a single-device memory lever; the hybrid "
+                "model already avoids gathering the vocab dim via "
+                "ParallelCrossEntropy on TP-sharded logits — unset it")
         self.embed_tokens = VocabParallelEmbedding(
             config.vocab_size, config.hidden_size,
             weight_attr=nn.initializer.Normal(0.0, config.initializer_range))
         pp = hcg.get_pipe_parallel_world_size()
         if config.num_hidden_layers % pp != 0:
             raise ValueError(f"num_hidden_layers {config.num_hidden_layers} % pp {pp} != 0")
-        blocks = [HybridLlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)]
+        blocks = [HybridLlamaDecoderLayer(config, context_parallel)
+                  for _ in range(config.num_hidden_layers)]
         self.decoder = ScannedLayers(blocks, mesh=hcg.mesh, pipe_axis="pipe")
         self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
         self.lm_head = ColumnParallelLinear(

@@ -1117,3 +1117,58 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None) -> Te
 
 from ...tensor.manipulation import pad  # noqa: E402,F401 (paddle exposes F.pad)
 from ...tensor.creation import Parameter  # noqa: E402,F401
+
+
+def fused_linear_cross_entropy(hidden, weight, labels, chunk_size: int = 1024,
+                               ignore_index: int = -100, name=None) -> Tensor:
+    """Mean softmax-CE of ``hidden @ weight`` WITHOUT materializing the full
+    [tokens, vocab] logits (reference capability: the fused softmax-CE path
+    of `c_softmax_with_cross_entropy` / fused CE kernels).
+
+    The token dim is processed in ``chunk_size`` slices under ``lax.scan``
+    with rematerialization: each chunk's logits exist only transiently in
+    fwd AND bwd, cutting peak activation memory from O(tokens·vocab) to
+    O(chunk·vocab) — the lever that buys batch size on HBM-bound LM heads.
+
+    hidden: [tokens, d] (flatten first); weight: [d, vocab]; labels: [tokens].
+    ``ignore_index`` tokens are masked out of both numerator and denominator,
+    matching F.cross_entropy. A non-divisible token count runs a scanned
+    divisible body plus ONE remainder chunk (memory stays O(chunk·vocab)).
+    """
+    hidden = ensure_tensor(hidden)
+    weight = ensure_tensor(weight)
+    lbl = (labels._value if isinstance(labels, Tensor) else
+           jnp.asarray(labels)).astype(jnp.int32)
+    n = hidden.shape[0]
+    chunk_size = min(chunk_size, n)
+    chunks = n // chunk_size
+    main = chunks * chunk_size
+
+    def fn(h, w):
+        @jax.checkpoint
+        def chunk_loss(hc, lc):
+            valid = lc != ignore_index
+            safe = jnp.where(valid, lc, 0)
+            logits = (hc @ w).astype(jnp.float32)
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            gold = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
+            per_tok = jnp.where(valid, lse - gold, 0.0)
+            return jnp.sum(per_tok), jnp.sum(valid.astype(jnp.float32))
+
+        hs = h[:main].reshape(chunks, chunk_size, h.shape[-1])
+        ls = lbl[:main].reshape(chunks, chunk_size)
+
+        def body(carry, xs):
+            tot, cnt = carry
+            t, c = chunk_loss(*xs)
+            return (tot + t, cnt + c), None
+
+        (total, count), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+            (hs, ls))
+        if main < n:  # remainder chunk, same bounded footprint
+            t, c = chunk_loss(h[main:], lbl[main:])
+            total, count = total + t, count + c
+        return total / jnp.maximum(count, 1.0)
+
+    return apply_op("fused_linear_cross_entropy", fn, (hidden, weight))

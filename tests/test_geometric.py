@@ -1,0 +1,94 @@
+"""paddle.geometric tests (reference test/legacy_test/test_segment_ops.py,
+test_graph_send_recv_op.py — numpy loop references)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric as G
+
+
+def t(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+class TestSegmentOps:
+    data = np.array([[1., 2.], [3., 4.], [5., 6.], [7., 8.]], np.float32)
+    ids = np.array([0, 0, 1, 1])
+
+    def test_sum_mean_max_min(self):
+        np.testing.assert_allclose(G.segment_sum(t(self.data), t(self.ids)).numpy(),
+                                   [[4, 6], [12, 14]])
+        np.testing.assert_allclose(G.segment_mean(t(self.data), t(self.ids)).numpy(),
+                                   [[2, 3], [6, 7]])
+        np.testing.assert_allclose(G.segment_max(t(self.data), t(self.ids)).numpy(),
+                                   [[3, 4], [7, 8]])
+        np.testing.assert_allclose(G.segment_min(t(self.data), t(self.ids)).numpy(),
+                                   [[1, 2], [5, 6]])
+
+    def test_empty_segment_fills_zero(self):
+        out = G.segment_max(t(self.data), t(np.array([0, 0, 2, 2])),
+                            num_segments=3).numpy()
+        np.testing.assert_allclose(out[1], [0, 0])  # paddle zero-fill
+
+    def test_grad_flows(self):
+        x = paddle.to_tensor(self.data, stop_gradient=False)
+        G.segment_sum(x, t(self.ids)).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones_like(self.data))
+
+
+class TestSendRecv:
+    def test_send_u_recv_sum(self):
+        x = np.array([[1.], [2.], [4.]], np.float32)
+        src = [0, 1, 2, 0]
+        dst = [1, 2, 1, 0]
+        out = G.send_u_recv(t(x), t(src), t(dst), reduce_op="sum").numpy()
+        # node0 <- x[0]; node1 <- x[0]+x[2]; node2 <- x[1]
+        np.testing.assert_allclose(out, [[1.], [5.], [2.]])
+
+    def test_send_u_recv_mean_out_size(self):
+        x = np.array([[2.], [4.]], np.float32)
+        out = G.send_u_recv(t(x), t([0, 1]), t([0, 0]), reduce_op="mean",
+                            out_size=4).numpy()
+        np.testing.assert_allclose(out, [[3.], [0.], [0.], [0.]])
+
+    def test_send_ue_recv(self):
+        x = np.array([[1.], [10.]], np.float32)
+        e = np.array([[0.5], [0.25]], np.float32)
+        out = G.send_ue_recv(t(x), t(e), t([0, 1]), t([1, 0]),
+                             message_op="mul", reduce_op="sum").numpy()
+        np.testing.assert_allclose(out, [[2.5], [0.5]])
+
+    def test_gcn_layer_trains(self):
+        """A GCN built from send_u_recv must train end to end."""
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.default_rng(0)
+        n = 20
+        src = rng.integers(0, n, 60)
+        dst = rng.integers(0, n, 60)
+        feats = rng.standard_normal((n, 8)).astype(np.float32)
+        labels = (feats[:, 0] > 0).astype(np.int64)
+        lin = nn.Linear(8, 2)
+        opt = paddle.optimizer.Adam(learning_rate=5e-2,
+                                    parameters=lin.parameters())
+        losses = []
+        for _ in range(30):
+            agg = G.send_u_recv(t(feats), t(src), t(dst), reduce_op="mean")
+            logits = lin(agg + t(feats))
+            loss = F.cross_entropy(logits, t(labels))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.5
+
+    def test_bad_ops_raise(self):
+        with pytest.raises(ValueError, match="reduce_op"):
+            G.send_u_recv(t(np.ones((2, 2), np.float32)), t([0]), t([1]),
+                          reduce_op="prod")
+        with pytest.raises(ValueError, match="message_op"):
+            G.send_ue_recv(t(np.ones((2, 2), np.float32)),
+                           t(np.ones((1, 2), np.float32)), t([0]), t([1]),
+                           message_op="pow")

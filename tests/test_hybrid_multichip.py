@@ -83,3 +83,50 @@ class TestFullFiveAxis:
         assert hcg.get_pipe_parallel_world_size() == 2
         assert hcg.get_model_parallel_world_size() == 2
         assert hcg.get_data_parallel_world_size() == 2
+
+
+class TestContextParallelInHybrid:
+    """Ring/Ulysses attention riding the sep axis inside the flagship model."""
+
+    def test_ring_matches_dense_attention(self):
+        from paddle_tpu.models.llama import llama_tiny
+        from paddle_tpu.models.llama_parallel import LlamaForCausalLMHybrid
+
+        hcg = _make_hcg(sep=4, dp=2)
+        cfg = llama_tiny(num_key_value_heads=4)  # kv == q heads: ring-capable
+        paddle.seed(0)
+        m_ring = LlamaForCausalLMHybrid(cfg, hcg, context_parallel="ring")
+        assert m_ring.context_parallel == "ring"
+        paddle.seed(0)
+        m_none = LlamaForCausalLMHybrid(cfg, hcg, context_parallel="none")
+        rng = np.random.default_rng(0)
+        ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (2, 32)).astype("int32"))
+        lr = m_ring(ids)
+        ln = m_none(ids)
+        np.testing.assert_allclose(lr.numpy(), ln.numpy(), rtol=1e-3, atol=1e-4)
+
+    def test_auto_picks_ulysses_for_gqa(self):
+        from paddle_tpu.models.llama import llama_tiny
+        from paddle_tpu.models.llama_parallel import LlamaForCausalLMHybrid
+
+        hcg = _make_hcg(sep=2, dp=4)
+        model = LlamaForCausalLMHybrid(llama_tiny(), hcg)  # kv=2 != q=4 → GQA
+        assert model.context_parallel == "ulysses"
+        ids = paddle.to_tensor(np.random.default_rng(1)
+                               .integers(0, 256, (2, 16)).astype("int32"))
+        out = model(ids)
+        assert np.isfinite(out.numpy()).all()
+
+    def test_invalid_context_parallel_rejected(self):
+        from paddle_tpu.models.llama import llama_tiny
+        from paddle_tpu.models.llama_parallel import LlamaForCausalLMHybrid
+
+        hcg = _make_hcg(sep=2, dp=4)
+        with pytest.raises(ValueError, match="must be"):
+            LlamaForCausalLMHybrid(llama_tiny(), hcg, context_parallel="Ring")
+        # kv=2 not divisible by sep=4 → clear config error, not silent degrade
+        hcg4 = _make_hcg(sep=4, dp=2)
+        with pytest.raises(ValueError, match="kv heads"):
+            LlamaForCausalLMHybrid(llama_tiny(num_attention_heads=8,
+                                              num_key_value_heads=2), hcg4,
+                                   context_parallel="ulysses")

@@ -221,3 +221,95 @@ class TestLlamaMoE:
                         labels=paddle.to_tensor(ids[:, 1:]))
         loss.backward()
         assert np.isfinite(float(loss.numpy()))
+
+
+class TestFusedLinearCE:
+    def test_matches_unfused_loss_and_grads(self):
+        import paddle_tpu.nn.functional as F
+        import paddle_tpu.nn as nn
+
+        rng = np.random.default_rng(0)
+        h = paddle.to_tensor(rng.standard_normal((64, 32)).astype(np.float32),
+                             stop_gradient=False)
+        w = paddle.to_tensor(rng.standard_normal((32, 100)).astype(np.float32),
+                             stop_gradient=False)
+        y = paddle.to_tensor(rng.integers(0, 100, 64))
+        fused = F.fused_linear_cross_entropy(h, w, y, chunk_size=16)
+        fused.backward()
+        gh, gw = h.grad.numpy().copy(), w.grad.numpy().copy()
+
+        h2 = paddle.to_tensor(h.numpy(), stop_gradient=False)
+        w2 = paddle.to_tensor(w.numpy(), stop_gradient=False)
+        ref = F.cross_entropy(F.linear(h2, w2), y)
+        ref.backward()
+        np.testing.assert_allclose(float(fused.numpy()), float(ref.numpy()),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(gh, h2.grad.numpy(), rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(gw, w2.grad.numpy(), rtol=1e-4, atol=1e-6)
+
+    def test_llama_config_path_matches(self):
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+        ids = np.random.default_rng(1).integers(0, 256, (2, 17))
+        x, y = ids[:, :-1], ids[:, 1:]
+        paddle.seed(0)
+        m1 = LlamaForCausalLM(llama_tiny())
+        paddle.seed(0)
+        m2 = LlamaForCausalLM(llama_tiny(fused_ce_chunk=8))
+        l1, logits = m1(paddle.to_tensor(x), labels=paddle.to_tensor(y))
+        l2, no_logits = m2(paddle.to_tensor(x), labels=paddle.to_tensor(y))
+        assert no_logits is None  # fused path never materializes logits
+        np.testing.assert_allclose(float(l1.numpy()), float(l2.numpy()),
+                                   rtol=1e-5)
+
+    def test_non_divisible_tokens_fall_back(self):
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.default_rng(2)
+        h = paddle.to_tensor(rng.standard_normal((10, 8)).astype(np.float32))
+        w = paddle.to_tensor(rng.standard_normal((8, 20)).astype(np.float32))
+        y = paddle.to_tensor(rng.integers(0, 20, 10))
+        out = F.fused_linear_cross_entropy(h, w, y, chunk_size=4)  # 10 % 4 != 0
+        ref = F.cross_entropy(F.linear(h, w), y)
+        np.testing.assert_allclose(float(out.numpy()), float(ref.numpy()),
+                                   rtol=1e-5)
+
+    def test_ignore_index_matches_unfused(self):
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.default_rng(3)
+        h = paddle.to_tensor(rng.standard_normal((32, 16)).astype(np.float32))
+        w = paddle.to_tensor(rng.standard_normal((16, 50)).astype(np.float32))
+        y = rng.integers(0, 50, 32)
+        y[::3] = -100  # padded positions
+        fused = F.fused_linear_cross_entropy(h, w, paddle.to_tensor(y),
+                                             chunk_size=8)
+        ref = F.cross_entropy(F.linear(h, w), paddle.to_tensor(y))
+        assert np.isfinite(float(fused.numpy()))
+        np.testing.assert_allclose(float(fused.numpy()), float(ref.numpy()),
+                                   rtol=1e-5)
+
+    def test_tied_embeddings_use_fused_path(self):
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+        paddle.seed(0)
+        model = LlamaForCausalLM(llama_tiny(tie_word_embeddings=True,
+                                            fused_ce_chunk=8))
+        ids = np.random.default_rng(4).integers(0, 256, (2, 17))
+        loss, logits = model(paddle.to_tensor(ids[:, :-1]),
+                             labels=paddle.to_tensor(ids[:, 1:]))
+        assert logits is None and np.isfinite(float(loss.numpy()))
+
+    def test_hybrid_rejects_fused_ce(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.models.llama import llama_tiny
+        from paddle_tpu.models.llama_parallel import LlamaForCausalLMHybrid
+
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": -1, "mp_degree": 1,
+                                   "pp_degree": 1, "sharding_degree": 1,
+                                   "sep_degree": 1}
+        dist.fleet.init(is_collective=True, strategy=strategy)
+        hcg = dist.get_hybrid_communicate_group()
+        with pytest.raises(ValueError, match="ParallelCrossEntropy"):
+            LlamaForCausalLMHybrid(llama_tiny(fused_ce_chunk=64), hcg)
