@@ -41,9 +41,13 @@ def _segment(name, reducer, fill):
         def fn(v):
             out = reducer(v, ids, num_segments=n)
             if fill is not None:
-                # jax fills empty segments with ±inf for max/min; paddle
-                # fills 0
-                out = jnp.where(jnp.isfinite(out), out, jnp.zeros_like(out))
+                # jax fills EMPTY segments with the dtype identity (±inf for
+                # floats, iinfo min/max for ints); paddle zero-fills them.
+                # Mask by emptiness, not by value (int dtypes; real ±inf data)
+                counts = jax.ops.segment_sum(jnp.ones((v.shape[0],), jnp.int32),
+                                             ids, num_segments=n)
+                empty = (counts == 0).reshape((n,) + (1,) * (v.ndim - 1))
+                out = jnp.where(empty, jnp.zeros_like(out), out)
             return out
 
         return apply_op(name, fn, (data,))

@@ -27,3 +27,5 @@ from .layer.transformer import (MultiHeadAttention, Transformer, TransformerDeco
                                 TransformerEncoderLayer)
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 from ..framework.param_attr import ParamAttr  # noqa: F401
+from .layer.rnn import (RNN, GRU, LSTM, BiRNN, GRUCell, LSTMCell,  # noqa: E402,F401
+                        RNNCellBase, SimpleRNN, SimpleRNNCell)

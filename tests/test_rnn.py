@@ -1,0 +1,219 @@
+"""Recurrent layer tests (reference test/legacy_test/test_rnn_*.py strategy:
+compare against a numpy step-by-step recurrence with identical weights)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def np_lstm_seq(x, h, c, wih, whh, bih, bhh):
+    """x: [B, T, I] → outputs [B, T, H], (h, c)."""
+    outs = []
+    for t in range(x.shape[1]):
+        gates = x[:, t] @ wih.T + bih + h @ whh.T + bhh
+        i, f, g, o = np.split(gates, 4, axis=-1)
+        c = sigmoid(f) * c + sigmoid(i) * np.tanh(g)
+        h = sigmoid(o) * np.tanh(c)
+        outs.append(h)
+    return np.stack(outs, 1), h, c
+
+
+def np_gru_seq(x, h, wih, whh, bih, bhh):
+    outs = []
+    for t in range(x.shape[1]):
+        xg = x[:, t] @ wih.T + bih
+        hg = h @ whh.T + bhh
+        x_r, x_z, x_c = np.split(xg, 3, axis=-1)
+        h_r, h_z, h_c = np.split(hg, 3, axis=-1)
+        r, z = sigmoid(x_r + h_r), sigmoid(x_z + h_z)
+        cand = np.tanh(x_c + r * h_c)
+        h = (h - cand) * z + cand
+        outs.append(h)
+    return np.stack(outs, 1), h
+
+
+def cell_weights(cell):
+    return (cell.weight_ih.numpy(), cell.weight_hh.numpy(),
+            cell.bias_ih.numpy(), cell.bias_hh.numpy())
+
+
+class TestCells:
+    def test_lstm_cell_step(self):
+        cell = nn.LSTMCell(16, 32)
+        x = np.random.default_rng(0).standard_normal((4, 16)).astype(np.float32)
+        h0 = np.random.default_rng(1).standard_normal((4, 32)).astype(np.float32)
+        c0 = np.random.default_rng(2).standard_normal((4, 32)).astype(np.float32)
+        y, (h, c) = cell(paddle.to_tensor(x), (paddle.to_tensor(h0),
+                                               paddle.to_tensor(c0)))
+        _, h_ref, c_ref = np_lstm_seq(x[:, None], h0, c0, *cell_weights(cell))
+        np.testing.assert_allclose(h.numpy(), h_ref, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(c.numpy(), c_ref, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(y.numpy(), h.numpy())
+
+    def test_gru_cell_step(self):
+        cell = nn.GRUCell(8, 16)
+        x = np.random.default_rng(3).standard_normal((4, 8)).astype(np.float32)
+        h0 = np.random.default_rng(4).standard_normal((4, 16)).astype(np.float32)
+        y, h = cell(paddle.to_tensor(x), paddle.to_tensor(h0))
+        _, h_ref = np_gru_seq(x[:, None], h0, *cell_weights(cell))
+        np.testing.assert_allclose(h.numpy(), h_ref, rtol=1e-5, atol=1e-6)
+
+    def test_simple_cell_default_states(self):
+        cell = nn.SimpleRNNCell(8, 16)
+        y, h = cell(paddle.to_tensor(np.ones((2, 8), np.float32)))
+        assert y.shape == [2, 16]
+        x = np.ones((2, 8), np.float32)
+        wih, whh, bih, bhh = cell_weights(cell)
+        ref = np.tanh(x @ wih.T + bih + bhh)
+        np.testing.assert_allclose(y.numpy(), ref, rtol=1e-5, atol=1e-6)
+
+    def test_bad_hidden_size(self):
+        with pytest.raises(ValueError):
+            nn.LSTMCell(4, 0)
+
+
+class TestFusedLSTM:
+    def test_matches_numpy_recurrence(self):
+        rnn = nn.LSTM(8, 16)
+        x = np.random.default_rng(5).standard_normal((3, 7, 8)).astype(np.float32)
+        out, (h, c) = rnn(paddle.to_tensor(x))
+        ref_out, ref_h, ref_c = np_lstm_seq(
+            x, np.zeros((3, 16), np.float32), np.zeros((3, 16), np.float32),
+            *cell_weights(rnn.cells[0]))
+        np.testing.assert_allclose(out.numpy(), ref_out, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(h.numpy()[0], ref_h, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(c.numpy()[0], ref_c, rtol=1e-4, atol=1e-5)
+
+    def test_two_layers_shapes_and_final_states(self):
+        rnn = nn.LSTM(8, 16, num_layers=2)
+        out, (h, c) = rnn(paddle.to_tensor(np.zeros((2, 5, 8), np.float32)))
+        assert out.shape == [2, 5, 16]
+        assert h.shape == [2, 2, 16] and c.shape == [2, 2, 16]
+
+    def test_bidirectional(self):
+        rnn = nn.LSTM(8, 16, direction="bidirect")
+        x = np.random.default_rng(6).standard_normal((2, 5, 8)).astype(np.float32)
+        out, (h, c) = rnn(paddle.to_tensor(x))
+        assert out.shape == [2, 5, 32]
+        assert h.shape == [2, 2, 16]
+        # backward direction's output at t=0 is its final hidden state
+        np.testing.assert_allclose(out.numpy()[:, 0, 16:], h.numpy()[1],
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_time_major(self):
+        rnn = nn.LSTM(8, 16, time_major=True)
+        x = np.random.default_rng(7).standard_normal((5, 2, 8)).astype(np.float32)
+        out, _ = rnn(paddle.to_tensor(x))
+        assert out.shape == [5, 2, 16]
+        rnn2 = nn.LSTM(8, 16)
+        for c1, c2 in zip(rnn.cells, rnn2.cells):
+            c2.weight_ih.set_value(c1.weight_ih.numpy())
+            c2.weight_hh.set_value(c1.weight_hh.numpy())
+            c2.bias_ih.set_value(c1.bias_ih.numpy())
+            c2.bias_hh.set_value(c1.bias_hh.numpy())
+        out2, _ = rnn2(paddle.to_tensor(np.swapaxes(x, 0, 1)))
+        np.testing.assert_allclose(out.numpy(), np.swapaxes(out2.numpy(), 0, 1),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_sequence_length_masks(self):
+        rnn = nn.LSTM(4, 8)
+        x = np.random.default_rng(8).standard_normal((2, 6, 4)).astype(np.float32)
+        lens = np.array([3, 6])
+        out, (h, _) = rnn(paddle.to_tensor(x),
+                          sequence_length=paddle.to_tensor(lens))
+        # outputs beyond each length are zero
+        np.testing.assert_array_equal(out.numpy()[0, 3:], 0)
+        assert np.abs(out.numpy()[1, 3:]).sum() > 0
+        # final state of row 0 equals its step-3 state
+        ref_out, ref_h, _ = np_lstm_seq(
+            x[:1, :3], np.zeros((1, 8), np.float32), np.zeros((1, 8), np.float32),
+            *cell_weights(rnn.cells[0]))
+        np.testing.assert_allclose(h.numpy()[0, 0], ref_h[0], rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_trains_on_sequence_task(self):
+        """LSTM learns to output the sign of the cumulative sum."""
+        paddle.seed(0)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((64, 10, 1)).astype(np.float32)
+        y = (x.sum(axis=1) > 0).astype(np.int64).ravel()
+        rnn = nn.LSTM(1, 16)
+        head = nn.Linear(16, 2)
+        params = rnn.parameters() + head.parameters()
+        opt = paddle.optimizer.Adam(learning_rate=1e-2, parameters=params)
+        import paddle_tpu.nn.functional as F
+
+        losses = []
+        for _ in range(40):
+            out, (h, _) = rnn(paddle.to_tensor(x))
+            logits = head(h[0])
+            loss = F.cross_entropy(logits, paddle.to_tensor(y))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.35, (losses[0], losses[-1])
+
+
+class TestGRUAndSimple:
+    def test_gru_matches_numpy(self):
+        rnn = nn.GRU(8, 16)
+        x = np.random.default_rng(9).standard_normal((3, 6, 8)).astype(np.float32)
+        out, h = rnn(paddle.to_tensor(x))
+        ref_out, ref_h = np_gru_seq(x, np.zeros((3, 16), np.float32),
+                                    *cell_weights(rnn.cells[0]))
+        np.testing.assert_allclose(out.numpy(), ref_out, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(h.numpy()[0], ref_h, rtol=1e-4, atol=1e-5)
+
+    def test_simple_rnn_relu(self):
+        rnn = nn.SimpleRNN(4, 8, activation="relu")
+        out, h = rnn(paddle.to_tensor(np.random.default_rng(10)
+                                      .standard_normal((2, 5, 4))
+                                      .astype(np.float32)))
+        assert out.shape == [2, 5, 8]
+        assert (out.numpy() >= 0).all()
+
+    def test_rnn_wrapper_matches_fused(self):
+        cell = nn.GRUCell(4, 8)
+        wrapper = nn.RNN(cell)
+        fused = nn.GRU(4, 8)
+        for name in ("weight_ih", "weight_hh", "bias_ih", "bias_hh"):
+            getattr(fused.cells[0], name).set_value(getattr(cell, name).numpy())
+        x = np.random.default_rng(11).standard_normal((2, 5, 4)).astype(np.float32)
+        o1, h1 = wrapper(paddle.to_tensor(x))
+        o2, h2 = fused(paddle.to_tensor(x))
+        np.testing.assert_allclose(o1.numpy(), o2.numpy(), rtol=1e-5, atol=1e-6)
+
+    def test_birnn(self):
+        bi = nn.BiRNN(nn.GRUCell(4, 8), nn.GRUCell(4, 8))
+        out, (ff, fb) = bi(paddle.to_tensor(np.ones((2, 5, 4), np.float32)))
+        assert out.shape == [2, 5, 16]
+
+
+class TestReviewRegressions:
+    def test_disabled_bias_is_zero(self):
+        cell = nn.SimpleRNNCell(4, 8, bias_ih_attr=False, bias_hh_attr=False)
+        np.testing.assert_array_equal(cell.bias_ih.numpy(), 0.0)
+        np.testing.assert_array_equal(cell.bias_hh.numpy(), 0.0)
+        assert cell.bias_ih.stop_gradient
+
+    def test_lstm_positional_weight_attr_binds(self):
+        init = nn.initializer.Constant(0.5)
+        # paddle positional style: ..., dropout, weight_ih_attr
+        rnn = nn.LSTM(4, 8, 1, "forward", False, 0.0, init)
+        np.testing.assert_allclose(rnn.cells[0].weight_ih.numpy(), 0.5)
+
+    def test_segment_max_int_zero_fill(self):
+        from paddle_tpu import geometric as G
+
+        data = paddle.to_tensor(np.array([[5, 2], [7, 1]], np.int32))
+        out = G.segment_max(data, paddle.to_tensor(np.array([0, 0])),
+                            num_segments=3).numpy()
+        np.testing.assert_array_equal(out[1], [0, 0])  # empty → 0, not INT_MIN
+        np.testing.assert_array_equal(out[0], [7, 2])
