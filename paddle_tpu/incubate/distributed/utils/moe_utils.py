@@ -1,0 +1,112 @@
+"""MoE dispatch utilities (reference
+`python/paddle/distributed/utils/moe_utils.py`: global_scatter:20,
+global_gather:153 — the NCCL all-to-all transport under the reference
+MoELayer — plus `moe_layer.py` count_by_gate).
+
+TPU-native context: the in-tree MoE layers route with dense
+dispatch/combine einsums (see `incubate/distributed/models/moe`) — THAT is
+the jit/XLA path. These utilities keep the reference's count-based
+transport API for eager/host-side custom routing: the routing counts are
+data-dependent, so the index bookkeeping runs on the host (concrete
+counts required — calling them under jit raises a clear error); tokens are
+placed into a fixed-capacity [expert, capacity, d] buffer with the same
+drop/pad semantics as the layer's dispatch mask."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ....tensor.tensor import Tensor, apply_op
+from ....tensor._op_utils import ensure_tensor
+
+__all__ = ["count_by_gate", "global_scatter", "global_gather"]
+
+
+def count_by_gate(gate_idx, num_expert: int, world_size: int = 1,
+                  require_pos: bool = True, group=None):
+    """Per-expert routing statistics (reference moe_layer.py count_by_gate):
+    returns (pos, local_expert_count, global_expert_count).
+
+    ``pos``: for each token (in expert-sorted order) its stable position;
+    ``local_expert_count``: [num_expert * world_size] tokens this shard
+    routes to each global expert; ``global_expert_count``: identical here —
+    the single-controller view already sees all tokens (multi-process would
+    all-to-all the counts; under GSPMD the counts are global by
+    construction)."""
+    idx = ensure_tensor(gate_idx)._value.reshape(-1).astype(jnp.int32)
+    e = num_expert * world_size
+    counts = jnp.bincount(idx, length=e).astype(jnp.int32)
+    pos = (jnp.argsort(idx, stable=True).astype(jnp.int32) if require_pos
+           else jnp.zeros((0,), jnp.int32))
+    return Tensor(pos), Tensor(counts), Tensor(counts)
+
+
+def global_scatter(x, local_count, global_count, group=None,
+                   use_calc_stream: bool = True,
+                   capacity: Optional[int] = None) -> Tensor:
+    """Reorder tokens into per-expert contiguous rows (reference
+    global_scatter:20 sends rows to the expert's owner rank via all-to-all).
+
+    Static reformulation: returns ``[E, capacity, d]`` — expert ``e``'s
+    buffer holds its tokens in arrival order, zero-padded (over-capacity
+    tokens dropped, exactly the MoE layer's semantics). ``local_count``:
+    [E] counts as produced by :func:`count_by_gate`; expert assignment is
+    reconstructed from the counts (tokens arrive expert-sorted via ``pos``)."""
+    x = ensure_tensor(x)
+    cv = ensure_tensor(local_count)._value
+    if isinstance(cv, jax.core.Tracer):
+        raise RuntimeError(
+            "global_scatter runs host-side routing on concrete counts and "
+            "cannot be traced — inside jit use the MoE layers' dispatch "
+            "einsums (incubate.distributed.models.moe)")
+    counts = np.asarray(cv).astype(np.int64)
+    e = int(counts.shape[0])
+    n, d = x.shape
+    cap = int(capacity) if capacity is not None else max(1, int(counts.max())) \
+        if counts.size else 1
+
+    # expert id and slot of each (expert-sorted) row — static given counts
+    expert_of = np.repeat(np.arange(e), counts)[:n]
+    slot_of = np.concatenate([np.arange(c) for c in counts])[:n] if n else \
+        np.zeros((0,), np.int64)
+    keep = slot_of < cap
+
+    def fn(v):
+        out = jnp.zeros((e, cap, d), v.dtype)
+        return out.at[expert_of[keep], slot_of[keep]].set(v[keep])
+
+    return apply_op("global_scatter", fn, (x,))
+
+
+def global_gather(x, local_count, global_count, group=None,
+                  use_calc_stream: bool = True) -> Tensor:
+    """Inverse of :func:`global_scatter` (reference global_gather:153):
+    flatten the [E, capacity, d] expert buffers back to the expert-sorted
+    token order described by ``local_count``. Dropped (over-capacity)
+    tokens come back as zero rows — the layer's combine treats them as
+    non-contributing."""
+    x = ensure_tensor(x)
+    cv = ensure_tensor(local_count)._value
+    if isinstance(cv, jax.core.Tracer):
+        raise RuntimeError(
+            "global_gather runs host-side routing on concrete counts and "
+            "cannot be traced — inside jit use the MoE layers' combine "
+            "einsums (incubate.distributed.models.moe)")
+    counts = np.asarray(cv).astype(np.int64)
+    e, cap, d = x.shape
+    n = int(counts.sum())
+    expert_of = np.repeat(np.arange(e), counts)
+    slot_of = np.concatenate([np.arange(c) for c in counts]) if n else \
+        np.zeros((0,), np.int64)
+    keep = slot_of < cap
+
+    def fn(v):
+        out = jnp.zeros((n, d), v.dtype)
+        return out.at[jnp.asarray(np.arange(n)[keep])].set(
+            v[expert_of[keep], slot_of[keep]])
+
+    return apply_op("global_gather", fn, (x,))
