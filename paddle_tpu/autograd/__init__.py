@@ -14,7 +14,8 @@ from .tape import backward as _tape_backward
 from .tape import enable_grad, is_grad_enabled, no_grad, set_grad_enabled
 
 __all__ = ["backward", "grad", "no_grad", "enable_grad", "is_grad_enabled",
-           "set_grad_enabled", "PyLayer", "PyLayerContext"]
+           "set_grad_enabled", "PyLayer", "PyLayerContext",
+           "jacobian", "hessian", "vjp", "jvp"]
 
 
 def backward(tensors, grad_tensors=None, retain_graph: bool = False) -> None:
@@ -154,3 +155,5 @@ class PyLayer(metaclass=_PyLayerMeta):
 
 class LegacyPyLayer(PyLayer):
     pass
+
+from .functional import hessian, jacobian, jvp, vjp  # noqa: E402,F401

@@ -39,6 +39,9 @@ def count_by_gate(gate_idx, num_expert: int, world_size: int = 1,
     construction)."""
     idx = ensure_tensor(gate_idx)._value.reshape(-1).astype(jnp.int32)
     e = num_expert * world_size
+    if idx.size and (int(idx.max()) >= e or int(idx.min()) < 0):
+        raise ValueError(f"gate index out of range [0, {e}): "
+                         f"min={int(idx.min())}, max={int(idx.max())}")
     counts = jnp.bincount(idx, length=e).astype(jnp.int32)
     pos = (jnp.argsort(idx, stable=True).astype(jnp.int32) if require_pos
            else jnp.zeros((0,), jnp.int32))
