@@ -1,0 +1,6 @@
+"""paddle.audio (reference `python/paddle/audio/`): feature front-ends +
+mel/window functional. Backends (file I/O) are out of scope — waveforms
+come in as tensors."""
+
+from . import features  # noqa: F401
+from . import functional  # noqa: F401

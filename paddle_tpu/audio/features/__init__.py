@@ -1,0 +1,124 @@
+"""paddle.audio.features (reference `python/paddle/audio/features/layers.py`:
+Spectrogram:24, MelSpectrogram:106, LogMelSpectrogram:206, MFCC:309).
+Each layer composes paddle_tpu.signal.stft with the functional mel/DCT
+matrices — differentiable feature front-ends that jit like any layer."""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from ... import signal as _signal
+from ...nn.layer.layers import Layer
+from ...tensor.tensor import Tensor, apply_op
+from ..functional import (compute_fbank_matrix, create_dct, get_window,
+                          power_to_db)
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    """|STFT|^power of [N, T] waveforms → [N, n_fft//2+1, num_frames]."""
+
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = 512,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 1.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        if power <= 0:
+            raise ValueError("power must be positive")
+        self.n_fft = n_fft
+        self.hop_length = hop_length if hop_length is not None else n_fft // 4
+        self.win_length = win_length if win_length is not None else n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.register_buffer("window",
+                             get_window(window, self.win_length, dtype=dtype),
+                             persistable=False)
+
+    def forward(self, x: Tensor) -> Tensor:
+        spec = _signal.stft(x, n_fft=self.n_fft, hop_length=self.hop_length,
+                            win_length=self.win_length, window=self.window,
+                            center=self.center, pad_mode=self.pad_mode)
+        p = self.power
+        return apply_op("spec_power",
+                        lambda s: jnp.abs(s) ** p if p != 2.0
+                        else (s.real * s.real + s.imag * s.imag), (spec,))
+
+
+class MelSpectrogram(Layer):
+    """Spectrogram projected onto a mel filterbank (reference :106)."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 2048,
+                 hop_length: Optional[int] = 512, win_length: Optional[int] = None,
+                 window: str = "hann", power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64, f_min: float = 50.0,
+                 f_max: Optional[float] = None, htk: bool = False,
+                 norm: str = "slaney", dtype: str = "float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                        power, center, pad_mode, dtype)
+        self.register_buffer(
+            "fbank_matrix",
+            compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max, htk, norm,
+                                 dtype),
+            persistable=False)
+
+    def forward(self, x: Tensor) -> Tensor:
+        spec = self._spectrogram(x)
+        fb = self.fbank_matrix
+        return apply_op("mel_project",
+                        lambda s, m: jnp.einsum("mf,...ft->...mt", m, s),
+                        (spec, fb))
+
+
+class LogMelSpectrogram(Layer):
+    """power_to_db(MelSpectrogram) (reference :206)."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None, win_length: Optional[int] = None,
+                 window: str = "hann", power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64, f_min: float = 50.0,
+                 f_max: Optional[float] = None, htk: bool = False,
+                 norm: str = "slaney", ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None, dtype: str = "float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(sr, n_fft, hop_length, win_length,
+                                              window, power, center, pad_mode,
+                                              n_mels, f_min, f_max, htk, norm,
+                                              dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x: Tensor) -> Tensor:
+        return power_to_db(self._melspectrogram(x), self.ref_value, self.amin,
+                           self.top_db)
+
+
+class MFCC(Layer):
+    """DCT of the log-mel spectrogram (reference :309)."""
+
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 512,
+                 hop_length: Optional[int] = None, win_length: Optional[int] = None,
+                 window: str = "hann", power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64, f_min: float = 50.0,
+                 f_max: Optional[float] = None, htk: bool = False,
+                 norm: str = "slaney", ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None, dtype: str = "float32"):
+        super().__init__()
+        if n_mfcc > n_mels:
+            raise ValueError("n_mfcc cannot be larger than n_mels")
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center, pad_mode,
+            n_mels, f_min, f_max, htk, norm, ref_value, amin, top_db, dtype)
+        self.register_buffer("dct_matrix", create_dct(n_mfcc, n_mels, dtype=dtype),
+                             persistable=False)
+
+    def forward(self, x: Tensor) -> Tensor:
+        logmel = self._log_melspectrogram(x)
+        return apply_op("mfcc_dct",
+                        lambda lm, d: jnp.einsum("nk,...nt->...kt", d, lm),
+                        (logmel, self.dct_matrix))

@@ -1,0 +1,159 @@
+"""paddle.audio.functional (reference
+`python/paddle/audio/functional/functional.py`: hz_to_mel:24, mel_to_hz:80,
+compute_fbank_matrix:188, power_to_db:261, create_dct:305; `window.py`
+get_window). Pure jnp — mel math matches librosa's Slaney scale exactly as
+the reference does."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor.tensor import Tensor, apply_op
+from ...tensor._op_utils import ensure_tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct", "get_window"]
+
+
+def _is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+def hz_to_mel(freq, htk: bool = False):
+    """Hz → mel (Slaney by default, HTK optional; reference :24)."""
+    tensor_in = _is_tensor(freq)
+    f = freq._value if tensor_in else freq
+    f_sp = 200.0 / 3
+    min_log_hz = 1000.0
+    min_log_mel = min_log_hz / f_sp
+    logstep = math.log(6.4) / 27.0
+    if tensor_in:
+        def fn(v):
+            if htk:
+                return 2595.0 * jnp.log10(1.0 + v / 700.0)
+            mels = v / f_sp
+            target = min_log_mel + jnp.log(v / min_log_hz + 1e-10) / logstep
+            return jnp.where(v > min_log_hz, target, mels)
+
+        return apply_op("hz_to_mel", fn, (freq,))
+    if htk:
+        return 2595.0 * math.log10(1.0 + f / 700.0)
+    mels = f / f_sp
+    if f >= min_log_hz:
+        mels = min_log_mel + math.log(f / min_log_hz + 1e-10) / logstep
+    return mels
+
+
+def mel_to_hz(mel, htk: bool = False):
+    """mel → Hz (reference :80)."""
+    tensor_in = _is_tensor(mel)
+    m = mel._value if tensor_in else mel
+    f_sp = 200.0 / 3
+    min_log_hz = 1000.0
+    min_log_mel = min_log_hz / f_sp
+    logstep = math.log(6.4) / 27.0
+    if tensor_in:
+        def fn(v):
+            if htk:
+                return 700.0 * (10.0 ** (v / 2595.0) - 1.0)
+            freqs = f_sp * v
+            target = min_log_hz * jnp.exp(logstep * (v - min_log_mel))
+            return jnp.where(v > min_log_mel, target, freqs)
+
+        return apply_op("mel_to_hz", fn, (mel,))
+    if htk:
+        return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    freqs = f_sp * m
+    if m >= min_log_mel:
+        freqs = min_log_hz * math.exp(logstep * (m - min_log_mel))
+    return freqs
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0, f_max: float = 11025.0,
+                    htk: bool = False, dtype: str = "float32") -> Tensor:
+    low = hz_to_mel(f_min, htk)
+    high = hz_to_mel(f_max, htk)
+    mels = np.linspace(low, high, n_mels)
+    return Tensor(jnp.asarray([mel_to_hz(float(m), htk) for m in mels],
+                              dtype=jnp.dtype(dtype)))
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype: str = "float32") -> Tensor:
+    return Tensor(jnp.linspace(0, sr / 2, 1 + n_fft // 2, dtype=jnp.dtype(dtype)))
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False, norm: str = "slaney",
+                         dtype: str = "float32") -> Tensor:
+    """Triangular mel filterbank [n_mels, 1 + n_fft//2] (reference :188)."""
+    f_max = f_max if f_max is not None else float(sr) / 2
+    fftfreqs = np.asarray(fft_frequencies(sr, n_fft)._value)
+    mel_f = np.asarray(mel_frequencies(n_mels + 2, f_min, f_max, htk)._value)
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0.0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2: n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    elif isinstance(norm, (int, float)):
+        # reference p-normalizes each filter row for numeric norm
+        p_norm = np.linalg.norm(weights, ord=norm, axis=-1, keepdims=True)
+        weights = weights / np.maximum(p_norm, 1e-12)
+    elif norm is not None:
+        raise ValueError("norm must be 'slaney', a p-norm number, or None")
+    return Tensor(jnp.asarray(weights, dtype=jnp.dtype(dtype)))
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0) -> Tensor:
+    """Power → dB with optional dynamic-range clipping (reference :261)."""
+    if amin <= 0:
+        raise ValueError("amin must be strictly positive")
+    if ref_value <= 0:
+        raise ValueError("ref_value must be strictly positive")
+    spect = ensure_tensor(spect)
+
+    def fn(s):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+        log_spec = log_spec - 10.0 * math.log10(max(ref_value, amin))
+        if top_db is not None:
+            if top_db < 0:
+                raise ValueError("top_db must be non-negative")
+            log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+        return log_spec
+
+    return apply_op("power_to_db", fn, (spect,))
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho",
+               dtype: str = "float32") -> Tensor:
+    """DCT-II matrix [n_mels, n_mfcc] (reference :305)."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)[None, :]
+    dct = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm is None:
+        dct *= 2.0
+    elif norm == "ortho":
+        dct[:, 0] *= math.sqrt(1.0 / n_mels)
+        dct[:, 1:] *= math.sqrt(2.0 / n_mels)
+    else:
+        raise ValueError("norm must be 'ortho' or None")
+    return Tensor(jnp.asarray(dct, dtype=jnp.dtype(dtype)))
+
+
+def get_window(window: Union[str, tuple], win_length: int, fftbins: bool = True,
+               dtype: str = "float32") -> Tensor:
+    """Window function by name (reference window.py get_window): hann,
+    hamming, blackman, bartlett, bohman, gaussian(std), taylor — via scipy
+    (matching values; the reference reimplements the same formulas)."""
+    from scipy.signal import get_window as sp_get_window
+
+    w = sp_get_window(window, win_length, fftbins=fftbins)
+    return Tensor(jnp.asarray(w, dtype=jnp.dtype(dtype)))

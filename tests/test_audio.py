@@ -1,0 +1,158 @@
+"""paddle.audio tests (reference test/legacy_test/test_audio_functions.py
+compares against librosa; here the anchors are librosa-identical closed
+forms and scipy)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.audio import features, functional as AF
+
+
+class TestFunctional:
+    def test_hz_mel_roundtrip_scalar_and_tensor(self):
+        for htk in (False, True):
+            for hz in (60.0, 440.0, 4000.0):
+                mel = AF.hz_to_mel(hz, htk)
+                back = AF.mel_to_hz(mel, htk)
+                assert back == pytest.approx(hz, rel=1e-4)
+            t = paddle.to_tensor(np.array([60.0, 440.0, 4000.0], np.float32))
+            back_t = AF.mel_to_hz(AF.hz_to_mel(t, htk), htk)
+            np.testing.assert_allclose(back_t.numpy(), t.numpy(), rtol=1e-3)
+
+    def test_slaney_anchor_values(self):
+        # librosa.hz_to_mel(1000) == 15.0 on the Slaney scale
+        assert AF.hz_to_mel(1000.0) == pytest.approx(15.0, rel=1e-6)
+        assert AF.mel_to_hz(15.0) == pytest.approx(1000.0, rel=1e-6)
+
+    def test_fft_frequencies(self):
+        np.testing.assert_allclose(AF.fft_frequencies(16000, 16).numpy(),
+                                   [0, 1000, 2000, 3000, 4000, 5000, 6000,
+                                    7000, 8000])
+
+    def test_fbank_matrix_properties(self):
+        fb = AF.compute_fbank_matrix(16000, 512, n_mels=40).numpy()
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+        assert (fb.sum(axis=1) > 0).all()  # every filter non-empty
+
+    def test_power_to_db(self):
+        s = paddle.to_tensor(np.array([1.0, 0.1, 1e-12], np.float32))
+        db = AF.power_to_db(s, top_db=None).numpy()
+        np.testing.assert_allclose(db[:2], [0.0, -10.0], atol=1e-4)
+        assert db[2] == pytest.approx(-100.0)  # amin floor
+        clipped = AF.power_to_db(s, top_db=20.0).numpy()
+        assert clipped.min() == pytest.approx(clipped.max() - 20.0)
+        with pytest.raises(ValueError):
+            AF.power_to_db(s, amin=0)
+
+    def test_create_dct_ortho(self):
+        d = AF.create_dct(13, 40).numpy()
+        assert d.shape == (40, 13)
+        # orthonormal columns
+        np.testing.assert_allclose(d.T @ d, np.eye(13), atol=1e-5)
+
+    def test_get_window(self):
+        w = AF.get_window("hann", 16).numpy()
+        np.testing.assert_allclose(w, np.hanning(17)[:16], atol=1e-6)
+
+
+class TestFeatureLayers:
+    wave = np.sin(2 * np.pi * 440 * np.linspace(0, 1, 8000)).astype(np.float32)[None]
+
+    def test_spectrogram_peak_at_tone(self):
+        layer = features.Spectrogram(n_fft=512, hop_length=256)
+        spec = layer(paddle.to_tensor(self.wave)).numpy()[0]
+        assert spec.shape[0] == 257
+        peak_bin = spec.mean(axis=1).argmax()
+        freq = peak_bin * 8000 / 512
+        assert abs(freq - 440) < 20
+
+    def test_mel_spectrogram_shape(self):
+        layer = features.MelSpectrogram(sr=8000, n_fft=512, hop_length=256,
+                                        n_mels=40, f_max=4000)
+        mel = layer(paddle.to_tensor(self.wave)).numpy()[0]
+        assert mel.shape[0] == 40
+        assert (mel >= 0).all()
+
+    def test_log_mel_and_mfcc(self):
+        logmel = features.LogMelSpectrogram(sr=8000, n_fft=512, hop_length=256,
+                                            n_mels=40, f_max=4000)
+        lm = logmel(paddle.to_tensor(self.wave))
+        assert np.isfinite(lm.numpy()).all()
+        mfcc = features.MFCC(sr=8000, n_mfcc=13, n_fft=512, hop_length=256,
+                             n_mels=40, f_max=4000)
+        out = mfcc(paddle.to_tensor(self.wave)).numpy()[0]
+        assert out.shape[0] == 13
+        assert np.isfinite(out).all()
+
+    def test_mfcc_validates_n_mfcc(self):
+        with pytest.raises(ValueError, match="n_mfcc"):
+            features.MFCC(n_mfcc=80, n_mels=40)
+
+    def test_features_differentiable(self):
+        layer = features.MelSpectrogram(sr=8000, n_fft=256, hop_length=128,
+                                        n_mels=20, f_max=4000)
+        x = paddle.to_tensor(self.wave[:, :2048], stop_gradient=False)
+        layer(x).sum().backward()
+        assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+    def test_trains_tone_classifier(self):
+        """End-to-end: MFCC front-end + linear head learns tone A vs B."""
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+
+        paddle.seed(0)
+        t = np.linspace(0, 0.25, 2000).astype(np.float32)
+        rng = np.random.default_rng(0)
+        waves, labels = [], []
+        for i in range(32):
+            f0 = 440 if i % 2 == 0 else 880
+            waves.append(np.sin(2 * np.pi * f0 * t) +
+                         0.1 * rng.standard_normal(2000).astype(np.float32))
+            labels.append(i % 2)
+        waves = np.stack(waves).astype(np.float32)
+        labels = np.asarray(labels)
+        front = features.MFCC(sr=8000, n_mfcc=13, n_fft=256, hop_length=128,
+                              n_mels=24, f_max=4000)
+        head = nn.Linear(13, 2)
+        opt = paddle.optimizer.Adam(learning_rate=5e-2,
+                                    parameters=head.parameters())
+        losses = []
+        for _ in range(25):
+            feats = front(paddle.to_tensor(waves)).mean(axis=-1)
+            loss = F.cross_entropy(head(feats), paddle.to_tensor(labels))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+
+
+class TestReferenceDefaults:
+    def test_spectrogram_default_power_is_magnitude(self):
+        wave = np.sin(np.linspace(0, 50, 2048)).astype(np.float32)[None]
+        mag = features.Spectrogram(n_fft=256, hop_length=128)(
+            paddle.to_tensor(wave)).numpy()
+        pow2 = features.Spectrogram(n_fft=256, hop_length=128, power=2.0)(
+            paddle.to_tensor(wave)).numpy()
+        np.testing.assert_allclose(mag ** 2, pow2, rtol=1e-3, atol=1e-4)
+
+    def test_hop_defaults(self):
+        assert features.MFCC(sr=8000, n_fft=512)._log_melspectrogram\
+            ._melspectrogram._spectrogram.hop_length == 128  # n_fft // 4
+        assert features.MelSpectrogram(sr=8000).\
+            _spectrogram.n_fft == 2048
+
+    def test_fbank_numeric_norm(self):
+        fb = AF.compute_fbank_matrix(16000, 512, n_mels=20, norm=1).numpy()
+        np.testing.assert_allclose(np.abs(fb).sum(axis=1), 1.0, rtol=1e-5)
+        fb2 = AF.compute_fbank_matrix(16000, 512, n_mels=20, norm=2).numpy()
+        np.testing.assert_allclose(np.linalg.norm(fb2, axis=1), 1.0, rtol=1e-5)
+
+    def test_hz_mel_tensor_grad(self):
+        f = paddle.to_tensor(np.array([500.0, 2000.0], np.float32),
+                             stop_gradient=False)
+        AF.hz_to_mel(f).sum().backward()
+        assert f.grad is not None
+        assert (f.grad.numpy() > 0).all()  # monotone increasing
