@@ -1,0 +1,244 @@
+"""paddle.vision.ops — detection ops (reference `python/paddle/vision/ops.py`:
+nms:1867, roi_align:1640, RoIAlign:1761, box_coder:573,
+distribute_fpn_proposals:1156; CUDA kernels under phi/kernels/gpu).
+
+TPU-native notes: NMS is inherently data-dependent (variable output count);
+the eager path returns the exact variable-length result like the reference,
+and a ``fixed_output_size`` option gives the jit-compilable padded form
+(score-sorted keep indices, -1-padded) that detection heads on TPU actually
+use. roi_align is expressed as dense bilinear gather+mean — XLA fuses it;
+no atomics needed (the CUDA kernel's whole reason to exist)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer.layers import Layer
+from ..tensor.tensor import Tensor, apply_op
+from ..tensor._op_utils import ensure_tensor
+
+__all__ = ["nms", "box_iou", "roi_align", "RoIAlign", "box_coder"]
+
+
+def _pairwise_iou(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    area1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / jnp.maximum(area1[:, None] + area2[None, :] - inter, 1e-10)
+
+
+def box_iou(boxes1, boxes2) -> Tensor:
+    """Pairwise IoU [N, M] of xyxy boxes (helper the reference inlines in
+    its NMS kernels)."""
+    return apply_op("box_iou", _pairwise_iou,
+                    (ensure_tensor(boxes1), ensure_tensor(boxes2)))
+
+
+def _nms_keep_mask(boxes: jnp.ndarray, scores: jnp.ndarray,
+                   iou_threshold: float) -> jnp.ndarray:
+    """Greedy NMS as a fixed-trip-count scan over score-sorted candidates:
+    returns a keep mask in the SORTED order — jit-compilable (the
+    data-dependence lives in the mask, not in shapes)."""
+    order = jnp.argsort(-scores)
+    b = boxes[order]
+    n = b.shape[0]
+    iou = _pairwise_iou(b, b)
+
+    def body(keep, i):
+        # i survives iff no higher-scored kept box overlaps it
+        suppressed = jnp.any(keep & (jnp.arange(n) < i) & (iou[i] > iou_threshold))
+        keep = keep.at[i].set(~suppressed)
+        return keep, None
+
+    keep0 = jnp.zeros((n,), bool).at[0].set(True) if n else jnp.zeros((0,), bool)
+    keep, _ = jax.lax.scan(body, keep0, jnp.arange(n))
+    return keep, order
+
+
+def nms(boxes, iou_threshold: float = 0.3, scores=None, category_idxs=None,
+        categories=None, top_k: Optional[int] = None,
+        fixed_output_size: Optional[int] = None):
+    """Greedy (optionally category-wise) NMS (reference ops.py:1867).
+    Returns kept box indices sorted by score. With ``fixed_output_size`` the
+    result is padded with -1 to a static shape (the TPU/jit form)."""
+    b = ensure_tensor(boxes)
+    n = b.shape[0]
+    s = ensure_tensor(scores) if scores is not None else None
+
+    if category_idxs is not None:
+        if s is None:
+            raise ValueError("category-wise nms requires scores")
+        cidx = np.asarray(ensure_tensor(category_idxs)._value)
+        keep_all: List[int] = []
+        sc = np.asarray(s._value)
+        for c in (categories if categories is not None else np.unique(cidx)):
+            sel = np.nonzero(cidx == c)[0]
+            if sel.size == 0:
+                continue
+            sub = nms(Tensor(b._value[sel]), iou_threshold, Tensor(s._value[sel]))
+            keep_all.extend(sel[np.asarray(sub._value)].tolist())
+        keep_all = sorted(keep_all, key=lambda i: -sc[i])
+        if top_k is not None:
+            keep_all = keep_all[:top_k]
+        if fixed_output_size is not None:
+            k = int(fixed_output_size)
+            keep_all = (keep_all[:k] + [-1] * max(0, k - len(keep_all)))
+        return Tensor(jnp.asarray(keep_all, jnp.int64))
+
+    score_v = s._value if s is not None else jnp.arange(n, 0, -1, dtype=jnp.float32)
+    keep, order = _nms_keep_mask(b._value.astype(jnp.float32),
+                                 score_v.astype(jnp.float32), iou_threshold)
+
+    if fixed_output_size is not None:
+        # static-shape form: rank-indexed scatter into k+1 slots (slot k is
+        # the spill for suppressed boxes AND kept ranks >= k — no index
+        # collision inside [0, k)), then slice
+        k = int(fixed_output_size)
+        rank = jnp.where(keep, jnp.cumsum(keep) - 1, k)
+        out = jnp.full((k + 1,), -1, jnp.int64)
+        out = out.at[jnp.minimum(rank, k)].set(
+            jnp.where(keep, order, -1).astype(jnp.int64))
+        return Tensor(out[:k])
+
+    kept = np.asarray(order)[np.asarray(keep)]
+    if top_k is not None:
+        kept = kept[:top_k]
+    return Tensor(jnp.asarray(kept, jnp.int64))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
+              sampling_ratio: int = -1, aligned: bool = True, name=None) -> Tensor:
+    """RoIAlign (reference ops.py:1640): bilinear-sampled pooled features
+    [total_boxes, C, out_h, out_w]. Dense vmapped gather formulation — one
+    fused XLA program instead of the CUDA kernel's atomics.
+
+    ``sampling_ratio=-1`` adapts to ceil(roi_size/output_size) like the
+    reference when boxes are concrete (eager); under tracing it falls back
+    to 2 (grid shapes must be static). Samples outside the feature map
+    contribute ZERO (the reference's y<-1 / y>height rule), not clamped
+    edge values."""
+    x = ensure_tensor(x)
+    boxes_t = ensure_tensor(boxes)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    counts = np.asarray(ensure_tensor(boxes_num)._value).astype(np.int64)
+    img_of_box = jnp.asarray(np.repeat(np.arange(len(counts)), counts))
+    off = 0.5 if aligned else 0.0
+
+    if sampling_ratio > 0:
+        sr = int(sampling_ratio)
+    else:
+        bv = boxes_t._value
+        if isinstance(bv, jax.core.Tracer):
+            sr = 2  # static fallback under tracing
+        else:
+            bb = np.asarray(bv) * spatial_scale
+            if bb.shape[0]:
+                sr = int(max(1, np.ceil(max(
+                    (bb[:, 2] - bb[:, 0]).max() / ow,
+                    (bb[:, 3] - bb[:, 1]).max() / oh))))
+                sr = min(sr, 16)  # grid-size guard
+            else:
+                sr = 1
+
+    def fn(feat, bx):
+        c = feat.shape[1]
+        h, w = feat.shape[-2:]
+        scaled = bx * spatial_scale - off
+
+        def one_box(img_idx, box):
+            x0, y0, x1, y1 = box
+            bw = jnp.maximum(x1 - x0, 1e-6)
+            bh = jnp.maximum(y1 - y0, 1e-6)
+            gy = y0 + (jnp.arange(oh * sr) + 0.5) * bh / (oh * sr)
+            gx = x0 + (jnp.arange(ow * sr) + 0.5) * bw / (ow * sr)
+            # reference OOB rule: samples with y<-1 or y>height give 0
+            valid = ((gy >= -1.0) & (gy <= h))[:, None] & \
+                    ((gx >= -1.0) & (gx <= w))[None, :]
+            ys = jnp.clip(gy, 0, h - 1)
+            xs = jnp.clip(gx, 0, w - 1)
+            y0i = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+            x0i = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+            y1i = jnp.clip(y0i + 1, 0, h - 1)
+            x1i = jnp.clip(x0i + 1, 0, w - 1)
+            wy = ys - y0i
+            wx = xs - x0i
+            img = feat[img_idx]
+            g = lambda yy, xx: img[:, yy[:, None], xx[None, :]]
+            samples = (g(y0i, x0i) * (1 - wy)[None, :, None] * (1 - wx)[None, None, :]
+                       + g(y0i, x1i) * (1 - wy)[None, :, None] * wx[None, None, :]
+                       + g(y1i, x0i) * wy[None, :, None] * (1 - wx)[None, None, :]
+                       + g(y1i, x1i) * wy[None, :, None] * wx[None, None, :])
+            samples = jnp.where(valid[None], samples, 0.0)
+            return samples.reshape(c, oh, sr, ow, sr).mean(axis=(2, 4))
+
+        if bx.shape[0] == 0:
+            return jnp.zeros((0, c, oh, ow), feat.dtype)
+        return jax.vmap(one_box)(img_of_box, scaled)
+
+    return apply_op("roi_align", fn, (x, boxes_t))
+
+
+class RoIAlign(Layer):
+    """Layer wrapper (reference ops.py:1761)."""
+
+    def __init__(self, output_size, spatial_scale: float = 1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num, aligned: bool = True):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale, aligned=aligned)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type: str = "encode_center_size", box_normalized: bool = True,
+              axis: int = 0, name=None) -> Tensor:
+    """Encode/decode boxes against priors (reference ops.py:573)."""
+    if axis != 0:
+        raise NotImplementedError("box_coder axis=1 (rank-3 broadcast) is not "
+                                  "implemented; reshape to [N, 4] per prior")
+    pb = ensure_tensor(prior_box)
+    tb = ensure_tensor(target_box)
+    if prior_box_var is None:  # reference: None means no variance scaling
+        pbv = Tensor(jnp.ones((1, 4), jnp.float32))
+    elif isinstance(prior_box_var, (list, tuple)):
+        pbv = Tensor(jnp.asarray(prior_box_var, jnp.float32))
+    else:
+        pbv = ensure_tensor(prior_box_var)
+    norm = 0.0 if box_normalized else 1.0
+
+    def fn(p, v, t):
+        pw = p[:, 2] - p[:, 0] + norm
+        ph = p[:, 3] - p[:, 1] + norm
+        pcx = p[:, 0] + pw * 0.5
+        pcy = p[:, 1] + ph * 0.5
+        v = jnp.broadcast_to(v.reshape(-1, 4) if v.ndim == 1 else v, p.shape)
+        if code_type == "encode_center_size":
+            tw = t[:, 2] - t[:, 0] + norm
+            th = t[:, 3] - t[:, 1] + norm
+            tcx = t[:, 0] + tw * 0.5
+            tcy = t[:, 1] + th * 0.5
+            out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
+                             jnp.log(tw / pw), jnp.log(th / ph)], axis=1)
+            return out / v
+        if code_type == "decode_center_size":
+            d = t * v
+            cx = d[:, 0] * pw + pcx
+            cy = d[:, 1] * ph + pcy
+            w = jnp.exp(d[:, 2]) * pw
+            h = jnp.exp(d[:, 3]) * ph
+            return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                              cx + w * 0.5 - norm, cy + h * 0.5 - norm], axis=1)
+        raise ValueError("code_type must be encode_center_size or decode_center_size")
+
+    return apply_op("box_coder", fn, (pb, pbv, tb))

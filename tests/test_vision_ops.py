@@ -1,0 +1,212 @@
+"""vision.ops tests (reference test/legacy_test/test_nms_op.py,
+test_roi_align_op.py — numpy loop references)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def np_nms(boxes, scores, thr):
+    order = np.argsort(-scores)
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(i)
+        if order.size == 1:
+            break
+        xx1 = np.maximum(boxes[i, 0], boxes[order[1:], 0])
+        yy1 = np.maximum(boxes[i, 1], boxes[order[1:], 1])
+        xx2 = np.minimum(boxes[i, 2], boxes[order[1:], 2])
+        yy2 = np.minimum(boxes[i, 3], boxes[order[1:], 3])
+        inter = np.maximum(0, xx2 - xx1) * np.maximum(0, yy2 - yy1)
+        a_i = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+        a_o = (boxes[order[1:], 2] - boxes[order[1:], 0]) * \
+              (boxes[order[1:], 3] - boxes[order[1:], 1])
+        iou = inter / (a_i + a_o - inter + 1e-10)
+        order = order[1:][iou <= thr]
+    return np.asarray(keep)
+
+
+def rand_boxes(n, seed=0):
+    rng = np.random.default_rng(seed)
+    xy = rng.uniform(0, 50, (n, 2))
+    wh = rng.uniform(5, 30, (n, 2))
+    return np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+
+
+class TestNMS:
+    def test_matches_numpy_greedy(self):
+        boxes = rand_boxes(40)
+        scores = np.random.default_rng(1).random(40).astype(np.float32)
+        got = V.nms(paddle.to_tensor(boxes), 0.5,
+                    paddle.to_tensor(scores)).numpy()
+        np.testing.assert_array_equal(got, np_nms(boxes, scores, 0.5))
+
+    def test_no_scores_uses_input_order(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                         np.float32)
+        got = V.nms(paddle.to_tensor(boxes), 0.5).numpy()
+        np.testing.assert_array_equal(got, [0, 2])  # box1 suppressed by box0
+
+    def test_top_k(self):
+        boxes = rand_boxes(30, seed=2)
+        scores = np.random.default_rng(3).random(30).astype(np.float32)
+        got = V.nms(paddle.to_tensor(boxes), 0.4, paddle.to_tensor(scores),
+                    top_k=3).numpy()
+        assert len(got) <= 3
+        np.testing.assert_array_equal(got, np_nms(boxes, scores, 0.4)[:3])
+
+    def test_categorywise(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11],
+                          [0, 0, 10, 10]], np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        cats = np.array([0, 0, 1])
+        got = V.nms(paddle.to_tensor(boxes), 0.5, paddle.to_tensor(scores),
+                    category_idxs=paddle.to_tensor(cats), categories=[0, 1]).numpy()
+        # box1 suppressed within cat 0; box2 survives in cat 1
+        np.testing.assert_array_equal(sorted(got), [0, 2])
+
+    def test_fixed_output_size_padded(self):
+        boxes = rand_boxes(20, seed=4)
+        scores = np.random.default_rng(5).random(20).astype(np.float32)
+        ref = np_nms(boxes, scores, 0.5)
+        got = V.nms(paddle.to_tensor(boxes), 0.5, paddle.to_tensor(scores),
+                    fixed_output_size=20).numpy()
+        assert got.shape == (20,)
+        np.testing.assert_array_equal(got[:len(ref)], ref)
+        assert (got[len(ref):] == -1).all()
+
+    def test_box_iou(self):
+        a = np.array([[0, 0, 10, 10]], np.float32)
+        b = np.array([[0, 0, 10, 10], [5, 5, 15, 15], [20, 20, 30, 30]],
+                     np.float32)
+        iou = V.box_iou(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()
+        np.testing.assert_allclose(iou[0], [1.0, 25 / 175, 0.0], rtol=1e-5)
+
+
+class TestRoIAlign:
+    def test_constant_feature(self):
+        x = np.full((1, 3, 16, 16), 7.0, np.float32)
+        boxes = np.array([[2.0, 2.0, 10.0, 10.0]], np.float32)
+        out = V.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                          paddle.to_tensor(np.array([1])), output_size=4)
+        assert out.shape == [1, 3, 4, 4]
+        np.testing.assert_allclose(out.numpy(), 7.0, rtol=1e-5)
+
+    def test_linear_ramp_center_values(self):
+        # feature = x coordinate; pooled bins ≈ bin-center x
+        w = 16
+        x = np.broadcast_to(np.arange(w, dtype=np.float32), (1, 1, w, w)).copy()
+        boxes = np.array([[0.0, 0.0, 8.0, 8.0]], np.float32)
+        out = V.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                          paddle.to_tensor(np.array([1])), output_size=2,
+                          aligned=False).numpy()[0, 0]
+        # bin 0 samples the ramp at x = 1, 3 (centers of the 2x2 grid) → 2;
+        # bin 1 at x = 5, 7 → 6 (value(x) == x on the ramp)
+        np.testing.assert_allclose(out[0], [2.0, 6.0], atol=0.05)
+
+    def test_multi_image_batching(self):
+        x = np.stack([np.full((1, 8, 8), 1.0), np.full((1, 8, 8), 2.0)]
+                     ).astype(np.float32)
+        boxes = np.array([[0, 0, 4, 4], [0, 0, 4, 4], [2, 2, 6, 6]], np.float32)
+        out = V.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                          paddle.to_tensor(np.array([1, 2])), output_size=2)
+        np.testing.assert_allclose(out.numpy()[0], 1.0, rtol=1e-5)
+        np.testing.assert_allclose(out.numpy()[1:], 2.0, rtol=1e-5)
+
+    def test_layer_and_grad(self):
+        layer = V.RoIAlign(output_size=3)
+        x = paddle.to_tensor(np.random.default_rng(6)
+                             .standard_normal((1, 2, 12, 12)).astype(np.float32),
+                             stop_gradient=False)
+        boxes = paddle.to_tensor(np.array([[1.0, 1.0, 9.0, 9.0]], np.float32))
+        out = layer(x, boxes, paddle.to_tensor(np.array([1])))
+        out.sum().backward()
+        assert x.grad is not None
+        assert float(np.abs(x.grad.numpy()).sum()) > 0
+
+
+class TestBoxCoder:
+    def test_encode_decode_roundtrip(self):
+        priors = rand_boxes(8, seed=7)
+        targets = rand_boxes(8, seed=8)
+        var = [0.1, 0.1, 0.2, 0.2]
+        enc = V.box_coder(paddle.to_tensor(priors), var,
+                          paddle.to_tensor(targets), "encode_center_size")
+        dec = V.box_coder(paddle.to_tensor(priors), var, enc,
+                          "decode_center_size")
+        np.testing.assert_allclose(dec.numpy(), targets, rtol=1e-4, atol=1e-3)
+
+
+class TestReviewRegressions:
+    def test_fixed_output_truncation_keeps_last_slot(self):
+        # many survivors, small static k: slot k-1 must hold the k-th kept id
+        boxes = np.stack([np.array([i * 100, 0, i * 100 + 10, 10])
+                          for i in range(25)]).astype(np.float32)  # disjoint
+        scores = np.linspace(1, 0.1, 25).astype(np.float32)
+        got = V.nms(paddle.to_tensor(boxes), 0.5, paddle.to_tensor(scores),
+                    fixed_output_size=16).numpy()
+        np.testing.assert_array_equal(got, np.arange(16))  # no -1 corruption
+
+    def test_categorywise_fixed_output_padded(self):
+        boxes = rand_boxes(6, seed=9)
+        scores = np.random.default_rng(10).random(6).astype(np.float32)
+        cats = np.array([0, 1, 0, 1, 0, 1])
+        got = V.nms(paddle.to_tensor(boxes), 0.9, paddle.to_tensor(scores),
+                    category_idxs=paddle.to_tensor(cats),
+                    fixed_output_size=10).numpy()
+        assert got.shape == (10,)
+        assert (got[6:] == -1).all()
+
+    def test_roi_align_spatial_scale_applied(self):
+        # feature = x coord; box in IMAGE coords, scale 0.5 → feature coords
+        w = 16
+        x = np.broadcast_to(np.arange(w, dtype=np.float32), (1, 1, w, w)).copy()
+        big = V.roi_align(paddle.to_tensor(x),
+                          paddle.to_tensor(np.array([[0, 0, 16.0, 16.0]],
+                                                    np.float32)),
+                          paddle.to_tensor(np.array([1])), output_size=2,
+                          spatial_scale=0.5, aligned=False).numpy()
+        small = V.roi_align(paddle.to_tensor(x),
+                            paddle.to_tensor(np.array([[0, 0, 8.0, 8.0]],
+                                                      np.float32)),
+                            paddle.to_tensor(np.array([1])), output_size=2,
+                            aligned=False).numpy()
+        np.testing.assert_allclose(big, small, rtol=1e-5)
+
+    def test_roi_align_oob_zeroed(self):
+        x = np.full((1, 1, 8, 8), 4.0, np.float32)
+        out = V.roi_align(paddle.to_tensor(x),
+                          paddle.to_tensor(np.array([[0, 0, 16.0, 8.0]],
+                                                    np.float32)),
+                          paddle.to_tensor(np.array([1])), output_size=2,
+                          sampling_ratio=2, aligned=False).numpy()[0, 0]
+        # right half of the box lies fully outside → zero contributions
+        np.testing.assert_allclose(out[:, 0], 4.0, rtol=1e-5)
+        assert (out[:, 1] < 4.0).all()
+
+    def test_box_coder_none_variance_and_axis(self):
+        priors = rand_boxes(4, seed=11)
+        targets = rand_boxes(4, seed=12)
+        enc = V.box_coder(paddle.to_tensor(priors), None,
+                          paddle.to_tensor(targets))
+        dec = V.box_coder(paddle.to_tensor(priors), None, enc,
+                          "decode_center_size")
+        np.testing.assert_allclose(dec.numpy(), targets, rtol=1e-4, atol=1e-3)
+        with pytest.raises(NotImplementedError):
+            V.box_coder(paddle.to_tensor(priors), None,
+                        paddle.to_tensor(targets), axis=1)
+
+    def test_adaptive_sampling_large_roi(self):
+        # 112-wide RoI to 7 bins: adaptive sr=16; ramp means stay exact
+        w = 128
+        x = np.broadcast_to(np.arange(w, dtype=np.float32), (1, 1, w, w)).copy()
+        out = V.roi_align(paddle.to_tensor(x),
+                          paddle.to_tensor(np.array([[0, 0, 112.0, 112.0]],
+                                                    np.float32)),
+                          paddle.to_tensor(np.array([1])), output_size=7,
+                          aligned=False).numpy()[0, 0]
+        expect = (np.arange(7) + 0.5) * 16  # bin-center x
+        np.testing.assert_allclose(out[0], expect, atol=0.1)
