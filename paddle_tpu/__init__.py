@@ -45,6 +45,7 @@ from .hapi import callbacks  # noqa: E402
 from . import distribution  # noqa: E402
 from . import fft  # noqa: E402
 from . import audio  # noqa: E402
+from . import hub  # noqa: E402
 from . import geometric  # noqa: E402
 from . import signal  # noqa: E402
 from . import sparse  # noqa: E402

@@ -1,0 +1,73 @@
+"""paddle.hub — model loading from hubconf entrypoints (reference
+`python/paddle/hapi/hub.py`: list:107, help:149, load:184).
+
+This build runs with zero network egress, so only ``source='local'`` is
+supported: a directory containing ``hubconf.py`` whose callables are the
+entrypoints (exactly the reference's local path). github/gitee sources
+raise with a clear message instead of failing mid-download."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import List
+
+__all__ = ["list", "help", "load"]
+
+_builtin_list = list
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no hubconf.py under {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules["paddle_tpu_hubconf"] = module
+    # hubconf files import repo-sibling modules (reference inserts the dir)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(module)
+    finally:
+        try:
+            sys.path.remove(repo_dir)
+        except ValueError:
+            pass
+    return module
+
+
+def _check_source(source: str) -> None:
+    if source != "local":
+        raise NotImplementedError(
+            f"hub source {source!r}: this build has no network egress — "
+            "clone the repo yourself and use source='local'")
+
+
+def list(repo_dir: str, source: str = "github", force_reload: bool = False):
+    """Entrypoint names exported by the repo's hubconf (reference :107)."""
+    _check_source(source)
+    module = _load_hubconf(repo_dir)
+    return _builtin_list(
+        name for name in dir(module)
+        if callable(getattr(module, name)) and not name.startswith("_"))
+
+
+def help(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False) -> str:
+    """Docstring of one entrypoint (reference :149)."""
+    _check_source(source)
+    module = _load_hubconf(repo_dir)
+    if not hasattr(module, model):
+        raise RuntimeError(f"hubconf has no entrypoint {model!r}")
+    return getattr(module, model).__doc__ or ""
+
+
+def load(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False, **kwargs):
+    """Instantiate an entrypoint (reference :184)."""
+    _check_source(source)
+    module = _load_hubconf(repo_dir)
+    if not hasattr(module, model):
+        raise RuntimeError(f"hubconf has no entrypoint {model!r}")
+    return getattr(module, model)(**kwargs)

@@ -90,7 +90,7 @@ def nms(boxes, iou_threshold: float = 0.3, scores=None, category_idxs=None,
         if fixed_output_size is not None:
             k = int(fixed_output_size)
             keep_all = (keep_all[:k] + [-1] * max(0, k - len(keep_all)))
-        return Tensor(jnp.asarray(keep_all, jnp.int64))
+        return Tensor(jnp.asarray(keep_all, jnp.int32))
 
     score_v = s._value if s is not None else jnp.arange(n, 0, -1, dtype=jnp.float32)
     keep, order = _nms_keep_mask(b._value.astype(jnp.float32),
@@ -102,15 +102,17 @@ def nms(boxes, iou_threshold: float = 0.3, scores=None, category_idxs=None,
         # collision inside [0, k)), then slice
         k = int(fixed_output_size)
         rank = jnp.where(keep, jnp.cumsum(keep) - 1, k)
-        out = jnp.full((k + 1,), -1, jnp.int64)
+        if top_k is not None:  # spill ranks beyond top_k too
+            rank = jnp.where(rank < int(top_k), rank, k)
+        out = jnp.full((k + 1,), -1, jnp.int32)
         out = out.at[jnp.minimum(rank, k)].set(
-            jnp.where(keep, order, -1).astype(jnp.int64))
+            jnp.where(keep, order, -1).astype(jnp.int32))
         return Tensor(out[:k])
 
     kept = np.asarray(order)[np.asarray(keep)]
     if top_k is not None:
         kept = kept[:top_k]
-    return Tensor(jnp.asarray(kept, jnp.int64))
+    return Tensor(jnp.asarray(kept, jnp.int32))
 
 
 def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
@@ -130,31 +132,37 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
         output_size = (output_size, output_size)
     oh, ow = output_size
     counts = np.asarray(ensure_tensor(boxes_num)._value).astype(np.int64)
+    if (counts < 0).any():
+        raise ValueError(f"boxes_num must be non-negative, got {counts}")
+    if len(counts) > x.shape[0]:
+        raise ValueError(f"boxes_num has {len(counts)} images but the batch "
+                         f"holds {x.shape[0]}")
+    if counts.sum() != boxes_t.shape[0]:
+        raise ValueError(f"boxes_num sums to {counts.sum()} but "
+                         f"{boxes_t.shape[0]} boxes were given")
     img_of_box = jnp.asarray(np.repeat(np.arange(len(counts)), counts))
     off = 0.5 if aligned else 0.0
 
+    # per-box sampling ratio (reference: ceil(roi_size/output_size)); static
+    # shapes require grouping boxes by their sr rather than one global max
+    bv = boxes_t._value
+    n_boxes = boxes_t.shape[0]
     if sampling_ratio > 0:
-        sr = int(sampling_ratio)
+        sr_of_box = np.full((n_boxes,), int(sampling_ratio), np.int64)
+    elif isinstance(bv, jax.core.Tracer):
+        sr_of_box = np.full((n_boxes,), 2, np.int64)  # static fallback in jit
     else:
-        bv = boxes_t._value
-        if isinstance(bv, jax.core.Tracer):
-            sr = 2  # static fallback under tracing
-        else:
-            bb = np.asarray(bv) * spatial_scale
-            if bb.shape[0]:
-                sr = int(max(1, np.ceil(max(
-                    (bb[:, 2] - bb[:, 0]).max() / ow,
-                    (bb[:, 3] - bb[:, 1]).max() / oh))))
-                sr = min(sr, 16)  # grid-size guard
-            else:
-                sr = 1
+        bb = np.asarray(bv) * spatial_scale
+        sr_of_box = np.clip(np.ceil(np.maximum(
+            (bb[:, 2] - bb[:, 0]) / ow, (bb[:, 3] - bb[:, 1]) / oh)),
+            1, 16).astype(np.int64) if n_boxes else np.zeros((0,), np.int64)
 
     def fn(feat, bx):
         c = feat.shape[1]
         h, w = feat.shape[-2:]
         scaled = bx * spatial_scale - off
 
-        def one_box(img_idx, box):
+        def one_box(img_idx, box, sr):
             x0, y0, x1, y1 = box
             bw = jnp.maximum(x1 - x0, 1e-6)
             bh = jnp.maximum(y1 - y0, 1e-6)
@@ -182,7 +190,15 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
 
         if bx.shape[0] == 0:
             return jnp.zeros((0, c, oh, ow), feat.dtype)
-        return jax.vmap(one_box)(img_of_box, scaled)
+        # vmap per sr group (distinct srs are few; grids stay static and
+        # small boxes don't pay a big box's sample budget)
+        out = jnp.zeros((bx.shape[0], c, oh, ow), feat.dtype)
+        for sr in np.unique(sr_of_box):
+            sel = jnp.asarray(np.nonzero(sr_of_box == sr)[0])
+            grp = jax.vmap(lambda i, b: one_box(i, b, int(sr)))(
+                img_of_box[sel], scaled[sel])
+            out = out.at[sel].set(grp)
+        return out
 
     return apply_op("roi_align", fn, (x, boxes_t))
 
@@ -218,27 +234,45 @@ def box_coder(prior_box, prior_box_var, target_box,
     norm = 0.0 if box_normalized else 1.0
 
     def fn(p, v, t):
-        pw = p[:, 2] - p[:, 0] + norm
+        pw = p[:, 2] - p[:, 0] + norm                       # [M]
         ph = p[:, 3] - p[:, 1] + norm
         pcx = p[:, 0] + pw * 0.5
         pcy = p[:, 1] + ph * 0.5
         v = jnp.broadcast_to(v.reshape(-1, 4) if v.ndim == 1 else v, p.shape)
         if code_type == "encode_center_size":
-            tw = t[:, 2] - t[:, 0] + norm
+            # reference shape contract: every target vs every prior → [N, M, 4]
+            tw = t[:, 2] - t[:, 0] + norm                   # [N]
             th = t[:, 3] - t[:, 1] + norm
             tcx = t[:, 0] + tw * 0.5
             tcy = t[:, 1] + th * 0.5
-            out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
-                             jnp.log(tw / pw), jnp.log(th / ph)], axis=1)
-            return out / v
+            out = jnp.stack([(tcx[:, None] - pcx[None, :]) / pw[None, :],
+                             (tcy[:, None] - pcy[None, :]) / ph[None, :],
+                             jnp.log(tw[:, None] / pw[None, :]),
+                             jnp.log(th[:, None] / ph[None, :])], axis=2)
+            return out / v[None, :, :]
         if code_type == "decode_center_size":
-            d = t * v
-            cx = d[:, 0] * pw + pcx
-            cy = d[:, 1] * ph + pcy
-            w = jnp.exp(d[:, 2]) * pw
-            h = jnp.exp(d[:, 3]) * ph
+            # t: [N, M, 4] (encode output shape) or [N, 4] elementwise
+            # (prior i decodes row i — the common SSD head form)
+            if t.ndim == 2:
+                if t.shape[0] != p.shape[0]:
+                    raise ValueError(
+                        f"rank-2 decode needs len(target)==len(prior); got "
+                        f"{t.shape[0]} vs {p.shape[0]} (pass [N, M, 4] instead)")
+                d = t * v
+                cx = d[:, 0] * pw + pcx
+                cy = d[:, 1] * ph + pcy
+                w = jnp.exp(d[:, 2]) * pw
+                h = jnp.exp(d[:, 3]) * ph
+                return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                                  cx + w * 0.5 - norm, cy + h * 0.5 - norm],
+                                 axis=1)
+            d = t * v[None, :, :]
+            cx = d[..., 0] * pw[None, :] + pcx[None, :]
+            cy = d[..., 1] * ph[None, :] + pcy[None, :]
+            w = jnp.exp(d[..., 2]) * pw[None, :]
+            h = jnp.exp(d[..., 3]) * ph[None, :]
             return jnp.stack([cx - w * 0.5, cy - h * 0.5,
-                              cx + w * 0.5 - norm, cy + h * 0.5 - norm], axis=1)
+                              cx + w * 0.5 - norm, cy + h * 0.5 - norm], axis=2)
         raise ValueError("code_type must be encode_center_size or decode_center_size")
 
     return apply_op("box_coder", fn, (pb, pbv, tb))

@@ -131,13 +131,29 @@ class TestRoIAlign:
 class TestBoxCoder:
     def test_encode_decode_roundtrip(self):
         priors = rand_boxes(8, seed=7)
-        targets = rand_boxes(8, seed=8)
+        targets = rand_boxes(5, seed=8)
         var = [0.1, 0.1, 0.2, 0.2]
         enc = V.box_coder(paddle.to_tensor(priors), var,
                           paddle.to_tensor(targets), "encode_center_size")
+        assert enc.shape == [5, 8, 4]  # reference: every target vs every prior
         dec = V.box_coder(paddle.to_tensor(priors), var, enc,
                           "decode_center_size")
-        np.testing.assert_allclose(dec.numpy(), targets, rtol=1e-4, atol=1e-3)
+        assert dec.shape == [5, 8, 4]
+        # decoding target i's encoding against any prior j recovers target i
+        for j in (0, 3, 7):
+            np.testing.assert_allclose(dec.numpy()[:, j], targets, rtol=1e-4,
+                                       atol=1e-3)
+
+    def test_elementwise_decode(self):
+        priors = rand_boxes(6, seed=13)
+        deltas = (np.random.default_rng(14).standard_normal((6, 4)) * 0.1
+                  ).astype(np.float32)
+        out = V.box_coder(paddle.to_tensor(priors), [0.1, 0.1, 0.2, 0.2],
+                          paddle.to_tensor(deltas), "decode_center_size")
+        assert out.shape == [6, 4]
+        with pytest.raises(ValueError, match="len"):
+            V.box_coder(paddle.to_tensor(priors), None,
+                        paddle.to_tensor(deltas[:3]), "decode_center_size")
 
 
 class TestReviewRegressions:
@@ -194,7 +210,8 @@ class TestReviewRegressions:
                           paddle.to_tensor(targets))
         dec = V.box_coder(paddle.to_tensor(priors), None, enc,
                           "decode_center_size")
-        np.testing.assert_allclose(dec.numpy(), targets, rtol=1e-4, atol=1e-3)
+        np.testing.assert_allclose(dec.numpy()[:, 0], targets, rtol=1e-4,
+                                   atol=1e-3)
         with pytest.raises(NotImplementedError):
             V.box_coder(paddle.to_tensor(priors), None,
                         paddle.to_tensor(targets), axis=1)
@@ -210,3 +227,62 @@ class TestReviewRegressions:
                           aligned=False).numpy()[0, 0]
         expect = (np.arange(7) + 0.5) * 16  # bin-center x
         np.testing.assert_allclose(out[0], expect, atol=0.1)
+
+
+class TestHub:
+    def test_local_hubconf(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "import paddle_tpu.nn as nn\n"
+            "def tiny_mlp(width=8):\n"
+            "    '''A tiny MLP entrypoint.'''\n"
+            "    return nn.Linear(width, 2)\n")
+        import paddle_tpu as paddle
+
+        names = paddle.hub.list(str(tmp_path), source="local")
+        assert "tiny_mlp" in names
+        assert "tiny MLP" in paddle.hub.help(str(tmp_path), "tiny_mlp",
+                                             source="local")
+        model = paddle.hub.load(str(tmp_path), "tiny_mlp", source="local",
+                                width=4)
+        assert model.weight.shape == [4, 2]
+
+    def test_network_sources_rejected(self, tmp_path):
+        import paddle_tpu as paddle
+
+        with pytest.raises(NotImplementedError, match="egress"):
+            paddle.hub.load("user/repo", "model")
+
+    def test_missing_entrypoint(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text("x = 1\n")
+        import paddle_tpu as paddle
+
+        with pytest.raises(RuntimeError, match="no entrypoint"):
+            paddle.hub.load(str(tmp_path), "nope", source="local")
+
+    def test_top_k_with_fixed_output(self):
+        boxes = np.stack([np.array([i * 100, 0, i * 100 + 10, 10])
+                          for i in range(12)]).astype(np.float32)
+        scores = np.linspace(1, 0.1, 12).astype(np.float32)
+        got = V.nms(paddle.to_tensor(boxes), 0.5, paddle.to_tensor(scores),
+                    top_k=5, fixed_output_size=8).numpy()
+        np.testing.assert_array_equal(got[:5], np.arange(5))
+        assert (got[5:] == -1).all()
+
+    def test_roi_align_validates_boxes_num(self):
+        x = paddle.to_tensor(np.zeros((2, 1, 8, 8), np.float32))
+        boxes = paddle.to_tensor(np.zeros((3, 4), np.float32))
+        with pytest.raises(ValueError, match="sums to"):
+            V.roi_align(x, boxes, paddle.to_tensor(np.array([1, 1])), 2)
+        with pytest.raises(ValueError, match="images but"):
+            V.roi_align(x, boxes, paddle.to_tensor(np.array([1, 1, 1])), 2)
+
+    def test_hubconf_sibling_import(self, tmp_path):
+        (tmp_path / "helpers.py").write_text("WIDTH = 6\n")
+        (tmp_path / "hubconf.py").write_text(
+            "from helpers import WIDTH\n"
+            "import paddle_tpu.nn as nn\n"
+            "def net():\n    return nn.Linear(WIDTH, 1)\n")
+        import paddle_tpu as paddle
+
+        model = paddle.hub.load(str(tmp_path), "net", source="local")
+        assert model.weight.shape == [6, 1]
