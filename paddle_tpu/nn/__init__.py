@@ -29,3 +29,10 @@ from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa:
 from ..framework.param_attr import ParamAttr  # noqa: F401
 from .layer.rnn import (RNN, GRU, LSTM, BiRNN, GRUCell, LSTMCell,  # noqa: E402,F401
                         RNNCellBase, SimpleRNN, SimpleRNNCell)
+from .layer.loss import (CTCLoss, GaussianNLLLoss, HingeEmbeddingLoss,  # noqa: E402,F401
+                         MultiLabelSoftMarginLoss, MultiMarginLoss,
+                         PoissonNLLLoss, SoftMarginLoss, TripletMarginLoss,
+                         TripletMarginWithDistanceLoss)
+from .layer.common import (ChannelShuffle, PairwiseDistance, PixelUnshuffle,  # noqa: E402,F401
+                           Unflatten, ZeroPad2D)
+from .layer.activation import LogSigmoid, RReLU, Silu, Softmax2D  # noqa: E402,F401

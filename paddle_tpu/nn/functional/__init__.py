@@ -36,6 +36,7 @@ tanh = _unary("tanh", jnp.tanh)
 softplus = _unary("softplus", jax.nn.softplus)
 softsign = _unary("softsign", jax.nn.soft_sign)
 silu = _unary("silu", jax.nn.silu)
+log_sigmoid = _unary("log_sigmoid", jax.nn.log_sigmoid)
 swish = silu
 mish = _unary("mish", lambda v: v * jnp.tanh(jax.nn.softplus(v)))
 hardswish = _unary("hardswish", jax.nn.hard_swish)
@@ -1172,3 +1173,270 @@ def fused_linear_cross_entropy(hidden, weight, labels, chunk_size: int = 1024,
         return total / jnp.maximum(count, 1.0)
 
     return apply_op("fused_linear_cross_entropy", fn, (hidden, weight))
+
+
+# ---------------------------------------------------------------------------
+# long-tail losses (reference nn/functional/loss.py)
+# ---------------------------------------------------------------------------
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank: int = 0,
+             reduction: str = "mean", norm_by_times: bool = False) -> Tensor:
+    """CTC loss (reference `nn/functional/loss.py` ctc_loss → warpctc).
+
+    TPU-native: the alpha (forward-variable) recursion in log space as ONE
+    ``lax.scan`` over time — no warpctc binary; jits and differentiates.
+    ``log_probs``: [T, B, C] raw logits (softmax applied internally, as the
+    reference); ``labels``: [B, L] int; lengths: [B]."""
+    log_probs = ensure_tensor(log_probs)
+    lbl = labels._value if isinstance(labels, Tensor) else jnp.asarray(labels)
+    in_len = (input_lengths._value if isinstance(input_lengths, Tensor)
+              else jnp.asarray(input_lengths)).astype(jnp.int32)
+    lab_len = (label_lengths._value if isinstance(label_lengths, Tensor)
+               else jnp.asarray(label_lengths)).astype(jnp.int32)
+    neg_inf = -1e30
+
+    def fn(lp):
+        t_max, b, c = lp.shape
+        logp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        l_max = lbl.shape[1]
+        s = 2 * l_max + 1
+        # extended sequence: blank, l1, blank, l2, ..., blank
+        ext = jnp.full((b, s), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lbl.astype(jnp.int32))
+        ext_len = 2 * lab_len + 1
+        # can we skip from s-2 to s (different non-blank labels)?
+        skip_ok = jnp.zeros((b, s), bool)
+        skip_ok = skip_ok.at[:, 2:].set(
+            (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2]))
+
+        alpha0 = jnp.full((b, s), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(logp[0, jnp.arange(b), ext[:, 0]])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(lab_len > 0, logp[0, jnp.arange(b), ext[:, 1]], neg_inf))
+
+        def lse(a, b_):
+            m = jnp.maximum(a, b_)
+            m_safe = jnp.where(m <= neg_inf, 0.0, m)
+            # clamp the sum: when both args are the -inf sentinel the exp sum
+            # is 0 and d(log 0) is 0/0 = NaN, which where() cannot mask
+            ssum = jnp.maximum(jnp.exp(a - m_safe) + jnp.exp(b_ - m_safe), 1e-30)
+            return jnp.where(m <= neg_inf, neg_inf, m_safe + jnp.log(ssum))
+
+        def step(alpha, t):
+            stay = alpha
+            from_prev = jnp.concatenate(
+                [jnp.full((b, 1), neg_inf), alpha[:, :-1]], axis=1)
+            from_skip = jnp.where(
+                skip_ok,
+                jnp.concatenate([jnp.full((b, 2), neg_inf), alpha[:, :-2]],
+                                axis=1), neg_inf)
+            merged = lse(lse(stay, from_prev), from_skip)
+            emit = logp[t, jnp.arange(b)[:, None], ext]
+            new = merged + emit
+            # frozen beyond each sequence's input length
+            new = jnp.where((t < in_len)[:, None], new, alpha)
+            return new, None
+
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, t_max))
+        idx = jnp.arange(b)
+        last = alpha[idx, jnp.maximum(ext_len - 1, 0)]
+        second_last = jnp.where(ext_len >= 2,
+                                alpha[idx, jnp.maximum(ext_len - 2, 0)], neg_inf)
+        ll = lse(last, second_last)
+        loss = -ll
+        if norm_by_times:
+            loss = loss / jnp.maximum(in_len.astype(jnp.float32), 1.0)
+        if reduction == "mean":
+            # reference divides each sample by its label length before the mean
+            return jnp.mean(loss / jnp.maximum(lab_len.astype(jnp.float32), 1.0))
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    return apply_op("ctc_loss", fn, (log_probs,))
+
+
+def gaussian_nll_loss(input, label, variance, full: bool = False,
+                      epsilon: float = 1e-6, reduction: str = "mean",
+                      name=None) -> Tensor:
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+    variance = ensure_tensor(variance)
+
+    def fn(mu, y, var):
+        var = jnp.clip(var, epsilon)
+        out = 0.5 * (jnp.log(var) + jnp.square(y - mu) / var)
+        if full:
+            out = out + 0.5 * float(np.log(2 * np.pi))
+        return _reduce_loss(out, reduction)
+
+    return apply_op("gaussian_nll_loss", fn, (input, label, variance))
+
+
+def poisson_nll_loss(input, label, log_input: bool = True, full: bool = False,
+                     epsilon: float = 1e-8, reduction: str = "mean",
+                     name=None) -> Tensor:
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+
+    def fn(x, y):
+        if log_input:
+            out = jnp.exp(x) - y * x
+        else:
+            out = x - y * jnp.log(x + epsilon)
+        if full:  # Stirling approximation for log(y!)
+            stirling = y * jnp.log(y + epsilon) - y + 0.5 * jnp.log(
+                2 * np.pi * (y + epsilon))
+            out = out + jnp.where(y > 1, stirling, 0.0)
+        return _reduce_loss(out, reduction)
+
+    return apply_op("poisson_nll_loss", fn, (input, label))
+
+
+def hinge_embedding_loss(input, label, margin: float = 1.0,
+                         reduction: str = "mean", name=None) -> Tensor:
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+
+    def fn(x, y):
+        out = jnp.where(y == 1.0, x, jnp.maximum(0.0, margin - x))
+        return _reduce_loss(out, reduction)
+
+    return apply_op("hinge_embedding_loss", fn, (input, label))
+
+
+def soft_margin_loss(input, label, reduction: str = "mean", name=None) -> Tensor:
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+
+    def fn(x, y):
+        # softplus(-yx): the stable form (log1p(exp(.)) overflows at ~88)
+        return _reduce_loss(jax.nn.softplus(-y * x), reduction)
+
+    return apply_op("soft_margin_loss", fn, (input, label))
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction: str = "mean", name=None) -> Tensor:
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+    tensors = (input, label) + ((ensure_tensor(weight),) if weight is not None
+                                else ())
+
+    def fn(x, y, *w):
+        out = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+        if w:
+            out = out * w[0]
+        return _reduce_loss(out.mean(axis=-1), reduction)
+
+    return apply_op("multi_label_soft_margin_loss", fn, tensors)
+
+
+def multi_margin_loss(input, label, p: int = 1, margin: float = 1.0,
+                      weight=None, reduction: str = "mean", name=None) -> Tensor:
+    input = ensure_tensor(input)
+    lbl = label._value if isinstance(label, Tensor) else jnp.asarray(label)
+    tensors = (input,) + ((ensure_tensor(weight),) if weight is not None else ())
+
+    def fn(x, *w):
+        n, c = x.shape
+        gold = jnp.take_along_axis(x, lbl[:, None].astype(jnp.int32), axis=1)
+        m = jnp.maximum(0.0, margin - gold + x) ** p
+        m = m * (1 - jax.nn.one_hot(lbl, c, dtype=x.dtype))  # skip the gold class
+        per_sample = m.sum(axis=1) / c
+        if w:  # reference scales each sample by weight[its label]
+            per_sample = per_sample * w[0][lbl.astype(jnp.int32)]
+        return _reduce_loss(per_sample, reduction)
+
+    return apply_op("multi_margin_loss", fn, tensors)
+
+
+def triplet_margin_loss(input, positive, negative, margin: float = 1.0,
+                        p: float = 2.0, epsilon: float = 1e-6, swap: bool = False,
+                        reduction: str = "mean", name=None) -> Tensor:
+    return triplet_margin_with_distance_loss(
+        input, positive, negative,
+        distance_function=None, margin=margin, swap=swap, reduction=reduction,
+        _p=p, _eps=epsilon)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin: float = 1.0,
+                                      swap: bool = False, reduction: str = "mean",
+                                      name=None, _p: float = 2.0,
+                                      _eps: float = 1e-6) -> Tensor:
+    input = ensure_tensor(input)
+    positive = ensure_tensor(positive)
+    negative = ensure_tensor(negative)
+    if distance_function is not None:
+        d_ap = distance_function(input, positive)
+        d_an = distance_function(input, negative)
+        if swap:
+            from ...tensor.math import minimum as _tmin
+
+            d_an = _tmin(d_an, distance_function(positive, negative))
+        out = relu(d_ap - d_an + margin)
+        if reduction == "mean":
+            return out.mean()
+        if reduction == "sum":
+            return out.sum()
+        return out
+
+    def fn(a, pos, neg):
+        def dist(u, v):
+            return jnp.power(jnp.sum(jnp.abs(u - v + _eps) ** _p, axis=-1),
+                             1.0 / _p)
+
+        d_ap = dist(a, pos)
+        d_an = dist(a, neg)
+        if swap:
+            d_an = jnp.minimum(d_an, dist(pos, neg))
+        return _reduce_loss(jnp.maximum(0.0, d_ap - d_an + margin), reduction)
+
+    return apply_op("triplet_margin_loss", fn, (input, positive, negative))
+
+
+def pairwise_distance(x, y, p: float = 2.0, epsilon: float = 1e-6,
+                      keepdim: bool = False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    y = ensure_tensor(y)
+
+    def fn(a, b):
+        d = jnp.power(jnp.sum(jnp.abs(a - b + epsilon) ** p, axis=-1,
+                              keepdims=keepdim), 1.0 / p)
+        return d
+
+    return apply_op("pairwise_distance", fn, (x, y))
+
+
+def pixel_unshuffle(x, downscale_factor: int, data_format: str = "NCHW",
+                    name=None) -> Tensor:
+    """Inverse of pixel_shuffle (reference vision.py pixel_unshuffle)."""
+    x = ensure_tensor(x)
+    r = downscale_factor
+
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c, h // r, r, w // r, r)
+            return v.transpose(0, 1, 3, 5, 2, 4).reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h // r, r, w // r, r, c)
+        # (..., c, r, r) channel order — must mirror the NCHW layout
+        return v.transpose(0, 1, 3, 5, 2, 4).reshape(n, h // r, w // r, c * r * r)
+
+    return apply_op("pixel_unshuffle", fn, (x,))
+
+
+def channel_shuffle(x, groups: int, data_format: str = "NCHW", name=None) -> Tensor:
+    x = ensure_tensor(x)
+
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            return v.reshape(n, groups, c // groups, h, w).transpose(
+                0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = v.shape
+        return v.reshape(n, h, w, groups, c // groups).transpose(
+            0, 1, 2, 4, 3).reshape(n, h, w, c)
+
+    return apply_op("channel_shuffle", fn, (x,))

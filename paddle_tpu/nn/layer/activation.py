@@ -2,6 +2,9 @@
 
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
+
 from ...framework.param_attr import ParamAttr
 from .. import functional as F
 from .. import initializer as I
@@ -82,3 +85,49 @@ class PReLU(Layer):
 
     def forward(self, x):
         return F.prelu(x, self.weight, data_format=self._data_format)
+
+
+class LogSigmoid(Layer):
+    def forward(self, x):
+        return F.log_sigmoid(x)
+
+
+class Silu(Layer):
+    def forward(self, x):
+        return F.silu(x)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW (reference activation.py)."""
+
+    def forward(self, x):
+        if x.ndim not in (3, 4):
+            raise ValueError("Softmax2D expects 3-D or 4-D input")
+        return F.softmax(x, axis=-3)
+
+
+class RReLU(Layer):
+    """Randomized leaky ReLU (reference activation.py RReLU): slope drawn
+    U[lower, upper] in training, fixed mean slope in eval."""
+
+    def __init__(self, lower: float = 1.0 / 8.0, upper: float = 1.0 / 3.0,
+                 name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        from ...framework.random import next_key
+        from ...tensor.tensor import apply_op
+
+        if self.training:
+            key = next_key()
+            lo, up = self.lower, self.upper
+
+            def fn(v):
+                slope = jax.random.uniform(key, v.shape, jnp.float32,
+                                           minval=lo, maxval=up)
+                return jnp.where(v >= 0, v, slope.astype(v.dtype) * v)
+
+            return apply_op("rrelu", fn, (x,))
+        mid = (self.lower + self.upper) / 2
+        return apply_op("rrelu_eval", lambda v: jnp.where(v >= 0, v, mid * v), (x,))

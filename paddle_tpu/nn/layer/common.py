@@ -2,6 +2,8 @@
 
 from __future__ import annotations
 
+import jax.numpy as jnp
+
 from typing import Optional
 
 import numpy as np
@@ -214,3 +216,72 @@ class Unfold(Layer):
 
     def forward(self, x):
         return F.unfold(x, *self.args)
+
+
+class PairwiseDistance(Layer):
+    """p-norm distance between row pairs (reference nn/layer/distance.py)."""
+
+    def __init__(self, p: float = 2.0, epsilon: float = 1e-6,
+                 keepdim: bool = False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)
+
+
+class Unflatten(Layer):
+    """Inverse of Flatten over one axis (reference common.py Unflatten)."""
+
+    def __init__(self, axis: int, shape, name=None):
+        super().__init__()
+        self.axis = axis
+        self.shape = list(shape)
+
+    def forward(self, x):
+        from ...tensor.manipulation import reshape
+
+        full = list(x.shape)
+        ax = self.axis if self.axis >= 0 else len(full) + self.axis
+        return reshape(x, full[:ax] + self.shape + full[ax + 1:])
+
+
+class ZeroPad2D(Layer):
+    """Zero padding on H/W (reference padding.py ZeroPad2D).
+    ``padding``: int or [left, right, top, bottom]."""
+
+    def __init__(self, padding, data_format: str = "NCHW", name=None):
+        super().__init__()
+        if isinstance(padding, int):
+            padding = [padding] * 4
+        self.padding = list(padding)
+        self.data_format = data_format
+
+    def forward(self, x):
+        l, r, t, b = self.padding
+        pad = [(0, 0), (0, 0), (t, b), (l, r)] if self.data_format == "NCHW" \
+            else [(0, 0), (t, b), (l, r), (0, 0)]
+        from ...tensor.tensor import apply_op
+
+        return apply_op("zero_pad2d", lambda v: jnp.pad(v, pad), (x,))
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor: int, data_format: str = "NCHW",
+                 name=None):
+        super().__init__()
+        self.downscale_factor = downscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self.downscale_factor, self.data_format)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups: int, data_format: str = "NCHW", name=None):
+        super().__init__()
+        self.groups = groups
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self.groups, self.data_format)

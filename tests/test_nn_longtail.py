@@ -1,0 +1,233 @@
+"""Long-tail nn layers (reference nn/layer/loss.py, activation.py,
+common.py, padding.py) — CTC validated against a brute-force path-sum."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def brute_force_ctc(logits, labels, blank=0):
+    """-log P(labels | logits) by enumerating ALL alignment paths."""
+    T, C = logits.shape
+    logp = np.log(np.exp(logits) / np.exp(logits).sum(-1, keepdims=True))
+    total = 0.0
+    for path in itertools.product(range(C), repeat=T):
+        # collapse: remove repeats then blanks
+        collapsed = []
+        prev = None
+        for s in path:
+            if s != prev:
+                collapsed.append(s)
+            prev = s
+        collapsed = [s for s in collapsed if s != blank]
+        if collapsed == list(labels):
+            total += np.exp(sum(logp[t, s] for t, s in enumerate(path)))
+    return -np.log(total)
+
+
+class TestCTC:
+    def test_matches_brute_force(self):
+        rng = np.random.default_rng(0)
+        T, C = 4, 3
+        logits = rng.standard_normal((T, 1, C)).astype(np.float32)
+        labels = np.array([[1, 2]])
+        loss = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                          paddle.to_tensor(np.array([T])),
+                          paddle.to_tensor(np.array([2])), reduction="none")
+        expect = brute_force_ctc(logits[:, 0], [1, 2])
+        assert float(loss.numpy()[0]) == pytest.approx(expect, rel=1e-4)
+
+    def test_repeated_label(self):
+        rng = np.random.default_rng(1)
+        T, C = 5, 3
+        logits = rng.standard_normal((T, 1, C)).astype(np.float32)
+        labels = np.array([[1, 1]])  # needs a blank between repeats
+        loss = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                          paddle.to_tensor(np.array([T])),
+                          paddle.to_tensor(np.array([2])), reduction="none")
+        expect = brute_force_ctc(logits[:, 0], [1, 1])
+        assert float(loss.numpy()[0]) == pytest.approx(expect, rel=1e-4)
+
+    def test_batch_with_lengths(self):
+        rng = np.random.default_rng(2)
+        logits = rng.standard_normal((6, 2, 4)).astype(np.float32)
+        labels = np.array([[1, 2, 0], [3, 0, 0]])
+        in_len = np.array([6, 4])
+        lab_len = np.array([2, 1])
+        loss = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                          paddle.to_tensor(in_len), paddle.to_tensor(lab_len),
+                          reduction="none").numpy()
+        e0 = brute_force_ctc(logits[:6, 0], [1, 2])
+        e1 = brute_force_ctc(logits[:4, 1], [3])
+        np.testing.assert_allclose(loss, [e0, e1], rtol=1e-4)
+
+    def test_layer_and_grad_and_training(self):
+        """CTC trains a toy alignment: logits learn to emit the target."""
+        paddle.seed(0)
+        rng = np.random.default_rng(3)
+        T, B, C = 8, 4, 5
+        logits = paddle.to_tensor(
+            rng.standard_normal((T, B, C)).astype(np.float32) * 0.1,
+            stop_gradient=False)
+        labels = paddle.to_tensor(rng.integers(1, C, (B, 3)))
+        crit = nn.CTCLoss()
+        il = paddle.to_tensor(np.full(B, T))
+        ll = paddle.to_tensor(np.full(B, 3))
+        opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[logits])
+        losses = []
+        for _ in range(30):
+            loss = crit(logits, labels, il, ll)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.2
+
+
+class TestLongTailLosses:
+    def test_gaussian_nll(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        y = paddle.to_tensor(np.array([1.5, 2.0], np.float32))
+        var = paddle.to_tensor(np.array([1.0, 4.0], np.float32))
+        got = float(nn.GaussianNLLLoss()(x, y, var).numpy())
+        expect = np.mean([0.5 * (np.log(1.0) + 0.25), 0.5 * np.log(4.0)])
+        assert got == pytest.approx(expect, rel=1e-5)
+
+    def test_poisson_nll(self):
+        x = paddle.to_tensor(np.array([0.5], np.float32))
+        y = paddle.to_tensor(np.array([2.0], np.float32))
+        got = float(nn.PoissonNLLLoss()(x, y).numpy())
+        assert got == pytest.approx(np.exp(0.5) - 2.0 * 0.5, rel=1e-5)
+
+    def test_hinge_embedding(self):
+        x = paddle.to_tensor(np.array([0.5, 0.4], np.float32))
+        y = paddle.to_tensor(np.array([1.0, -1.0], np.float32))
+        got = float(nn.HingeEmbeddingLoss(margin=1.0)(x, y).numpy())
+        assert got == pytest.approx((0.5 + 0.6) / 2, rel=1e-5)
+
+    def test_soft_margin(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32))
+        y = paddle.to_tensor(np.array([1.0], np.float32))
+        got = float(nn.SoftMarginLoss()(x, y).numpy())
+        assert got == pytest.approx(np.log1p(np.exp(-2.0)), rel=1e-5)
+
+    def test_multi_margin_and_multilabel(self):
+        x = paddle.to_tensor(np.array([[0.1, 0.9, 0.2]], np.float32))
+        y = paddle.to_tensor(np.array([1]))
+        got = float(nn.MultiMarginLoss()(x, y).numpy())
+        expect = (max(0, 1 - 0.9 + 0.1) + max(0, 1 - 0.9 + 0.2)) / 3
+        assert got == pytest.approx(expect, rel=1e-5)
+        ml = nn.MultiLabelSoftMarginLoss()(
+            paddle.to_tensor(np.array([[2.0, -2.0]], np.float32)),
+            paddle.to_tensor(np.array([[1.0, 0.0]], np.float32)))
+        expect_ml = np.mean([-np.log(1 / (1 + np.exp(-2.0))),
+                             -np.log(1 / (1 + np.exp(-2.0)))])
+        assert float(ml.numpy()) == pytest.approx(expect_ml, rel=1e-4)
+
+    def test_triplet_margin(self):
+        a = paddle.to_tensor(np.zeros((2, 3), np.float32))
+        p = paddle.to_tensor(np.ones((2, 3), np.float32) * 0.1)
+        n = paddle.to_tensor(np.ones((2, 3), np.float32) * 5.0)
+        assert float(nn.TripletMarginLoss(margin=1.0)(a, p, n).numpy()) == 0.0
+        n2 = paddle.to_tensor(np.ones((2, 3), np.float32) * 0.2)
+        assert float(nn.TripletMarginLoss(margin=1.0)(a, p, n2).numpy()) > 0
+
+    def test_triplet_with_custom_distance(self):
+        dist = lambda u, v: (u - v).abs().sum(axis=-1)
+        crit = nn.TripletMarginWithDistanceLoss(distance_function=dist,
+                                                margin=0.5)
+        a = paddle.to_tensor(np.zeros((1, 2), np.float32))
+        p = paddle.to_tensor(np.ones((1, 2), np.float32))
+        n = paddle.to_tensor(np.ones((1, 2), np.float32) * 0.5)
+        got = float(crit(a, p, n).numpy())
+        assert got == pytest.approx(max(0, 2.0 - 1.0 + 0.5), rel=1e-5)
+
+
+class TestShapeAndActivationLayers:
+    def test_unflatten_zeropad(self):
+        x = paddle.to_tensor(np.arange(24, dtype=np.float32).reshape(2, 12))
+        out = nn.Unflatten(1, [3, 4])(x)
+        assert out.shape == [2, 3, 4]
+        padded = nn.ZeroPad2D([1, 2, 3, 4])(
+            paddle.to_tensor(np.ones((1, 1, 2, 2), np.float32)))
+        assert padded.shape == [1, 1, 9, 5]
+        assert float(padded.numpy().sum()) == 4.0
+
+    def test_pixel_unshuffle_roundtrip(self):
+        x = paddle.to_tensor(np.random.default_rng(4)
+                             .standard_normal((1, 2, 4, 4)).astype(np.float32))
+        down = nn.PixelUnshuffle(2)(x)
+        assert down.shape == [1, 8, 2, 2]
+        back = F.pixel_shuffle(down, 2)
+        np.testing.assert_allclose(back.numpy(), x.numpy(), rtol=1e-6)
+
+    def test_channel_shuffle_involution(self):
+        x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 4, 2, 2))
+        once = nn.ChannelShuffle(2)(x)
+        twice = nn.ChannelShuffle(2)(once)
+        np.testing.assert_allclose(twice.numpy(), x.numpy())
+        assert not np.allclose(once.numpy(), x.numpy())
+
+    def test_pairwise_distance(self):
+        a = paddle.to_tensor(np.array([[0.0, 0.0]], np.float32))
+        b = paddle.to_tensor(np.array([[3.0, 4.0]], np.float32))
+        assert float(nn.PairwiseDistance()(a, b).numpy()) == pytest.approx(
+            5.0, rel=1e-4)
+
+    def test_activations(self):
+        x = paddle.to_tensor(np.array([-1.0, 0.0, 2.0], np.float32))
+        np.testing.assert_allclose(nn.LogSigmoid()(x).numpy(),
+                                   np.log(1 / (1 + np.exp([1.0, 0.0, -2.0]))),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(nn.Silu()(x).numpy(),
+                                   x.numpy() / (1 + np.exp(-x.numpy())),
+                                   rtol=1e-5)
+        s2d = nn.Softmax2D()(paddle.to_tensor(
+            np.zeros((1, 3, 2, 2), np.float32)))
+        np.testing.assert_allclose(s2d.numpy(), 1 / 3, rtol=1e-6)
+
+    def test_rrelu_train_vs_eval(self):
+        layer = nn.RReLU(0.1, 0.3)
+        x = paddle.to_tensor(np.full((1000,), -1.0, np.float32))
+        layer.train()
+        paddle.seed(0)
+        out = layer(x).numpy()
+        assert (-0.3 <= out).all() and (out <= -0.1).all()
+        assert np.unique(out).size > 10  # random slopes
+        layer.eval()
+        np.testing.assert_allclose(layer(x).numpy(), -0.2, rtol=1e-5)
+
+
+class TestThirdReviewRegressions:
+    def test_soft_margin_stable_at_large_logits(self):
+        x = paddle.to_tensor(np.array([200.0], np.float32), stop_gradient=False)
+        y = paddle.to_tensor(np.array([-1.0], np.float32))
+        loss = F.soft_margin_loss(x, y)
+        assert np.isfinite(float(loss.numpy()))
+        loss.backward()
+        assert np.isfinite(x.grad.numpy()).all()
+
+    def test_multi_margin_weight_applied(self):
+        x = paddle.to_tensor(np.array([[0.1, 0.9, 0.2]], np.float32))
+        y = paddle.to_tensor(np.array([1]))
+        w = paddle.to_tensor(np.array([1.0, 10.0, 1.0], np.float32))
+        base = float(F.multi_margin_loss(x, y).numpy())
+        weighted = float(F.multi_margin_loss(x, y, weight=w).numpy())
+        assert weighted == pytest.approx(10 * base, rel=1e-5)
+
+    def test_pixel_unshuffle_layout_consistency(self):
+        x = np.arange(32, dtype=np.float32).reshape(1, 2, 4, 4)
+        nchw = F.pixel_unshuffle(paddle.to_tensor(x), 2).numpy()
+        nhwc = F.pixel_unshuffle(paddle.to_tensor(x.transpose(0, 2, 3, 1)),
+                                 2, data_format="NHWC").numpy()
+        np.testing.assert_allclose(nhwc.transpose(0, 3, 1, 2), nchw)
+
+    def test_f_log_sigmoid(self):
+        x = paddle.to_tensor(np.array([-1.0, 3.0], np.float32))
+        np.testing.assert_allclose(F.log_sigmoid(x).numpy(),
+                                   -np.log1p(np.exp([1.0, -3.0])), rtol=1e-5)
