@@ -36,3 +36,4 @@ from .layer.loss import (CTCLoss, GaussianNLLLoss, HingeEmbeddingLoss,  # noqa: 
 from .layer.common import (ChannelShuffle, PairwiseDistance, PixelUnshuffle,  # noqa: E402,F401
                            Unflatten, ZeroPad2D)
 from .layer.activation import LogSigmoid, RReLU, Silu, Softmax2D  # noqa: E402,F401
+from .layer.pooling import AdaptiveMaxPool3D  # noqa: E402,F401

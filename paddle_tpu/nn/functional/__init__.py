@@ -509,24 +509,47 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None) -> Tensor:
 
 
 def _adaptive_pool(x, output_size, nd, mode, data_format):
+    """Adaptive pooling with paddle's variable windows (bin i covers
+    [floor(i·S/O), ceil((i+1)·S/O))): handles non-divisible sizes and
+    ``None`` entries (= keep that dim). Windows are static per (shape,
+    output_size) so this jits."""
     x = ensure_tensor(x)
-    out_sz = _tuple_n(output_size, nd)
+    if output_size is None or isinstance(output_size, int):
+        out_sz = (output_size,) * nd
+    else:
+        out_sz = tuple(output_size)  # may contain None (= keep that dim)
     channel_first = data_format.startswith("NC")
-    spatial = tuple(x.shape[2:]) if channel_first else tuple(x.shape[1:-1])
-    if any(s % o != 0 for s, o in zip(spatial, out_sz)):
-        raise NotImplementedError(
-            f"adaptive pool requires divisible spatial dims on TPU (static windows): "
-            f"{spatial} -> {out_sz}")
-    ks = tuple(s // o for s, o in zip(spatial, out_sz))
-    if mode == "avg":
-        if nd == 1:
-            return avg_pool1d(x, ks, ks, 0, data_format=data_format)
-        if nd == 2:
-            return avg_pool2d(x, ks, ks, 0, data_format=data_format)
-        return avg_pool3d(x, ks, ks, 0, data_format=data_format)
-    if nd == 1:
-        return max_pool1d(x, ks, ks, 0, data_format=data_format)
-    return max_pool2d(x, ks, ks, 0, data_format=data_format)
+    first_spatial = 2 if channel_first else 1
+    spatial = tuple(x.shape[first_spatial:first_spatial + nd])
+    out_sz = tuple(s if o is None else int(o) for s, o in zip(spatial, out_sz))
+
+    # fast path: divisible dims reduce to a plain strided pool
+    if all(s % o == 0 for s, o in zip(spatial, out_sz)):
+        ks = tuple(s // o for s, o in zip(spatial, out_sz))
+        if mode == "avg":
+            fns = {1: avg_pool1d, 2: avg_pool2d, 3: avg_pool3d}
+        else:
+            fns = {1: max_pool1d, 2: max_pool2d, 3: max_pool3d}
+        return fns[nd](x, ks, ks, 0, data_format=data_format)
+
+    def fn(v):
+        red = jnp.max if mode == "max" else jnp.mean
+
+        def pool_axis(arr, axis, size, n_out):
+            outs = []
+            for i in range(n_out):
+                lo = (i * size) // n_out
+                hi = -(-((i + 1) * size) // n_out)
+                sl = [slice(None)] * arr.ndim
+                sl[axis] = slice(lo, hi)
+                outs.append(red(arr[tuple(sl)], axis=axis, keepdims=True))
+            return jnp.concatenate(outs, axis=axis)
+
+        for d in range(nd):
+            v = pool_axis(v, first_spatial + d, spatial[d], out_sz[d])
+        return v
+
+    return apply_op(f"adaptive_{mode}_pool{nd}d", fn, (x,))
 
 
 # ---------------------------------------------------------------------------
@@ -1440,3 +1463,227 @@ def channel_shuffle(x, groups: int, data_format: str = "NCHW", name=None) -> Ten
             0, 1, 2, 4, 3).reshape(n, h, w, c)
 
     return apply_op("channel_shuffle", fn, (x,))
+
+
+# ---------------------------------------------------------------------------
+# remaining functional surface (reference nn/functional/*.py)
+# ---------------------------------------------------------------------------
+def sequence_mask(x, maxlen=None, dtype="int64", name=None) -> Tensor:
+    """[..., maxlen] mask with mask[..., j] = j < x[...] (reference
+    sequence_lod.py sequence_mask)."""
+    x = ensure_tensor(x)
+    from ...framework import dtype as _dt
+
+    def fn(v):
+        m = maxlen if maxlen is not None else int(v.max())
+        return (jnp.arange(m) < v[..., None]).astype(_dt.canonical_dtype(dtype))
+
+    return apply_op("sequence_mask", fn, (x,))
+
+
+def log_loss(input, label, epsilon: float = 1e-4, name=None) -> Tensor:
+    """Elementwise negative log likelihood of probabilities (reference
+    loss.py log_loss)."""
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+
+    def fn(p, y):
+        return -(y * jnp.log(p + epsilon) + (1 - y) * jnp.log1p(epsilon - p))
+
+    return apply_op("log_loss", fn, (input, label))
+
+
+def dice_loss(input, label, epsilon: float = 1e-5, name=None) -> Tensor:
+    """1 − Dice coefficient over per-sample class probabilities (reference
+    loss.py dice_loss): input [N, ..., C] probs, label [N, ..., 1] int."""
+    input = ensure_tensor(input)
+    lbl = label._value if isinstance(label, Tensor) else jnp.asarray(label)
+
+    def fn(p):
+        one_hot = jax.nn.one_hot(lbl[..., 0], p.shape[-1], dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * one_hot, axis=reduce_dims)
+        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(one_hot, axis=reduce_dims)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+
+    return apply_op("dice_loss", fn, (input,))
+
+
+def npair_loss(anchor, positive, labels, l2_reg: float = 0.002, name=None) -> Tensor:
+    """N-pair metric loss (reference loss.py npair_loss)."""
+    anchor = ensure_tensor(anchor)
+    positive = ensure_tensor(positive)
+    lbl = labels._value if isinstance(labels, Tensor) else jnp.asarray(labels)
+
+    def fn(a, p):
+        reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(a), 1)) +
+                        jnp.mean(jnp.sum(jnp.square(p), 1))) * 0.25
+        sim = a @ p.T
+        lab = lbl.reshape(-1)
+        targets = (lab[:, None] == lab[None, :]).astype(sim.dtype)
+        targets = targets / jnp.sum(targets, axis=1, keepdims=True)
+        ce = -jnp.sum(targets * jax.nn.log_softmax(sim, axis=1), axis=1)
+        return jnp.mean(ce) + reg
+
+    return apply_op("npair_loss", fn, (anchor, positive))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha: float = 0.25,
+                       gamma: float = 2.0, reduction: str = "sum",
+                       name=None) -> Tensor:
+    """Focal loss on logits (reference loss.py sigmoid_focal_loss)."""
+    logit = ensure_tensor(logit)
+    label = ensure_tensor(label)
+    tensors = (logit, label) + ((ensure_tensor(normalizer),)
+                                if normalizer is not None else ())
+
+    def fn(x, y, *norm):
+        p = jax.nn.sigmoid(x)
+        ce = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if norm:
+            loss = loss / norm[0]
+        return _reduce_loss(loss, reduction)
+
+    return apply_op("sigmoid_focal_loss", fn, tensors)
+
+
+def zeropad2d(x, padding, data_format: str = "NCHW", name=None) -> Tensor:
+    if isinstance(padding, int):
+        padding = [padding] * 4
+    l, r, t, b = padding
+    pad = [(0, 0), (0, 0), (t, b), (l, r)] if data_format == "NCHW" \
+        else [(0, 0), (t, b), (l, r), (0, 0)]
+    return apply_op("zeropad2d", lambda v: jnp.pad(v, pad), (ensure_tensor(x),))
+
+
+def temporal_shift(x, seg_num: int, shift_ratio: float = 0.25,
+                   data_format: str = "NCHW", name=None) -> Tensor:
+    """TSM temporal channel shift (reference extension.py temporal_shift)."""
+    x = ensure_tensor(x)
+
+    def fn(v):
+        if data_format == "NHWC":
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v = v.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        back = jnp.concatenate([v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], 1)
+        fwd = jnp.concatenate([jnp.zeros_like(v[:, :1, fold:2 * fold]),
+                               v[:, :-1, fold:2 * fold]], 1)
+        keep = v[:, :, 2 * fold:]
+        out = jnp.concatenate([back, fwd, keep], axis=2).reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return apply_op("temporal_shift", fn, (x,))
+
+
+def affine_grid(theta, out_shape, align_corners: bool = True, name=None) -> Tensor:
+    """Sampling grid from 2x3 affine matrices (reference vision.py
+    affine_grid): theta [N, 2, 3] → grid [N, H, W, 2] in [-1, 1] coords."""
+    theta = ensure_tensor(theta)
+    n, c, h, w = [int(s) for s in (out_shape.numpy() if isinstance(out_shape, Tensor)
+                                   else np.asarray(out_shape))]
+
+    def fn(th):
+        if align_corners:
+            ys = jnp.linspace(-1, 1, h)
+            xs = jnp.linspace(-1, 1, w)
+        else:
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H, W, 3]
+        return jnp.einsum("nij,hwj->nhwi", th.astype(jnp.float32), base)
+
+    return apply_op("affine_grid", fn, (theta,))
+
+
+def grid_sample(x, grid, mode: str = "bilinear", padding_mode: str = "zeros",
+                align_corners: bool = True, name=None) -> Tensor:
+    """Sample x [N, C, H, W] at grid [N, Hg, Wg, 2] (xy in [-1, 1])
+    (reference vision.py grid_sample)."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError("mode must be bilinear or nearest")
+    if padding_mode not in ("zeros", "border"):
+        raise NotImplementedError("padding_mode reflection is not supported")
+    x = ensure_tensor(x)
+    grid = ensure_tensor(grid)
+
+    def fn(v, g):
+        nb, c, h, w = v.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def sample_one(img, fx_, fy_):
+            if mode == "nearest":
+                xi = jnp.round(fx_).astype(jnp.int32)
+                yi = jnp.round(fy_).astype(jnp.int32)
+                valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+                xi = jnp.clip(xi, 0, w - 1)
+                yi = jnp.clip(yi, 0, h - 1)
+                out = img[:, yi, xi]
+                if padding_mode == "zeros":
+                    out = jnp.where(valid[None], out, 0.0)
+                return out
+            x0 = jnp.floor(fx_)
+            y0 = jnp.floor(fy_)
+            wx = fx_ - x0
+            wy = fy_ - y0
+
+            def tap(xi, yi):
+                valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+                xi_c = jnp.clip(xi.astype(jnp.int32), 0, w - 1)
+                yi_c = jnp.clip(yi.astype(jnp.int32), 0, h - 1)
+                val = img[:, yi_c, xi_c]
+                if padding_mode == "zeros":
+                    val = jnp.where(valid[None], val, 0.0)
+                return val
+
+            return (tap(x0, y0) * (1 - wx) * (1 - wy)
+                    + tap(x0 + 1, y0) * wx * (1 - wy)
+                    + tap(x0, y0 + 1) * (1 - wx) * wy
+                    + tap(x0 + 1, y0 + 1) * wx * wy)
+
+        return jax.vmap(sample_one)(v, fx, fy)
+
+    return apply_op("grid_sample", fn, (x, grid))
+
+
+def adaptive_max_pool3d(x, output_size, return_mask: bool = False, name=None):
+    if return_mask:
+        raise NotImplementedError("adaptive_max_pool3d return_mask: argmax "
+                                  "indices are a CUDA-unpool affordance")
+    return _adaptive_pool(x, output_size, 3, "max", "NCDHW")
+
+
+def _inplace(op_fn):
+    def wrapper(x, *args, **kwargs):
+        out = op_fn(x, *args, **kwargs)
+        if isinstance(x, Tensor):
+            x._rebind(out)
+            return x
+        return out
+
+    wrapper.__name__ = op_fn.__name__ + "_"
+    wrapper.__doc__ = f"In-place variant of {op_fn.__name__} (paddle `_` suffix)."
+    return wrapper
+
+
+relu_ = _inplace(relu)
+tanh_ = _inplace(tanh)
+softmax_ = _inplace(softmax)
+elu_ = _inplace(elu)
+hardtanh_ = _inplace(hardtanh)
+leaky_relu_ = _inplace(leaky_relu)
+thresholded_relu_ = _inplace(thresholded_relu)

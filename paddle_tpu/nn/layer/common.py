@@ -258,12 +258,7 @@ class ZeroPad2D(Layer):
         self.data_format = data_format
 
     def forward(self, x):
-        l, r, t, b = self.padding
-        pad = [(0, 0), (0, 0), (t, b), (l, r)] if self.data_format == "NCHW" \
-            else [(0, 0), (t, b), (l, r), (0, 0)]
-        from ...tensor.tensor import apply_op
-
-        return apply_op("zero_pad2d", lambda v: jnp.pad(v, pad), (x,))
+        return F.zeropad2d(x, self.padding, self.data_format)
 
 
 class PixelUnshuffle(Layer):

@@ -231,3 +231,92 @@ class TestThirdReviewRegressions:
         x = paddle.to_tensor(np.array([-1.0, 3.0], np.float32))
         np.testing.assert_allclose(F.log_sigmoid(x).numpy(),
                                    -np.log1p(np.exp([1.0, -3.0])), rtol=1e-5)
+
+
+class TestFunctionalTail:
+    def test_sequence_mask(self):
+        m = F.sequence_mask(paddle.to_tensor(np.array([2, 4, 0])), maxlen=5)
+        expect = np.array([[1, 1, 0, 0, 0], [1, 1, 1, 1, 0], [0, 0, 0, 0, 0]])
+        np.testing.assert_array_equal(m.numpy(), expect)
+        auto = F.sequence_mask(paddle.to_tensor(np.array([1, 3])))
+        assert auto.shape == [2, 3]
+
+    def test_log_and_dice_loss(self):
+        p = paddle.to_tensor(np.array([0.9, 0.1], np.float32))
+        y = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        got = F.log_loss(p, y).numpy()
+        assert (got > 0).all() and got[0] < 0.2
+        probs = paddle.to_tensor(np.array([[[0.9, 0.1], [0.8, 0.2]]], np.float32))
+        lbl = paddle.to_tensor(np.array([[[0], [0]]]))
+        d = float(F.dice_loss(probs, lbl).numpy())
+        assert 0 < d < 0.2  # mostly-correct → small dice loss
+
+    def test_sigmoid_focal_loss_down_weights_easy(self):
+        easy = F.sigmoid_focal_loss(paddle.to_tensor(np.array([6.0], np.float32)),
+                                    paddle.to_tensor(np.array([1.0], np.float32)))
+        hard = F.sigmoid_focal_loss(paddle.to_tensor(np.array([-6.0], np.float32)),
+                                    paddle.to_tensor(np.array([1.0], np.float32)))
+        assert float(easy.numpy()) < float(hard.numpy()) * 1e-3
+
+    def test_npair_loss_prefers_matching(self):
+        rng = np.random.default_rng(0)
+        emb = rng.standard_normal((4, 8)).astype(np.float32)
+        lab = paddle.to_tensor(np.arange(4))
+        matched = F.npair_loss(paddle.to_tensor(emb), paddle.to_tensor(emb * 5),
+                               lab, l2_reg=0.0)
+        mismatched = F.npair_loss(paddle.to_tensor(emb),
+                                  paddle.to_tensor(-emb * 5), lab, l2_reg=0.0)
+        assert float(matched.numpy()) < float(mismatched.numpy())
+
+    def test_temporal_shift_moves_channels(self):
+        x = np.arange(2 * 2 * 4 * 1 * 1, dtype=np.float32).reshape(4, 4, 1, 1)
+        out = F.temporal_shift(paddle.to_tensor(x), seg_num=2,
+                               shift_ratio=0.25).numpy()
+        # channel 0 shifted backward: frame0 gets frame1's value
+        assert out[0, 0, 0, 0] == x[1, 0, 0, 0]
+        assert out[1, 0, 0, 0] == 0  # last frame zero-padded
+
+    def test_grid_sample_identity_and_affine(self):
+        x = np.random.default_rng(1).standard_normal((1, 2, 5, 5)).astype(np.float32)
+        theta = paddle.to_tensor(np.array([[[1.0, 0, 0], [0, 1.0, 0]]], np.float32))
+        grid = F.affine_grid(theta, [1, 2, 5, 5])
+        out = F.grid_sample(paddle.to_tensor(x), grid)
+        np.testing.assert_allclose(out.numpy(), x, rtol=1e-4, atol=1e-5)
+        # zeros padding outside
+        theta_shift = paddle.to_tensor(np.array([[[1.0, 0, 2.0], [0, 1.0, 0]]],
+                                                np.float32))
+        out2 = F.grid_sample(paddle.to_tensor(x),
+                             F.affine_grid(theta_shift, [1, 2, 5, 5]))
+        assert float(np.abs(out2.numpy()[..., -1]).sum()) == 0.0
+
+    def test_adaptive_max_pool3d(self):
+        x = np.arange(2 * 1 * 4 * 4 * 4, dtype=np.float32).reshape(2, 1, 4, 4, 4)
+        out = F.adaptive_max_pool3d(paddle.to_tensor(x), 2)
+        assert out.shape == [2, 1, 2, 2, 2]
+        assert float(out.numpy()[0, 0, -1, -1, -1]) == float(x[0, 0, :].max())
+        layer = nn.AdaptiveMaxPool3D(2)
+        np.testing.assert_allclose(layer(paddle.to_tensor(x)).numpy(),
+                                   out.numpy())
+
+    def test_inplace_variants_rebind(self):
+        x = paddle.to_tensor(np.array([-1.0, 2.0], np.float32))
+        out = F.relu_(x)
+        assert out is x
+        np.testing.assert_allclose(x.numpy(), [0.0, 2.0])
+        y = paddle.to_tensor(np.array([0.0, 2.0], np.float32))
+        F.softmax_(y)
+        e = np.exp([0.0, 2.0])
+        np.testing.assert_allclose(y.numpy(), e / e.sum(), rtol=1e-5)
+
+    def test_adaptive_pool_non_divisible_and_none(self):
+        # 4 -> 3 bins (non-divisible) across avg/max 1d/2d; None keeps a dim
+        x2 = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        out = F.adaptive_max_pool2d(x2, 3)
+        assert out.shape == [1, 1, 3, 3]
+        assert float(out.numpy()[0, 0, -1, -1]) == 15.0
+        avg = F.adaptive_avg_pool2d(x2, 3)
+        # bin 0 of rows covers rows [0, ceil(4/3)) = rows 0..1
+        assert avg.shape == [1, 1, 3, 3]
+        x3 = paddle.to_tensor(np.zeros((1, 1, 5, 4, 4), np.float32))
+        keep = F.adaptive_max_pool3d(x3, (None, 2, 2))
+        assert keep.shape == [1, 1, 5, 2, 2]
