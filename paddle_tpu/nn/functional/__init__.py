@@ -1687,3 +1687,68 @@ elu_ = _inplace(elu)
 hardtanh_ = _inplace(hardtanh)
 leaky_relu_ = _inplace(leaky_relu)
 thresholded_relu_ = _inplace(thresholded_relu)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None) -> Tensor:
+    """out[b, o] = x1[b] @ W[o] @ x2[b] (+ bias) (reference common.py
+    bilinear; the form nn.Bilinear wraps)."""
+    x1 = ensure_tensor(x1)
+    x2 = ensure_tensor(x2)
+    weight = ensure_tensor(weight)
+    tensors = (x1, x2, weight) + ((ensure_tensor(bias),) if bias is not None
+                                  else ())
+
+    def fn(a, b, w, *bb):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bb:
+            out = out + bb[0]
+        return out
+
+    return apply_op("bilinear", fn, tensors)
+
+
+def rrelu(x, lower: float = 1.0 / 8.0, upper: float = 1.0 / 3.0,
+          training: bool = True, name=None) -> Tensor:
+    """Randomized leaky relu (reference rrelu.py): random slope U[lower,
+    upper] when training, mean slope otherwise."""
+    if not 0 <= lower <= upper:
+        raise ValueError(f"rrelu requires 0 <= lower <= upper, got "
+                         f"[{lower}, {upper}]")
+    x = ensure_tensor(x)
+    if not training:
+        mid = (lower + upper) / 2
+        return apply_op("rrelu_eval", lambda v: jnp.where(v >= 0, v, mid * v), (x,))
+    key = next_key()
+
+    def fn(v):
+        slope = jax.random.uniform(key, v.shape, jnp.float32,
+                                   minval=lower, maxval=upper)
+        return jnp.where(v >= 0, v, slope.astype(v.dtype) * v)
+
+    return apply_op("rrelu", fn, (x,))
+
+
+def gather_tree(ids, parents, name=None) -> Tensor:
+    """Back-trace beam-search parent pointers into full sequences
+    (reference extension.py gather_tree): ids/parents [T, B, beam] →
+    sequences [T, B, beam] read root-to-leaf."""
+    ids_v = ids._value if isinstance(ids, Tensor) else jnp.asarray(ids)
+    par_v = (parents._value if isinstance(parents, Tensor)
+             else jnp.asarray(parents)).astype(jnp.int32)
+    ids_t = ids if isinstance(ids, Tensor) else Tensor(ids_v)
+
+    def fn(idv):
+        t, b, k = idv.shape
+        binx = jnp.arange(b)[:, None]
+
+        def step(beam_ptr, ti):
+            # ti runs T-1 → 0; emit the token each current beam took at ti
+            tok = idv[ti][binx, beam_ptr]
+            beam_ptr = par_v[ti][binx, beam_ptr]
+            return beam_ptr, tok
+
+        init = jnp.broadcast_to(jnp.arange(k)[None, :], (b, k))
+        _, toks = jax.lax.scan(step, init, jnp.arange(t - 1, -1, -1))
+        return toks[::-1]  # back to root-first order
+
+    return apply_op("gather_tree", fn, (ids_t,))

@@ -2,9 +2,6 @@
 
 from __future__ import annotations
 
-import jax
-import jax.numpy as jnp
-
 from ...framework.param_attr import ParamAttr
 from .. import functional as F
 from .. import initializer as I
@@ -116,18 +113,4 @@ class RReLU(Layer):
         self.lower, self.upper = lower, upper
 
     def forward(self, x):
-        from ...framework.random import next_key
-        from ...tensor.tensor import apply_op
-
-        if self.training:
-            key = next_key()
-            lo, up = self.lower, self.upper
-
-            def fn(v):
-                slope = jax.random.uniform(key, v.shape, jnp.float32,
-                                           minval=lo, maxval=up)
-                return jnp.where(v >= 0, v, slope.astype(v.dtype) * v)
-
-            return apply_op("rrelu", fn, (x,))
-        mid = (self.lower + self.upper) / 2
-        return apply_op("rrelu_eval", lambda v: jnp.where(v >= 0, v, mid * v), (x,))
+        return F.rrelu(x, self.lower, self.upper, training=self.training)

@@ -192,12 +192,7 @@ class Bilinear(Layer):
             [1, out_features], attr=bias_attr, is_bias=True)
 
     def forward(self, x1, x2):
-        from ...tensor.einsum import einsum
-
-        out = einsum("bi,oij,bj->bo", x1, self.weight, x2)
-        if self.bias is not None:
-            out = out + self.bias
-        return out
+        return F.bilinear(x1, x2, self.weight, self.bias)
 
 
 class PixelShuffle(Layer):

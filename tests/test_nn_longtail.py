@@ -320,3 +320,34 @@ class TestFunctionalTail:
         x3 = paddle.to_tensor(np.zeros((1, 1, 5, 4, 4), np.float32))
         keep = F.adaptive_max_pool3d(x3, (None, 2, 2))
         assert keep.shape == [1, 1, 5, 2, 2]
+
+    def test_f_bilinear_matches_layer(self):
+        layer = nn.Bilinear(3, 4, 2)
+        a = paddle.to_tensor(np.random.default_rng(5).standard_normal((2, 3))
+                             .astype(np.float32))
+        b = paddle.to_tensor(np.random.default_rng(6).standard_normal((2, 4))
+                             .astype(np.float32))
+        out = F.bilinear(a, b, layer.weight, layer.bias)
+        np.testing.assert_allclose(out.numpy(), layer(a, b).numpy(), rtol=1e-5)
+        ref = np.einsum("bi,oij,bj->bo", a.numpy(), layer.weight.numpy(),
+                        b.numpy()) + layer.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_f_rrelu(self):
+        x = paddle.to_tensor(np.full((500,), -2.0, np.float32))
+        paddle.seed(1)
+        out = F.rrelu(x, 0.1, 0.3, training=True).numpy()
+        assert (-0.6 <= out).all() and (out <= -0.2).all()
+        ev = F.rrelu(x, 0.1, 0.3, training=False).numpy()
+        np.testing.assert_allclose(ev, -0.4, rtol=1e-5)
+
+    def test_gather_tree(self):
+        # T=3, B=1, beam=2: classic backtrace example
+        ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]])
+        parents = np.array([[[0, 0]], [[0, 0]], [[1, 0]]])
+        out = F.gather_tree(paddle.to_tensor(ids),
+                            paddle.to_tensor(parents)).numpy()
+        # beam 0 at t=2 came from beam 1 at t=1 (parent=1), which came from
+        # beam 0 at t=0 → sequence [1, 4, 5]; beam 1 took [1, 3, 6]
+        np.testing.assert_array_equal(out[:, 0, 0], [1, 4, 5])
+        np.testing.assert_array_equal(out[:, 0, 1], [1, 3, 6])
