@@ -20,6 +20,7 @@ from .engine import (DistributedTrainStep, GPipeLayers, ScannedLayers,  # noqa: 
 from .parallel import (DataParallel, ParallelEnv, get_rank, get_world_size,  # noqa: F401
                        init_parallel_env, is_initialized)
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
+from .store import TCPKVStore, TCPStore, rendezvous  # noqa: F401
 from .watchdog import CommWatchdog  # noqa: F401
 from .topology import (CommGroup, HybridCommunicateGroup, build_mesh,  # noqa: F401
                        get_hybrid_communicate_group, set_hybrid_communicate_group)

@@ -106,18 +106,36 @@ def ring_attention(q, k, v, mesh: Optional[Mesh] = None, sep_axis: str = "sep",
                    causal: bool = False, scale: Optional[float] = None):
     """Blockwise ring attention over the ``sep_axis`` ring (module docstring).
 
-    q/k/v: [b, s, h, d] global arrays, s divisible by the sep degree;
-    kv heads must equal q heads (use Ulysses or TP for GQA splits)."""
+    q/k/v: [b, s, h, d] global arrays, s divisible by the sep degree; GQA
+    (kv heads dividing q heads) rotates the unrepeated KV chunks and repeats
+    shard-locally. Dispatches the inner block math to the Pallas flash
+    kernel (ops/pallas/ring_flash.py) when the backend and shapes allow."""
     mesh = _resolve_mesh(mesh)
     n = mesh.shape[sep_axis]
     q = q if isinstance(q, Tensor) else Tensor(q)
     k = k if isinstance(k, Tensor) else Tensor(k)
     v = v if isinstance(v, Tensor) else Tensor(v)
     b, s, h, d = q.shape
-    if k.shape[2] != h:
-        raise ValueError("ring_attention requires matching q/kv head counts")
+    hkv = k.shape[2]
+    if h % hkv != 0:
+        raise ValueError(f"ring_attention GQA requires kv heads ({hkv}) to "
+                         f"divide q heads ({h})")
     if s % n != 0:
         raise ValueError(f"sequence {s} not divisible by sep degree {n}")
+
+    from ...ops import pallas_eligible, pallas_interpret_mode
+    from ...ops.sharded import mesh_flash_attention, mesh_flash_supported
+
+    if n > 1 and pallas_eligible("use_flash_attention") and \
+            mesh_flash_supported(mesh, q.shape, k.shape, has_mask=False,
+                                 dropout_p=0.0, causal=causal):
+        interp = pallas_interpret_mode()
+        return apply_op(
+            "ring_flash_attention",
+            lambda qv, kv, vv: mesh_flash_attention(
+                qv, kv, vv, mesh, causal=causal, scale=scale,
+                interpret=interp),
+            (q, k, v))
     sc = scale if scale is not None else 1.0 / float(d) ** 0.5
     perm = [(r, (r + 1) % n) for r in range(n)]
 
@@ -137,12 +155,17 @@ def ring_attention(q, k, v, mesh: Optional[Mesh] = None, sep_axis: str = "sep",
         # positions within a chunk (for the diagonal block's causal tril)
         qpos = jnp.arange(c)
 
+        rep = h // hkv
+
         def step(carry, i):
             acc, m_, l_, k_cur, v_cur = carry
             # k_cur currently holds the chunk originally at ring position
-            # (idx - i) mod n
+            # (idx - i) mod n; GQA repeats shard-locally (the ring moves the
+            # unrepeated chunks)
             src = (idx - i) % n
-            logits = jnp.einsum("bqhd,bkhd->bhqk", qf, k_cur.astype(jnp.float32))
+            k_loc = jnp.repeat(k_cur, rep, axis=2) if rep > 1 else k_cur
+            v_loc = jnp.repeat(v_cur, rep, axis=2) if rep > 1 else v_cur
+            logits = jnp.einsum("bqhd,bkhd->bhqk", qf, k_loc.astype(jnp.float32))
             if causal:
                 # future block → all masked; self block → tril; past → open
                 block_rel = src - idx          # >0 ⇒ future, 0 ⇒ self, <0 ⇒ past
@@ -160,7 +183,7 @@ def ring_attention(q, k, v, mesh: Optional[Mesh] = None, sep_axis: str = "sep",
                                   logits - safe_m[..., None]))
             corr = jnp.where(jnp.isneginf(m_), 0.0, jnp.exp(m_ - safe_m))
             l_new = l_ * corr + jnp.sum(p, axis=-1)
-            pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32))
+            pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_loc.astype(jnp.float32))
             acc_new = acc * jnp.transpose(corr, (0, 2, 1))[..., None] + pv
             k_next = jax.lax.ppermute(k_cur, sep_axis, perm)
             v_next = jax.lax.ppermute(v_cur, sep_axis, perm)

@@ -177,5 +177,9 @@ define_flag("use_fused_rms_norm", True,
 define_flag("use_fused_rope", True,
             "Dispatch rotary embedding to the fused Pallas kernel on TPU "
             "(reference: fused_rotary_position_embedding.py surface).")
+define_flag("pallas_interpret", False,
+            "Run the Pallas TPU kernels through the interpreter so the kernel "
+            "code paths (incl. the shard_map/ring compositions) execute on "
+            "CPU test meshes.")
 define_flag("seed_offset_by_rank", True,
             "Offset the global seed by process rank for per-host RNG streams.")

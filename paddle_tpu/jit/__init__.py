@@ -371,12 +371,18 @@ class TrainStep:
 class InputSpec:
     """Shape/dtype signature of one model input (reference
     `python/paddle/static/input.py` InputSpec). ``None``/``-1`` dims are
-    DYNAMIC: the exported program is shape-polymorphic in them
-    (jax.export symbolic dimensions)."""
+    DYNAMIC: the exported program is shape-polymorphic in them (jax.export
+    symbolic dimensions). A ``str`` dim names its symbol, and equal names
+    share one symbol ACROSS specs (e.g. two inputs with a shared dynamic
+    batch: ``InputSpec(["b", 128]), InputSpec(["b"])``); anonymous dynamic
+    dims at position 0 also share one batch symbol, other anonymous dims
+    vary independently."""
 
     def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
-        self.shape = tuple(None if s is None or int(s) == -1 else int(s)
-                           for s in shape)
+        self.shape = tuple(
+            s if isinstance(s, str)
+            else None if s is None or int(s) == -1 else int(s)
+            for s in shape)
         self.dtype = dtype
         self.name = name
 
@@ -387,22 +393,37 @@ class InputSpec:
 def _specs_to_sds(specs):
     """[InputSpec | Tensor | ShapeDtypeStruct] → ShapeDtypeStructs, with
     dynamic InputSpec dims lowered to jax.export symbolic dimensions (one
-    shared scope: the same symbol is NOT reused, each dynamic dim varies
-    independently)."""
+    shared scope). Named (str) dims and anonymous dim-0 dims share symbols
+    across specs — the common multi-input case where every input carries the
+    same dynamic batch; other anonymous dims vary independently."""
     from jax import export as jax_export
     from ..framework import dtype as _dtype_mod
 
     out = []
     scope = jax_export.SymbolicScope()
     counter = [0]
+    named = {}
 
-    def dyn():
+    def dyn(key=None):
+        if key is not None and key in named:
+            return named[key]
         counter[0] += 1
-        return jax_export.symbolic_shape(f"d{counter[0]}", scope=scope)[0]
+        # anonymous symbols live in a reserved "_…" namespace so they can
+        # never alias a user-provided dim name in the shared scope
+        name = key if isinstance(key, str) else (
+            "_dbatch" if key == 0 else f"_d{counter[0]}")
+        sym = jax_export.symbolic_shape(name, scope=scope)[0]
+        if key is not None:
+            named[key] = sym
+        return sym
 
     for spec in specs:
         if isinstance(spec, InputSpec):
-            shape = tuple(dyn() if s is None else s for s in spec.shape)
+            shape = tuple(
+                dyn(s) if isinstance(s, str)
+                else dyn(0) if s is None and i == 0
+                else dyn() if s is None else s
+                for i, s in enumerate(spec.shape))
             out.append(jax.ShapeDtypeStruct(
                 shape, _dtype_mod.canonical_dtype(spec.dtype)))
         elif isinstance(spec, Tensor):

@@ -135,17 +135,29 @@ def _rope_tables(head_dim: int, max_pos: int, theta: float):
 def apply_rotary_pos_emb(q: Tensor, k: Tensor, cos, sin, position_offset: int = 0):
     """q/k: [b, s, h, d]; cos/sin: [max_pos, d] jax arrays (fused path:
     ops/pallas/rope.py; reference `fused_rotary_position_embedding.py`)."""
-    from ..ops import pallas_eligible
+    from ..ops import pallas_mode
 
     s = q.shape[1]
-    if pallas_eligible("use_fused_rope") and q.shape[-1] % 2 == 0 and s % 8 == 0:
+    mode = pallas_mode("use_fused_rope")
+    if mode is not None and q.shape[-1] % 2 == 0 and s % 8 == 0:
+        kind, mesh, interp = mode
         from ..ops.pallas import fused_rope
+        from ..ops.sharded import mesh_rope, mesh_rope_supported
 
         table_c = cos[position_offset:position_offset + s]
         table_s = sin[position_offset:position_offset + s]
-        return apply_op("fused_rope",
-                        lambda qv, kv: fused_rope(qv, kv, table_c, table_s),
-                        (q, k), multi_out=True)
+        if kind == "mesh":
+            if mesh_rope_supported(mesh, q.shape, k.shape):
+                return apply_op(
+                    "fused_rope",
+                    lambda qv, kv: mesh_rope(qv, kv, table_c, table_s, mesh,
+                                             interpret=interp),
+                    (q, k), multi_out=True)
+        else:
+            return apply_op("fused_rope",
+                            lambda qv, kv: fused_rope(qv, kv, table_c, table_s,
+                                                      interpret=interp),
+                            (q, k), multi_out=True)
 
     cos_s = cos[position_offset:position_offset + s][None, :, None, :]
     sin_s = sin[position_offset:position_offset + s][None, :, None, :]

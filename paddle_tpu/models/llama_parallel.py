@@ -69,11 +69,6 @@ class HybridLlamaAttention(nn.Layer):
                 raise NotImplementedError(
                     "context-parallel attention supports causal masking only")
             if self.context_parallel == "ring":
-                if cfg.num_key_value_heads != cfg.num_attention_heads:
-                    # GQA: ring needs matched head counts; expand via Ulysses
-                    # or TP instead
-                    raise ValueError("ring attention requires kv heads == q "
-                                     "heads (use context_parallel='ulysses')")
                 out = ring_attention(q, k, v, causal=True)
             else:
                 out = ulysses_attention(q, k, v, is_causal=True)
@@ -127,11 +122,9 @@ class LlamaForCausalLMHybrid(nn.Layer):
         self.hcg = hcg
         sep = hcg.mesh.shape.get("sep", 1)
         if context_parallel == "auto":
-            if sep > 1:
-                gqa = config.num_key_value_heads != config.num_attention_heads
-                context_parallel = "ulysses" if gqa else "ring"
-            else:
-                context_parallel = "none"
+            # ring handles GQA (grouped KV chunks rotate unrepeated); it is
+            # the memory-scaling default whenever the seq dim is sharded
+            context_parallel = "ring" if sep > 1 else "none"
         if context_parallel not in ("none", "ring", "ulysses"):
             raise ValueError(f"context_parallel={context_parallel!r}: must be "
                              "'auto', 'none', 'ring' or 'ulysses'")

@@ -590,18 +590,29 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon: float = 1e-
 def rms_norm(x, weight=None, epsilon: float = 1e-6, name=None) -> Tensor:
     """RMSNorm (reference: `python/paddle/incubate/nn/functional/fused_rms_norm.py`).
     Dispatches to the fused Pallas kernel on TPU; XLA path elsewhere."""
-    from ...ops import pallas_eligible
+    from ...ops import pallas_mode
 
     x = ensure_tensor(x)
     tensors = (x, ensure_tensor(weight)) if weight is not None else (x,)
 
-    if weight is not None and pallas_eligible("use_fused_rms_norm") and \
-            x.shape[-1] == weight.shape[-1] and x.ndim >= 2 and \
-            (x.size // x.shape[-1]) % 8 == 0 and x.shape[-1] % 128 == 0:
+    mode = pallas_mode("use_fused_rms_norm") if weight is not None else None
+    if mode is not None and x.shape[-1] == weight.shape[-1] and x.ndim >= 2 \
+            and (x.size // x.shape[-1]) % 8 == 0 and x.shape[-1] % 128 == 0:
+        kind, mesh, interp = mode
         from ...ops.pallas import fused_rms_norm
+        from ...ops.sharded import mesh_rms_norm, mesh_rms_norm_supported
 
-        return apply_op("fused_rms_norm",
-                        lambda v, w: fused_rms_norm(v, w, epsilon), tensors)
+        if kind == "mesh":
+            if mesh_rms_norm_supported(mesh, x.shape):
+                return apply_op(
+                    "fused_rms_norm",
+                    lambda v, w: mesh_rms_norm(v, w, mesh, epsilon,
+                                               interpret=interp), tensors)
+        else:
+            return apply_op(
+                "fused_rms_norm",
+                lambda v, w: fused_rms_norm(v, w, epsilon, interpret=interp),
+                tensors)
 
     def fn(v, *w):
         vf = v.astype(jnp.float32)
@@ -978,7 +989,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     """SDPA (reference: `nn/functional/flash_attention.py:442`). Inputs
     [batch, seq, heads, head_dim] (paddle flash-attn layout). Dispatches to
     the Pallas flash kernel on TPU when shapes allow, else the XLA path."""
-    from ...ops import pallas_eligible
+    from ...ops import pallas_mode
     from ...ops.attention import sdpa_reference
 
     from ...amp import maybe_autocast_tensors
@@ -990,14 +1001,31 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     p = dropout_p if training else 0.0
     dkey = next_key() if p > 0.0 else None
 
-    if pallas_eligible("use_flash_attention"):
+    mode = pallas_mode("use_flash_attention")
+    if mode is not None:
+        kind, mesh, interp = mode
         from ...ops.pallas import flash_attention, flash_attention_supported
+        from ...ops.sharded import mesh_flash_attention, mesh_flash_supported
 
-        if flash_attention_supported(query.shape, key.shape,
-                                     has_mask=mask_val is not None,
-                                     dropout_p=p, causal=is_causal):
+        if kind == "mesh":
+            # hybrid mesh live: the kernel must run shard-local under a
+            # fully-manual shard_map (GSPMD can't partition a Mosaic custom
+            # call) — the SPMD-rule analogue, ops/sharded.py
+            if mesh_flash_supported(mesh, query.shape, key.shape,
+                                    has_mask=mask_val is not None,
+                                    dropout_p=p, causal=is_causal):
+                def mesh_fn(q, k, v):
+                    return mesh_flash_attention(q, k, v, mesh,
+                                                causal=is_causal,
+                                                interpret=interp)
+
+                return apply_op("flash_attn", mesh_fn, tensors)
+        elif flash_attention_supported(query.shape, key.shape,
+                                       has_mask=mask_val is not None,
+                                       dropout_p=p, causal=is_causal):
             def flash_fn(q, k, v):
-                return flash_attention(q, k, v, causal=is_causal)
+                return flash_attention(q, k, v, causal=is_causal,
+                                       interpret=interp)
 
             return apply_op("flash_attn", flash_fn, tensors)
 
@@ -1475,6 +1503,11 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None) -> Tensor:
     from ...framework import dtype as _dt
 
     def fn(v):
+        if maxlen is None and isinstance(v, jax.core.Tracer):
+            raise ValueError(
+                "sequence_mask(maxlen=None) sizes the mask from the concrete "
+                "max length, which is unavailable under jit/to_static — pass "
+                "maxlen explicitly")
         m = maxlen if maxlen is not None else int(v.max())
         return (jnp.arange(m) < v[..., None]).astype(_dt.canonical_dtype(dtype))
 
@@ -1711,8 +1744,8 @@ def rrelu(x, lower: float = 1.0 / 8.0, upper: float = 1.0 / 3.0,
           training: bool = True, name=None) -> Tensor:
     """Randomized leaky relu (reference rrelu.py): random slope U[lower,
     upper] when training, mean slope otherwise."""
-    if not 0 <= lower <= upper:
-        raise ValueError(f"rrelu requires 0 <= lower <= upper, got "
+    if not 0 <= lower <= upper <= 1:
+        raise ValueError(f"rrelu requires 0 <= lower <= upper <= 1, got "
                          f"[{lower}, {upper}]")
     x = ensure_tensor(x)
     if not training:

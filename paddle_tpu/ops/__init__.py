@@ -8,20 +8,51 @@ import jax
 
 
 @functools.lru_cache(maxsize=1)
-def _tpu_single_device() -> bool:
+def _on_tpu() -> bool:
     try:
-        devs = jax.devices()
+        return jax.devices()[0].platform == "tpu"
     except Exception:
         return False
-    return devs[0].platform == "tpu" and len(devs) == 1
+
+
+def pallas_interpret_mode() -> bool:
+    """True when the ``pallas_interpret`` flag forces the kernels through the
+    Pallas interpreter (CPU testing of the TPU kernel paths)."""
+    from ..framework.flags import get_flags
+
+    return bool(get_flags("pallas_interpret")["pallas_interpret"])
 
 
 def pallas_eligible(flag_name: str) -> bool:
-    """True when the Pallas path should be used: TPU backend, single-device
-    context (multi-chip goes through GSPMD where the sharded XLA path is
-    used until the kernels grow shard_map wrappers), and the flag is on."""
+    """True when the Pallas path should be used: TPU backend (multi-chip
+    composes through the shard_map wrappers in ``ops/sharded.py`` and
+    therefore needs a live hybrid mesh — without one, a bare Mosaic custom
+    call would land in a GSPMD program that cannot partition it, so we fall
+    back to the partitionable XLA path) or interpreter mode forced, and the
+    flag is on."""
     from ..framework.flags import get_flags
 
-    if not _tpu_single_device():
+    if _on_tpu():
+        if len(jax.devices()) > 1:
+            from .sharded import active_mesh
+
+            if active_mesh() is None:
+                return False
+    elif not pallas_interpret_mode():
         return False
     return bool(get_flags(flag_name)[flag_name])
+
+
+def pallas_mode(flag_name: str):
+    """Kernel dispatch resolution shared by the functional wrappers:
+    ``None`` (XLA path) | ``("mesh", mesh, interpret)`` (shard_map wrapper)
+    | ``("local", None, interpret)`` (direct kernel)."""
+    if not pallas_eligible(flag_name):
+        return None
+    from .sharded import active_mesh
+
+    interp = pallas_interpret_mode()
+    mesh = active_mesh()
+    if mesh is not None:
+        return ("mesh", mesh, interp)
+    return ("local", None, interp)

@@ -1,0 +1,188 @@
+"""Ring flash attention: the Pallas flash kernel composed with sequence
+("sep") parallelism.
+
+Called shard-local INSIDE a fully-manual ``shard_map`` (built by
+``ops/sharded.py``): q/k/v arrive as the local sequence chunks
+[b, c, h, d] (c = s / sep_degree). Forward rotates the K/V chunks around
+the sep ring with ``ppermute`` and merges each block's flash output into a
+running (out, logsumexp) pair — no device ever materializes the full
+sequence, so per-device attention memory is O(s/N). Backward re-rotates the
+ring and carries rotating dK/dV accumulators; each step reuses the FA2
+two-kernel split from ``flash_attention.py`` with the TOTAL logsumexp and
+delta (the FA2 backward is blockwise in K — exactly the structure the ring
+provides).
+
+Causality is decided per (device, chunk) pair: the chunk from a later ring
+position is fully masked (skipped — no kernel launch), the home chunk runs
+the causal kernel, earlier chunks run unmasked. GQA needs no special
+handling: the kernel reads grouped KV heads via its BlockSpec index map and
+the ring rotates the *unrepeated* KV chunks (bandwidth-optimal).
+
+Capability parity target: the reference distributes its fused flash kernel
+via an explicit SPMD rule (`paddle/phi/infermeta/spmd_rules/flash_attention.cc`)
++ sep-parallel groups (`fleet/utils/sequence_parallel_utils.py`); this module
+is the TPU analogue of that composition.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .flash_attention import _LANES, _bwd, _from_internal, _fwd, _to_internal
+
+
+def _pvary(x, axes: Tuple[str, ...]):
+    """Mark ``x`` varying over ``axes`` (scan carries inside shard_map must
+    declare their VMA type up front; fresh constants start unvaried)."""
+    if not axes:
+        return x
+    return jax.lax.pcast(x, tuple(axes), to="varying")
+
+
+def _merge(o, lse, o_i, lse_i):
+    """Online-softmax merge of a block result into the running (out, lse).
+
+    o [b,h,c,d] f32; lse [b,h,c,1] f32; o_i block output (input dtype,
+    already normalized by its own l); lse_i [b,h,c,LANES] f32 broadcast."""
+    lse_i = lse_i[..., :1]
+    new = jnp.logaddexp(lse, lse_i)
+    # rows with no live key yet have new == -inf: keep the accumulator at 0
+    wa = jnp.where(jnp.isneginf(new), 0.0, jnp.exp(lse - new))
+    wb = jnp.where(jnp.isneginf(new), 0.0, jnp.exp(lse_i - new))
+    return o * wa + o_i.astype(jnp.float32) * wb, new
+
+
+def _ring_perm(n: int):
+    return [(r, (r + 1) % n) for r in range(n)]
+
+
+def _rf_fwd_core(qi, ki, vi, axis_name, n, causal, scale, bq, bk, interpret,
+                 varying):
+    b, hq, c, d = qi.shape
+    idx = jax.lax.axis_index(axis_name)
+    perm = _ring_perm(n)
+
+    def block(k_cur, v_cur, src):
+        def full(_):
+            return _fwd(qi, k_cur, v_cur, scale=scale, causal=False,
+                        block_q=bq, block_k=bk, interpret=interpret)
+
+        def diag(_):
+            return _fwd(qi, k_cur, v_cur, scale=scale, causal=True,
+                        block_q=bq, block_k=bk, interpret=interpret)
+
+        def skip(_):
+            return (_pvary(jnp.zeros((b, hq, c, d), qi.dtype), varying),
+                    _pvary(jnp.full((b, hq, c, _LANES), -jnp.inf, jnp.float32),
+                           varying))
+
+        if not causal:
+            return full(None)
+        # src == idx → home chunk (causal diag); src < idx → past (open);
+        # src > idx → future (fully masked: no kernel launch)
+        branch = jnp.where(src == idx, 0, jnp.where(src < idx, 1, 2))
+        return jax.lax.switch(branch, [diag, full, skip], None)
+
+    o0 = _pvary(jnp.zeros((b, hq, c, d), jnp.float32), varying)
+    lse0 = _pvary(jnp.full((b, hq, c, 1), -jnp.inf, jnp.float32), varying)
+
+    def step(carry, i):
+        o, lse, k_cur, v_cur = carry
+        src = (idx - i) % n
+        o_i, lse_i = block(k_cur, v_cur, src)
+        o, lse = _merge(o, lse, o_i, lse_i)
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (o, lse, k_cur, v_cur), None
+
+    (o, lse, _, _), _ = jax.lax.scan(step, (o0, lse0, ki, vi), jnp.arange(n))
+    lse_b = jnp.broadcast_to(lse, (b, hq, c, _LANES))
+    return o.astype(qi.dtype), lse_b
+
+
+def _rf_bwd_core(qi, ki, vi, out, lse_b, doi, axis_name, n, causal, scale,
+                 bq, bk, interpret, varying):
+    idx = jax.lax.axis_index(axis_name)
+    perm = _ring_perm(n)
+
+    def block(k_cur, v_cur, src):
+        def run(causal_flag):
+            dq, dk, dv = _bwd(scale, causal_flag, bq, bk, interpret,
+                              (qi, k_cur, v_cur, out, lse_b), doi)
+            return (dq.astype(jnp.float32), dk.astype(jnp.float32),
+                    dv.astype(jnp.float32))
+
+        def diag(_):
+            return run(True)
+
+        def full(_):
+            return run(False)
+
+        def skip(_):
+            return (_pvary(jnp.zeros(qi.shape, jnp.float32), varying),
+                    _pvary(jnp.zeros(k_cur.shape, jnp.float32), varying),
+                    _pvary(jnp.zeros(v_cur.shape, jnp.float32), varying))
+
+        if not causal:
+            return full(None)
+        branch = jnp.where(src == idx, 0, jnp.where(src < idx, 1, 2))
+        return jax.lax.switch(branch, [diag, full, skip], None)
+
+    dq0 = _pvary(jnp.zeros(qi.shape, jnp.float32), varying)
+    dk0 = _pvary(jnp.zeros(ki.shape, jnp.float32), varying)
+    dv0 = _pvary(jnp.zeros(vi.shape, jnp.float32), varying)
+
+    def step(carry, i):
+        dq, dk_cur, dv_cur, k_cur, v_cur = carry
+        src = (idx - i) % n
+        dq_i, dk_i, dv_i = block(k_cur, v_cur, src)
+        dq = dq + dq_i
+        # dK/dV travel WITH their chunk: after n rotations each accumulator
+        # returns home having collected every device's contribution
+        dk_cur = jax.lax.ppermute(dk_cur + dk_i, axis_name, perm)
+        dv_cur = jax.lax.ppermute(dv_cur + dv_i, axis_name, perm)
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (dq, dk_cur, dv_cur, k_cur, v_cur), None
+
+    (dq, dk, dv, _, _), _ = jax.lax.scan(
+        step, (dq0, dk0, dv0, ki, vi), jnp.arange(n))
+    return dq.astype(qi.dtype), dk.astype(ki.dtype), dv.astype(vi.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
+def ring_flash_attention(q, k, v, axis_name: str, n: int, causal: bool,
+                         scale: Optional[float], block_q: int, block_k: int,
+                         interpret: bool, varying_axes: Tuple[str, ...]):
+    """Shard-local entry (inside a fully-manual shard_map): q [b, c, hq, d],
+    k/v [b, c, hkv, d] local chunks of a sequence sharded over ``axis_name``
+    with degree ``n``; returns the local out chunk [b, c, hq, d]."""
+    out, _ = _rf_fwd(q, k, v, axis_name, n, causal, scale, block_q, block_k,
+                     interpret, varying_axes)
+    return out
+
+
+def _rf_fwd(q, k, v, axis_name, n, causal, scale, bq, bk, interpret, varying):
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / float(d) ** 0.5
+    qi, ki, vi = _to_internal(q), _to_internal(k), _to_internal(v)
+    o, lse_b = _rf_fwd_core(qi, ki, vi, axis_name, n, causal, s, bq, bk,
+                            interpret, varying)
+    return _from_internal(o), (qi, ki, vi, o, lse_b)
+
+
+def _rf_bwd(axis_name, n, causal, scale, bq, bk, interpret, varying, res, g):
+    qi, ki, vi, o, lse_b = res
+    d = qi.shape[-1]
+    s = scale if scale is not None else 1.0 / float(d) ** 0.5
+    dq, dk, dv = _rf_bwd_core(qi, ki, vi, o, lse_b, _to_internal(g),
+                              axis_name, n, causal, s, bq, bk, interpret,
+                              varying)
+    return _from_internal(dq), _from_internal(dk), _from_internal(dv)
+
+
+ring_flash_attention.defvjp(_rf_fwd, _rf_bwd)

@@ -1,0 +1,211 @@
+"""Mesh composition for the Pallas kernels — the SPMD-rule layer.
+
+The reference keeps its fused kernels alive under auto-parallel by
+registering an explicit SPMD rule per op (e.g.
+`paddle/phi/infermeta/spmd_rules/flash_attention.cc`, wired through
+`ops.yaml`). GSPMD cannot partition a Mosaic custom call, so the TPU
+analogue is a fully-manual ``shard_map`` wrapper per kernel:
+
+- batch dims shard over ("data", "sharding") — embarrassingly parallel;
+- the head dim shards over "model" (TP: column-parallel QKV already lays
+  heads out this way);
+- a sequence dim sharded over "sep" dispatches to
+  :mod:`ops.pallas.ring_flash` (KV ring + online-softmax merge);
+- every other mesh axis (e.g. "pipe") is unreferenced → the wrapper sees
+  replicated data, which is exactly the scanned-pipeline layout.
+
+``F.scaled_dot_product_attention`` / ``rms_norm`` / rope consult
+:func:`active_mesh` and route through these wrappers whenever a hybrid mesh
+is live, so the fused kernels and the distributed engine compose (the gap
+called out in round 2: the 56% MFU path previously existed only
+single-chip)."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["active_mesh", "mesh_flash_supported", "mesh_flash_attention",
+           "mesh_rms_norm_supported", "mesh_rms_norm",
+           "mesh_rope_supported", "mesh_rope"]
+
+
+def active_mesh() -> Optional[Mesh]:
+    """The hybrid mesh when one is live and non-trivial, else None."""
+    from ..distributed.topology import get_hybrid_communicate_group
+
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        return None
+    mesh = hcg.mesh
+    if math.prod(mesh.shape.values()) <= 1:
+        return None
+    return mesh
+
+
+def _size(mesh: Mesh, name: str) -> int:
+    return mesh.shape.get(name, 1)
+
+
+def _batch_axes(mesh: Mesh) -> Tuple[str, ...]:
+    return tuple(a for a in ("data", "sharding") if _size(mesh, a) > 1)
+
+
+def _dim_entry(axes):
+    if not axes:
+        return None
+    return axes if isinstance(axes, str) else tuple(axes)
+
+
+def _flatten(spec: P) -> Tuple[str, ...]:
+    out = []
+    for s in spec:
+        if s is None:
+            continue
+        out.extend(s if isinstance(s, tuple) else (s,))
+    return tuple(out)
+
+
+def _auto_block(s: int, cap: int = 256) -> Optional[int]:
+    """Largest sublane-aligned (multiple of 8) divisor of ``s`` up to
+    ``cap``; None when the dim can't be tiled."""
+    if s % 8 != 0:
+        return None
+    for b in range(min(cap, s), 7, -8):
+        if s % b == 0:
+            return b
+    return None
+
+
+# ---------------------------------------------------------------------------
+# flash attention
+# ---------------------------------------------------------------------------
+def _attn_spec(mesh: Mesh) -> P:
+    """[b, s, h, d] layout: batch over data×sharding, seq over sep, heads
+    over model."""
+    return P(_dim_entry(_batch_axes(mesh)),
+             "sep" if _size(mesh, "sep") > 1 else None,
+             "model" if _size(mesh, "model") > 1 else None,
+             None)
+
+
+def _attn_local_shapes(mesh, q_shape, k_shape):
+    b, sq, hq, d = q_shape
+    _, sk, hkv, _ = k_shape
+    dp = math.prod(_size(mesh, a) for a in _batch_axes(mesh)) or 1
+    mp = max(_size(mesh, "model"), 1)
+    sep = max(_size(mesh, "sep"), 1)
+    if b % dp or sq % sep or sk % sep or hq % mp or hkv % mp:
+        return None
+    return ((b // dp, sq // sep, hq // mp, d),
+            (b // dp, sk // sep, hkv // mp, d), sep)
+
+
+def mesh_flash_supported(mesh: Mesh, q_shape, k_shape, *, has_mask: bool,
+                         dropout_p: float, causal: bool) -> bool:
+    from .pallas import flash_attention_supported
+
+    local = _attn_local_shapes(mesh, q_shape, k_shape)
+    if local is None:
+        return False
+    lq, lk, sep = local
+    if sep > 1 and lq[1] != lk[1]:
+        return False  # ring needs equal chunking of q and kv
+    bq, bk = _auto_block(lq[1]), _auto_block(lk[1])
+    if bq is None or bk is None:
+        return False
+    return flash_attention_supported(lq, lk, has_mask=has_mask,
+                                     dropout_p=dropout_p, causal=causal,
+                                     block_q=bq, block_k=bk)
+
+
+def mesh_flash_attention(q, k, v, mesh: Mesh, *, causal: bool = False,
+                         scale: Optional[float] = None,
+                         interpret: bool = False):
+    """GLOBAL [b, s, h, d] q/k/v → global out, with the Pallas kernel running
+    shard-local under a fully-manual shard_map over ``mesh``."""
+    from .pallas import flash_attention
+    from .pallas.ring_flash import ring_flash_attention
+
+    spec = _attn_spec(mesh)
+    lq, lk, sep = _attn_local_shapes(mesh, q.shape, k.shape)
+    bq, bk = _auto_block(lq[1]), _auto_block(lk[1])
+    varying = _flatten(spec)
+
+    if sep > 1:
+        def body(ql, kl, vl):
+            return ring_flash_attention(ql, kl, vl, "sep", sep, causal, scale,
+                                        bq, bk, interpret, varying)
+    else:
+        def body(ql, kl, vl):
+            return flash_attention(ql, kl, vl, scale, causal, bq, bk,
+                                   interpret)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# fused rms norm
+# ---------------------------------------------------------------------------
+def _rows_spec(mesh: Mesh, ndim: int) -> P:
+    """[batch, (seq,) ..., hidden]: dim0 over data×sharding, dim1 over sep
+    when rank ≥ 3; hidden replicated (the norm reduces over it)."""
+    entries = [_dim_entry(_batch_axes(mesh))]
+    if ndim >= 3 and _size(mesh, "sep") > 1:
+        entries.append("sep")
+    entries += [None] * (ndim - len(entries))
+    return P(*entries)
+
+
+def mesh_rms_norm_supported(mesh: Mesh, x_shape) -> bool:
+    dp = math.prod(_size(mesh, a) for a in _batch_axes(mesh)) or 1
+    sep = max(_size(mesh, "sep"), 1)
+    if x_shape[0] % dp:
+        return False
+    if len(x_shape) >= 3 and x_shape[1] % sep:
+        return False
+    rows = math.prod(x_shape[:-1]) // (dp * (sep if len(x_shape) >= 3 else 1))
+    return rows % 8 == 0 and x_shape[-1] % 128 == 0
+
+
+def mesh_rms_norm(x, weight, mesh: Mesh, eps: float, interpret: bool = False):
+    from .pallas import fused_rms_norm
+
+    spec = _rows_spec(mesh, x.ndim)
+    fn = jax.shard_map(
+        lambda xl, wl: fused_rms_norm(xl, wl, eps, interpret=interpret),
+        mesh=mesh, in_specs=(spec, P(None)), out_specs=spec, check_vma=False)
+    return fn(x, weight)
+
+
+# ---------------------------------------------------------------------------
+# fused rope
+# ---------------------------------------------------------------------------
+def mesh_rope_supported(mesh: Mesh, q_shape, k_shape) -> bool:
+    local = _attn_local_shapes(mesh, q_shape, k_shape)
+    if local is None:
+        return False
+    lq, lk, _ = local
+    return lq[1] % 8 == 0 and lk[1] % 8 == 0 and lq[3] % 2 == 0
+
+
+def mesh_rope(q, k, cos_s, sin_s, mesh: Mesh, interpret: bool = False):
+    """q/k [b, s, h, d] global; cos_s/sin_s [s, d] position tables — the
+    table rows ride the same "sep" sharding as the sequence dim, so each
+    shard rotates with its own positions."""
+    from .pallas import fused_rope
+
+    spec = _attn_spec(mesh)
+    sep = "sep" if _size(mesh, "sep") > 1 else None
+    tspec = P(sep, None)
+    fn = jax.shard_map(
+        lambda ql, kl, cl, sl: fused_rope(ql, kl, cl, sl, interpret=interpret),
+        mesh=mesh, in_specs=(spec, spec, tspec, tspec),
+        out_specs=(spec, spec), check_vma=False)
+    return fn(q, k, cos_s, sin_s)

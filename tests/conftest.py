@@ -22,6 +22,13 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy tests (many XLA compiles / multi-process); run the fast "
+        "lane with -m 'not slow', the heavies with -m slow")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

@@ -4,6 +4,8 @@ changes (reference `distributed/checkpoint/` semantics, SURVEY §8.6)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 import paddle_tpu.distributed as dist
 import paddle_tpu.nn as nn

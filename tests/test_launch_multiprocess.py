@@ -10,6 +10,8 @@ import textwrap
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 _PAYLOAD = textwrap.dedent("""
     import os, re
     flags = os.environ.get("XLA_FLAGS", "")

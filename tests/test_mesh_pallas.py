@@ -1,0 +1,196 @@
+"""Pallas kernels composed with the hybrid mesh (round-2 verdict #1).
+
+The reference distributes its fused flash kernel via an SPMD rule
+(`paddle/phi/infermeta/spmd_rules/flash_attention.cc`); here the analogue is
+the fully-manual shard_map wrappers in ``ops/sharded.py`` + the ring-flash
+kernel in ``ops/pallas/ring_flash.py``. These tests run the REAL kernel code
+(Pallas interpreter) on the 8-device CPU mesh and check numerics against the
+pure-XLA reference, including gradients through the custom VJPs, plus that
+the compiled hybrid train step actually contains pallas_call ops."""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.framework.flags import flag_guard
+from paddle_tpu.ops.attention import sdpa_reference
+from paddle_tpu.ops.sharded import (mesh_flash_attention, mesh_flash_supported,
+                                    mesh_rms_norm, mesh_rope)
+from paddle_tpu.distributed.topology import build_mesh
+
+
+def _mesh(**degrees):
+    import math
+    total = math.prod(degrees.values())
+    return build_mesh(dp=degrees.get("data", 1), pp=degrees.get("pipe", 1),
+                      sharding=degrees.get("sharding", 1),
+                      sep=degrees.get("sep", 1), mp=degrees.get("model", 1),
+                      devices=jax.devices()[:total])
+
+
+def _qkv(rng, b=2, s=32, hq=4, hkv=4, d=16):
+    q = rng.standard_normal((b, s, hq, d)).astype(np.float32)
+    k = rng.standard_normal((b, s, hkv, d)).astype(np.float32)
+    v = rng.standard_normal((b, s, hkv, d)).astype(np.float32)
+    return jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("degrees,hkv", [
+    ({"sep": 4}, 4),            # pure ring
+    ({"sep": 4}, 2),            # ring + GQA
+    ({"data": 2, "sep": 2}, 4),  # ring × dp
+    ({"data": 2, "model": 2}, 4),  # no ring: batch/head parallel kernel
+    ({"data": 2, "model": 2, "sep": 2}, 2),  # everything + GQA
+])
+def test_mesh_flash_vs_reference(rng, causal, degrees, hkv):
+    mesh = _mesh(**degrees)
+    q, k, v = _qkv(rng, hkv=hkv)
+    assert mesh_flash_supported(mesh, q.shape, k.shape, has_mask=False,
+                                dropout_p=0.0, causal=causal)
+
+    def mesh_fn(q, k, v):
+        return mesh_flash_attention(q, k, v, mesh, causal=causal,
+                                    interpret=True)
+
+    ref_fn = lambda q, k, v: sdpa_reference(q, k, v, is_causal=causal)
+
+    out = mesh_fn(q, k, v)
+    ref = ref_fn(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+    # gradients: ring backward (rotating dK/dV accumulators) vs autodiff of
+    # the reference path
+    w = jnp.asarray(rng.standard_normal(ref.shape).astype(np.float32))
+    g_mesh = jax.grad(lambda q, k, v: jnp.sum(mesh_fn(q, k, v) * w),
+                      argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(lambda q, k, v: jnp.sum(ref_fn(q, k, v) * w),
+                     argnums=(0, 1, 2))(q, k, v)
+    for gm, gr, name in zip(g_mesh, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(gm), np.asarray(gr),
+                                   rtol=5e-4, atol=5e-4, err_msg=name)
+
+
+def test_mesh_flash_under_jit(rng):
+    mesh = _mesh(data=2, sep=2, model=2)
+    q, k, v = _qkv(rng, hkv=2)
+
+    @jax.jit
+    def fn(q, k, v):
+        return mesh_flash_attention(q, k, v, mesh, causal=True,
+                                    interpret=True)
+
+    out = fn(q, k, v)
+    ref = sdpa_reference(q, k, v, is_causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_mesh_rms_norm_and_rope(rng):
+    mesh = _mesh(data=2, sep=2)
+    x = jnp.asarray(rng.standard_normal((2, 16, 128)).astype(np.float32))
+    w = jnp.asarray(rng.standard_normal((128,)).astype(np.float32))
+    out = mesh_rms_norm(x, w, mesh, 1e-6, interpret=True)
+    ref = (x * jax.lax.rsqrt(jnp.mean(x * x, -1, keepdims=True) + 1e-6)) * w
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    q, k, _ = _qkv(rng, s=16, hq=4, hkv=2, d=8)
+    from paddle_tpu.models.llama import _rope_tables
+    cos, sin = _rope_tables(8, 16, 10000.0)
+    oq, ok = mesh_rope(q, k, cos, sin, mesh, interpret=True)
+
+    def rot(vv):
+        half = vv.shape[-1] // 2
+        return jnp.concatenate([-vv[..., half:], vv[..., :half]], axis=-1)
+
+    c, s_ = cos[None, :, None, :], sin[None, :, None, :]
+    np.testing.assert_allclose(np.asarray(oq), np.asarray(q * c + rot(q) * s_),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(ok), np.asarray(k * c + rot(k) * s_),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.fixture
+def hybrid_fleet():
+    strategy = dist.fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 1,
+                               "sharding_degree": 1, "sep_degree": 2}
+    dist.fleet.init(is_collective=True, strategy=strategy)
+    yield dist.get_hybrid_communicate_group()
+    dist.topology.set_hybrid_communicate_group(None)
+
+
+def test_hybrid_train_step_uses_pallas(hybrid_fleet):
+    """The flagship composition: DistributedTrainStep over dp×mp×sep with the
+    flash/norm/rope kernels active — the jaxpr must contain pallas_call and
+    one step must train (finite decreasing loss)."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.models.llama import llama_tiny
+    from paddle_tpu.models.llama_parallel import LlamaForCausalLMHybrid
+
+    hcg = hybrid_fleet
+    with flag_guard(pallas_interpret=True, use_flash_attention=True,
+                    use_fused_rms_norm=True, use_fused_rope=True):
+        paddle.seed(0)
+        cfg = llama_tiny(num_hidden_layers=2, num_attention_heads=4,
+                         num_key_value_heads=2, hidden_size=128,
+                         intermediate_size=256)
+        model = LlamaForCausalLMHybrid(cfg, hcg, context_parallel="ring")
+
+        rng = np.random.default_rng(0)
+        ids = paddle.to_tensor(
+            rng.integers(0, cfg.vocab_size, (4, 32)).astype("int32"))
+        labels = paddle.to_tensor(
+            rng.integers(0, cfg.vocab_size, (4, 32)).astype("int32"))
+
+        # the forward jaxpr must actually contain the kernels
+        jaxpr = jax.make_jaxpr(
+            lambda x, y: model(paddle.Tensor(x), labels=paddle.Tensor(y))[0].value
+        )(ids.value, labels.value)
+        assert "pallas_call" in str(jaxpr), "no pallas_call in hybrid forward"
+
+        opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters(),
+                                     grad_clip=nn.ClipGradByGlobalNorm(1.0))
+        step = dist.DistributedTrainStep(
+            model, lambda m, x, y: m(x, labels=y)[0], opt, hcg,
+            sharding_stage=0)
+        loss1 = float(step(ids, labels))
+        loss2 = float(step(ids, labels))
+        assert np.isfinite(loss1) and np.isfinite(loss2)
+        assert loss2 < loss1
+
+
+def test_hybrid_flash_matches_sdpa_loss(hybrid_fleet):
+    """Same seed/batch: forward loss with the kernels on vs off must agree —
+    the honesty check that the mesh kernels compute the same math."""
+    from paddle_tpu.models.llama import llama_tiny
+    from paddle_tpu.models.llama_parallel import LlamaForCausalLMHybrid
+
+    hcg = hybrid_fleet
+    rng = np.random.default_rng(1)
+    losses = []
+    for interp in (True, False):
+        with flag_guard(pallas_interpret=interp, use_flash_attention=interp,
+                        use_fused_rms_norm=interp, use_fused_rope=interp):
+            paddle.seed(0)
+            cfg = llama_tiny(num_hidden_layers=2, num_attention_heads=4,
+                             num_key_value_heads=4, hidden_size=128,
+                             intermediate_size=256)
+            model = LlamaForCausalLMHybrid(cfg, hcg, context_parallel="ring")
+            ids = paddle.to_tensor(
+                np.random.default_rng(7).integers(
+                    0, cfg.vocab_size, (4, 32)).astype("int32"))
+            labels = paddle.to_tensor(
+                np.random.default_rng(8).integers(
+                    0, cfg.vocab_size, (4, 32)).astype("int32"))
+            loss, _ = model(ids, labels=labels)
+            losses.append(float(loss))
+    assert abs(losses[0] - losses[1]) < 5e-3, losses

@@ -8,6 +8,8 @@ exactly — forward, backward, and multi-step training loss."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import jax
 
 import paddle_tpu as paddle
