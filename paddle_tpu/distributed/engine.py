@@ -346,19 +346,23 @@ class GPipeLayers(ScannedLayers):
 
             (_, ys), _ = jax.lax.scan(tick, (state0, ys0),
                                       jnp.arange(m + n_stages - 1))
-            # results live on the last stage; make them pipe-replicated
-            ys = jax.lax.psum(
-                jnp.where(stage == n_stages - 1, ys, jnp.zeros_like(ys)), axis)
-            return ys.reshape(xv_.shape)
+            # results live on the last stage; expose them pipe-sharded on a
+            # leading stage dim and let the caller slice stage P-1 — GSPMD
+            # then moves only the real data to consumers, instead of the
+            # full-output masked psum this used to do (round-2 weak #4)
+            return ys.reshape((1,) + xv_.shape)
 
         pipeline = jax.shard_map(
             sharded_body, mesh=mesh, axis_names={axis},
             in_specs=tuple([P()] + [P(axis)] * len(stacked)),
-            out_specs=P(), check_vma=True)
+            out_specs=P(axis), check_vma=True)
+
+        def pipeline_out(xv_, *stacks_):
+            return pipeline(xv_, *stacks_)[n_stages - 1]
 
         from ..tensor.tensor import apply_op
 
-        return apply_op("gpipe_pipeline", pipeline, tuple([x] + stacked))
+        return apply_op("gpipe_pipeline", pipeline_out, tuple([x] + stacked))
 
 
 def gpipe_spmd_step(layers: Sequence[Layer], mesh: Mesh, num_microbatches: int,

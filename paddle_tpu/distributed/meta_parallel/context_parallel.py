@@ -128,13 +128,14 @@ def ring_attention(q, k, v, mesh: Optional[Mesh] = None, sep_axis: str = "sep",
 
     if n > 1 and pallas_eligible("use_flash_attention") and \
             mesh_flash_supported(mesh, q.shape, k.shape, has_mask=False,
-                                 dropout_p=0.0, causal=causal):
+                                 dropout_p=0.0, causal=causal,
+                                 sep_axis=sep_axis):
         interp = pallas_interpret_mode()
         return apply_op(
             "ring_flash_attention",
             lambda qv, kv, vv: mesh_flash_attention(
                 qv, kv, vv, mesh, causal=causal, scale=scale,
-                interpret=interp),
+                interpret=interp, sep_axis=sep_axis),
             (q, k, v))
     sc = scale if scale is not None else 1.0 / float(d) ** 0.5
     perm = [(r, (r + 1) % n) for r in range(n)]

@@ -211,7 +211,8 @@ class TCPStore:
         self.world_size, self.timeout = world_size, timeout
         self._server: Optional[_StoreServer] = None
         if is_master:
-            self._server = _StoreServer("", port)
+            bind_host = "" if host in ("", "0.0.0.0", "localhost") else host
+            self._server = _StoreServer(bind_host, port)
             self._server.start()
             port = self._server.port
         self.port = port
@@ -234,9 +235,27 @@ class TCPStore:
                 time.sleep(0.1)
 
     def _call(self, **req) -> dict:
+        # the socket's recv deadline must EXCEED the server-side command
+        # window (get/wait/barrier block up to their own timeout before the
+        # server replies); if it fired first the reply would stay queued and
+        # desync the framed protocol for every later call
+        cmd_timeout = float(req.get("timeout") or self.timeout)
         with self._lock:
-            _send_msg(self._sock, req)
-            resp = _recv_msg(self._sock)
+            try:
+                self._sock.settimeout(cmd_timeout + 10.0)
+                _send_msg(self._sock, req)
+                resp = _recv_msg(self._sock)
+            except (socket.timeout, OSError):
+                # connection state unknown — reconnect so later calls see a
+                # clean stream instead of a stale reply
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = self._connect(
+                    self.host if not self.is_master else "127.0.0.1",
+                    self.port, self.timeout)
+                raise TimeoutError(f"store call {req.get('cmd')} timed out")
         if "error" in resp:
             if resp["error"] == "timeout":
                 raise TimeoutError(resp.get("detail", ""))
@@ -336,20 +355,44 @@ class TCPKVStore:
         return float("inf") if a is None else a
 
 
+def _host_is_local(host: str) -> bool:
+    """True when ``host`` names this machine — only then may a process try
+    to HOST the rendezvous store. A bind test alone is wrong across nodes:
+    the port is free on every other machine too, so every node would elect
+    itself master and rendezvous could never complete."""
+    if host in ("", "0.0.0.0", "127.0.0.1", "localhost"):
+        return True
+    names = {socket.gethostname(), socket.getfqdn()}
+    try:
+        names.update(socket.gethostbyname_ex(socket.gethostname())[2])
+    except OSError:
+        pass
+    if host in names:
+        return True
+    try:
+        return socket.gethostbyname(host) in names | {"127.0.0.1"}
+    except OSError:
+        return False
+
+
 def rendezvous(master: str, nnodes: int, job_id: str = "default",
                node_rank: Optional[int] = None,
                timeout: float = 300.0) -> Tuple[TCPStore, int]:
     """Multi-node launch rendezvous (reference `controllers/master.py:73`):
-    the process that wins the bind race on ``master`` (host:port) hosts the
-    store; every node gets (or registers) its node rank, publishes its
-    hostname, and all nodes leave through a barrier together. Returns
-    ``(store, node_rank)``."""
+    a process ON the master host (bind-race decides among local peers)
+    hosts the store; every other node connects as a client; every node gets
+    (or registers) its node rank, publishes its hostname, and all nodes
+    leave through a barrier together. Returns ``(store, node_rank)``."""
     host, port_s = master.rsplit(":", 1)
     port = int(port_s)
-    try:
-        store = TCPStore(host, port, is_master=True, world_size=nnodes,
-                         timeout=timeout)
-    except OSError:
+    store = None
+    if _host_is_local(host):
+        try:
+            store = TCPStore(host, port, is_master=True, world_size=nnodes,
+                             timeout=timeout)
+        except OSError:
+            store = None
+    if store is None:
         store = TCPStore(host, port, is_master=False, world_size=nnodes,
                          timeout=timeout)
     if node_rank is None or node_rank < 0:

@@ -84,21 +84,21 @@ def _auto_block(s: int, cap: int = 256) -> Optional[int]:
 # ---------------------------------------------------------------------------
 # flash attention
 # ---------------------------------------------------------------------------
-def _attn_spec(mesh: Mesh) -> P:
-    """[b, s, h, d] layout: batch over data×sharding, seq over sep, heads
-    over model."""
+def _attn_spec(mesh: Mesh, sep_axis: str = "sep") -> P:
+    """[b, s, h, d] layout: batch over data×sharding, seq over the sequence
+    axis (``sep_axis``), heads over model."""
     return P(_dim_entry(_batch_axes(mesh)),
-             "sep" if _size(mesh, "sep") > 1 else None,
+             sep_axis if _size(mesh, sep_axis) > 1 else None,
              "model" if _size(mesh, "model") > 1 else None,
              None)
 
 
-def _attn_local_shapes(mesh, q_shape, k_shape):
+def _attn_local_shapes(mesh, q_shape, k_shape, sep_axis: str = "sep"):
     b, sq, hq, d = q_shape
     _, sk, hkv, _ = k_shape
     dp = math.prod(_size(mesh, a) for a in _batch_axes(mesh)) or 1
     mp = max(_size(mesh, "model"), 1)
-    sep = max(_size(mesh, "sep"), 1)
+    sep = max(_size(mesh, sep_axis), 1)
     if b % dp or sq % sep or sk % sep or hq % mp or hkv % mp:
         return None
     return ((b // dp, sq // sep, hq // mp, d),
@@ -106,10 +106,11 @@ def _attn_local_shapes(mesh, q_shape, k_shape):
 
 
 def mesh_flash_supported(mesh: Mesh, q_shape, k_shape, *, has_mask: bool,
-                         dropout_p: float, causal: bool) -> bool:
+                         dropout_p: float, causal: bool,
+                         sep_axis: str = "sep") -> bool:
     from .pallas import flash_attention_supported
 
-    local = _attn_local_shapes(mesh, q_shape, k_shape)
+    local = _attn_local_shapes(mesh, q_shape, k_shape, sep_axis)
     if local is None:
         return False
     lq, lk, sep = local
@@ -125,21 +126,21 @@ def mesh_flash_supported(mesh: Mesh, q_shape, k_shape, *, has_mask: bool,
 
 def mesh_flash_attention(q, k, v, mesh: Mesh, *, causal: bool = False,
                          scale: Optional[float] = None,
-                         interpret: bool = False):
+                         interpret: bool = False, sep_axis: str = "sep"):
     """GLOBAL [b, s, h, d] q/k/v → global out, with the Pallas kernel running
     shard-local under a fully-manual shard_map over ``mesh``."""
     from .pallas import flash_attention
     from .pallas.ring_flash import ring_flash_attention
 
-    spec = _attn_spec(mesh)
-    lq, lk, sep = _attn_local_shapes(mesh, q.shape, k.shape)
+    spec = _attn_spec(mesh, sep_axis)
+    lq, lk, sep = _attn_local_shapes(mesh, q.shape, k.shape, sep_axis)
     bq, bk = _auto_block(lq[1]), _auto_block(lk[1])
     varying = _flatten(spec)
 
     if sep > 1:
         def body(ql, kl, vl):
-            return ring_flash_attention(ql, kl, vl, "sep", sep, causal, scale,
-                                        bq, bk, interpret, varying)
+            return ring_flash_attention(ql, kl, vl, sep_axis, sep, causal,
+                                        scale, bq, bk, interpret, varying)
     else:
         def body(ql, kl, vl):
             return flash_attention(ql, kl, vl, scale, causal, bq, bk,

@@ -3,6 +3,8 @@
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 import paddle_tpu.nn.functional as F
 from paddle_tpu.models import (GPTForCausalLM, LlamaForCausalLM, ErnieForSequenceClassification,

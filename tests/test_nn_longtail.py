@@ -66,6 +66,7 @@ class TestCTC:
         e1 = brute_force_ctc(logits[:4, 1], [3])
         np.testing.assert_allclose(loss, [e0, e1], rtol=1e-4)
 
+    @pytest.mark.slow
     def test_layer_and_grad_and_training(self):
         """CTC trains a toy alignment: logits learn to emit the target."""
         paddle.seed(0)

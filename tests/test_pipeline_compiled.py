@@ -127,6 +127,145 @@ class TestGPipeLayers:
         assert out.shape == [4, 8]
 
 
+class TestOneFOneBCompiled:
+    """Compiled 1F1B / interleaved-VPP engine (round-2 verdict #2): the
+    whole schedule — forwards, recompute backwards, ring hops, fused loss —
+    in ONE XLA program. Parity target: the host engines above and the
+    reference `pipeline_parallel.py:440,906`."""
+
+    def _loss(self):
+        return lambda out, y: F.mse_loss(out, y)
+
+    def _seq_ref(self, blocks, x, y, m):
+        losses = []
+        for mx, my in zip(np.split(x, m), np.split(y, m)):
+            h = paddle.to_tensor(mx)
+            for b in blocks:
+                h = b(h)
+            ml = F.mse_loss(h, paddle.to_tensor(my))
+            (ml * (1.0 / m)).backward()
+            losses.append(float(ml.numpy()))
+        return float(np.mean(losses))
+
+    @pytest.mark.parametrize("v,n_layers", [(1, 4), (2, 4)])
+    def test_loss_and_grads_match_sequential(self, pipe_mesh, v, n_layers):
+        from paddle_tpu.distributed import OneFOneBLayers
+
+        blocks = make_blocks(n_layers, 16)
+        ref_blocks = make_blocks(n_layers, 16)
+        eng = OneFOneBLayers(blocks, pipe_mesh, num_microbatches=4,
+                             loss_fn=self._loss(), num_virtual_stages=v)
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((8, 16)).astype(np.float32)
+        y = rng.standard_normal((8, 16)).astype(np.float32)
+        loss, grads = eng.loss_and_grads(paddle.to_tensor(x), paddle.to_tensor(y))
+        ref_loss = self._seq_ref(ref_blocks, x, y, 4)
+        np.testing.assert_allclose(float(loss.numpy()), ref_loss, rtol=1e-5)
+        for k, name in enumerate(eng._stack_names):
+            ref = np.stack([dict(b.named_parameters())[name].grad.numpy()
+                            for b in ref_blocks])[eng._row_order]
+            np.testing.assert_allclose(np.asarray(grads[k]), ref, rtol=1e-4,
+                                       atol=1e-5, err_msg=f"v={v} {name}")
+
+    def test_pipe4_interleaved_matches_and_beats_gpipe_compute(self):
+        """pipe-4 mesh: parity + the bubble claim — the 1F1B schedule
+        executes exactly the useful segment-steps (2*P*M*v) while compiled
+        GPipe's lockstep scan executes 2*P*v*(M+P-1), i.e. its bubble is
+        real wasted compute."""
+        from paddle_tpu.distributed import OneFOneBLayers, make_1f1b_schedule
+
+        mesh4 = build_mesh(dp=1, pp=4, sharding=1, sep=1, mp=1,
+                           devices=jax.devices()[:4])
+        P_, M_, V_ = 4, 4, 2
+        blocks = make_blocks(8, 8, seed=3)
+        ref_blocks = make_blocks(8, 8, seed=3)
+        eng = OneFOneBLayers(blocks, mesh4, num_microbatches=M_,
+                             loss_fn=self._loss(), num_virtual_stages=V_)
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((8, 8)).astype(np.float32)
+        y = rng.standard_normal((8, 8)).astype(np.float32)
+        loss, grads = eng.loss_and_grads(paddle.to_tensor(x), paddle.to_tensor(y))
+        ref_loss = self._seq_ref(ref_blocks, x, y, M_)
+        np.testing.assert_allclose(float(loss.numpy()), ref_loss, rtol=1e-5)
+        for k, name in enumerate(eng._stack_names):
+            ref = np.stack([dict(b.named_parameters())[name].grad.numpy()
+                            for b in ref_blocks])[eng._row_order]
+            np.testing.assert_allclose(np.asarray(grads[k]), ref, rtol=1e-4,
+                                       atol=1e-5)
+
+        sched = make_1f1b_schedule(P_, M_, V_)
+        useful = 2 * P_ * M_ * V_
+        gpipe_equiv = 2 * P_ * V_ * (M_ + P_ - 1)
+        assert sched["busy_micro_steps"] == useful < gpipe_equiv
+        # memory bound: in-flight activation stash depth stays O(P*v), not M*v
+        assert sched["Da"] <= 2 * P_ * V_
+
+    def test_train_batch_trains(self, pipe_mesh):
+        from paddle_tpu.distributed import OneFOneBLayers
+
+        eng = OneFOneBLayers(make_blocks(4, 16, seed=9), pipe_mesh,
+                             num_microbatches=4, loss_fn=self._loss())
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=eng.parameters())
+        rng = np.random.default_rng(7)
+        x = rng.standard_normal((8, 16)).astype(np.float32)
+        y = rng.standard_normal((8, 16)).astype(np.float32)
+        losses = [float(eng.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)),
+                                        opt).numpy()) for _ in range(4)]
+        assert losses[-1] < losses[0]
+
+    def test_matches_host_1f1b_engine(self, pipe_mesh):
+        """Same loss as the host-side scheduler (the behavior-parity engine)."""
+        from paddle_tpu.distributed import OneFOneBLayers
+        from paddle_tpu.distributed.meta_parallel import PipelineParallel
+        from paddle_tpu.distributed.meta_parallel.pp_layers import PipelineLayer
+
+        width, L, m = 12, 4, 4
+        blocks = make_blocks(L, width, seed=11)
+        host_blocks = make_blocks(L, width, seed=11)
+        eng = OneFOneBLayers(blocks, pipe_mesh, num_microbatches=m,
+                             loss_fn=self._loss())
+        pl = PipelineLayer(host_blocks, num_stages=2,
+                           loss_fn=lambda out, yy: F.mse_loss(out, yy))
+        host = PipelineParallel(pl, accumulate_steps=m)
+        rng = np.random.default_rng(13)
+        x = rng.standard_normal((8, width)).astype(np.float32)
+        y = rng.standard_normal((8, width)).astype(np.float32)
+        loss, grads = eng.loss_and_grads(paddle.to_tensor(x), paddle.to_tensor(y))
+        host_loss = host.forward_backward_pipeline(paddle.to_tensor(x),
+                                                   paddle.to_tensor(y))
+        np.testing.assert_allclose(float(loss.numpy()),
+                                   float(host_loss.numpy()), rtol=1e-5)
+        for k, name in enumerate(eng._stack_names):
+            ref = np.stack([dict(b.named_parameters())[name].grad.numpy()
+                            for b in host_blocks])[eng._row_order]
+            np.testing.assert_allclose(np.asarray(grads[k]), ref,
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_schedule_dependencies_and_errors(self):
+        from paddle_tpu.distributed import OneFOneBLayers, make_1f1b_schedule
+
+        s = make_1f1b_schedule(4, 8, 2)
+        p, v = 4, 2
+        for (c, i, st), tf in s["tick_f"].items():
+            if st > 0:
+                assert s["tick_f"][(c, i, st - 1)] < tf
+            elif c > 0:
+                assert s["tick_f"][(c - 1, i, p - 1)] < tf
+        for (c, i, st), tb in s["tick_b"].items():
+            assert s["tick_f"][(c, i, st)] < tb
+            if st < p - 1:
+                assert s["tick_b"][(c, i, st + 1)] < tb
+            elif c < v - 1:
+                assert s["tick_b"][(c + 1, i, 0)] < tb
+        with pytest.raises(ValueError, match="multiple of the pipe degree"):
+            make_1f1b_schedule(4, 6, 2)
+        mesh2 = build_mesh(dp=1, pp=2, sharding=1, sep=1, mp=1,
+                           devices=jax.devices()[:2])
+        with pytest.raises(ValueError, match="not divisible by pipe"):
+            OneFOneBLayers(make_blocks(3, 8), mesh2, 2, lambda o, y: o.mean())
+
+
 class TestInterleavedVPP:
     """PipelineParallelWithInterleave (reference pipeline_parallel.py:906)."""
 

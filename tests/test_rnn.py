@@ -137,6 +137,7 @@ class TestFusedLSTM:
         np.testing.assert_allclose(h.numpy()[0, 0], ref_h[0], rtol=1e-4,
                                    atol=1e-5)
 
+    @pytest.mark.slow
     def test_trains_on_sequence_task(self):
         """LSTM learns to output the sign of the cumulative sum."""
         paddle.seed(0)

