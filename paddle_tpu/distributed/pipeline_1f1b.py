@@ -303,7 +303,7 @@ class OneFOneBLayers(GPipeLayers):
         return apply_op("vpp_forward", fn, tuple([x] + stacked))
 
     # -- compiled 1F1B ------------------------------------------------------
-    def _build(self, x_sds, y_sds):
+    def _build(self):
         mesh, axis = self._mesh, self._pipe_axis
         p = mesh.shape[axis]
         m, v, ell = self.num_microbatches, self._v, self._ell
@@ -471,7 +471,7 @@ class OneFOneBLayers(GPipeLayers):
                              f"num_microbatches {self.num_microbatches}")
         key = (xv.shape, str(xv.dtype), yv.shape, str(yv.dtype))
         if key not in self._cache:
-            self._cache[key] = self._build(xv, yv)
+            self._cache[key] = self._build()
         stacks = [self._parameters[n.replace(".", "__")]._value
                   for n in self._stack_names]
         out = self._cache[key](xv, yv, *stacks)

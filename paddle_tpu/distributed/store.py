@@ -216,9 +216,13 @@ class TCPStore:
             self._server.start()
             port = self._server.port
         self.port = port
+        # the master's own client must dial the address the server actually
+        # listens on: loopback only when the bind was wildcard/loopback
+        self._connect_host = ("127.0.0.1"
+                              if host in ("", "0.0.0.0", "localhost",
+                                          "127.0.0.1") else host)
         self._lock = threading.Lock()
-        self._sock = self._connect(host if not is_master else "127.0.0.1",
-                                   port, timeout)
+        self._sock = self._connect(self._connect_host, port, timeout)
 
     @staticmethod
     def _connect(host: str, port: int, timeout: float) -> socket.socket:
@@ -252,9 +256,8 @@ class TCPStore:
                     self._sock.close()
                 except OSError:
                     pass
-                self._sock = self._connect(
-                    self.host if not self.is_master else "127.0.0.1",
-                    self.port, self.timeout)
+                self._sock = self._connect(self._connect_host, self.port,
+                                           self.timeout)
                 raise TimeoutError(f"store call {req.get('cmd')} timed out")
         if "error" in resp:
             if resp["error"] == "timeout":
@@ -370,7 +373,20 @@ def _host_is_local(host: str) -> bool:
     if host in names:
         return True
     try:
-        return socket.gethostbyname(host) in names | {"127.0.0.1"}
+        if socket.gethostbyname(host) in names | {"127.0.0.1"}:
+            return True
+    except OSError:
+        pass
+    # IP-form hosts naming one of this machine's interfaces may not appear
+    # in any hostname lookup — a bind probe is authoritative (binding a
+    # SPECIFIC address only succeeds locally, and port 0 avoids races)
+    try:
+        probe = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            probe.bind((host, 0))
+            return True
+        finally:
+            probe.close()
     except OSError:
         return False
 
