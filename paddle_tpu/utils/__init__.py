@@ -1,0 +1,5 @@
+"""paddle.utils parity surface (the slices the TPU build needs)."""
+
+from . import cpp_extension  # noqa: F401
+
+__all__ = ["cpp_extension"]

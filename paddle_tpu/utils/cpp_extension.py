@@ -1,0 +1,228 @@
+"""Custom-op / custom-kernel registration (SURVEY N25).
+
+The reference loads user C++/CUDA ops at runtime through a stable C ABI
+(`paddle/phi/capi/include/pd_kernel.h`, `fluid/framework/custom_operator.cc`,
+user-facing `paddle.utils.cpp_extension.load` — exercised by
+`test/custom_op/test_custom_relu_op_setup.py`). The TPU-native equivalents:
+
+- :func:`load` — JIT-compile C++ sources against jaxlib's bundled XLA FFI
+  headers into a shared library, read its exported op manifest
+  (``PD_TPU_OP_MANIFEST`` from ``paddle_tpu/extension.h``), register every
+  handler with ``jax.ffi.register_ffi_target`` and return a module-like
+  object whose attributes are differentiable Tensor ops (grad handlers wire
+  into ``jax.custom_vjp``). FFI custom calls execute on the host, so they
+  register for the CPU platform — the reference's "custom CPU kernel" story
+  (`test/custom_runtime/test_custom_cpu_plugin.py`).
+- :func:`register_op` — the pure-Python/Pallas path: hand a traceable
+  forward (jnp ops or a ``pallas_call``) and optionally a backward; the op
+  is wrapped in ``custom_vjp``, funneled through ``apply_op`` (so the eager
+  tape records it) and published in :data:`custom_ops`. This is how an
+  out-of-tree TPU kernel plugs in.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["CppExtension", "load", "register_op", "get_op", "custom_ops"]
+
+_INCLUDE = os.path.join(os.path.dirname(__file__), "include")
+
+#: name → Tensor-level callable for every registered custom op
+custom_ops: Dict[str, Callable] = {}
+
+
+def CppExtension(sources: Sequence[str], **kwargs):
+    """setuptools-style descriptor (reference `cpp_extension.setup` shape);
+    returns the kwargs bundle :func:`load` consumes."""
+    return {"sources": list(sources), **kwargs}
+
+
+def _compile(name: str, sources: Sequence[str], extra_cxx_flags, build_dir,
+             verbose: bool) -> str:
+    os.makedirs(build_dir, exist_ok=True)
+    tag = hashlib.sha1()
+    for s in sources:
+        with open(s, "rb") as f:
+            tag.update(f.read())
+    tag.update(" ".join(extra_cxx_flags or []).encode())
+    so_path = os.path.join(build_dir, f"{name}_{tag.hexdigest()[:12]}.so")
+    if os.path.exists(so_path):
+        return so_path
+    # compile to a process-private temp name, then atomically rename: several
+    # ranks of a multi-process launch build the same extension at startup and
+    # must never CDLL a half-written library
+    tmp_path = f"{so_path}.tmp.{os.getpid()}"
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+           f"-I{jax.ffi.include_dir()}", f"-I{_INCLUDE}",
+           *(extra_cxx_flags or []), *sources, "-o", tmp_path]
+    if verbose:
+        print("[paddle_tpu.cpp_extension]", " ".join(cmd))
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"custom-op build failed:\n{proc.stderr}")
+    os.replace(tmp_path, so_path)
+    return so_path
+
+
+def _parse_manifest(lib: ctypes.CDLL) -> List[dict]:
+    try:
+        fn = lib.paddle_tpu_op_manifest
+    except AttributeError:
+        raise RuntimeError(
+            "library exports no paddle_tpu_op_manifest(); declare ops with "
+            "PD_TPU_OP_MANIFEST in paddle_tpu/extension.h")
+    fn.restype = ctypes.c_char_p
+    entries = []
+    for part in fn().decode().split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        head, *opts = part.split(",")
+        op, fwd = head.split("=")
+        entry = {"op": op.strip(), "fwd": fwd.strip(), "grad": None}
+        for o in opts:
+            k, v = o.split("=")
+            if k.strip() == "grad":
+                entry["grad"] = v.strip()
+        entries.append(entry)
+    return entries
+
+
+class _OpModule:
+    """Attribute bundle returned by :func:`load` (mirrors the generated
+    python module of the reference's custom-op build)."""
+
+    def __init__(self, name):
+        self._name = name
+
+    def __repr__(self):
+        ops = [k for k in self.__dict__ if not k.startswith("_")]
+        return f"<paddle_tpu custom-op module {self._name}: {ops}>"
+
+
+def load(name: str, sources: Sequence[str],
+         extra_cxx_flags: Optional[Sequence[str]] = None,
+         build_directory: Optional[str] = None,
+         verbose: bool = False) -> _OpModule:
+    """Compile + register every op in ``sources``; returns a module-like
+    object with one differentiable function per op (reference
+    `paddle.utils.cpp_extension.load`)."""
+    build_dir = build_directory or os.path.join(
+        tempfile.gettempdir(), "paddle_tpu_extensions")
+    so_path = _compile(name, sources, extra_cxx_flags, build_dir, verbose)
+    lib = ctypes.CDLL(so_path)
+    mod = _OpModule(name)
+    for entry in _parse_manifest(lib):
+        target = f"{name}.{entry['op']}"
+        jax.ffi.register_ffi_target(
+            target, jax.ffi.pycapsule(getattr(lib, entry["fwd"])),
+            platform="cpu")
+        grad_target = None
+        if entry["grad"]:
+            grad_target = f"{target}_grad"
+            jax.ffi.register_ffi_target(
+                grad_target, jax.ffi.pycapsule(getattr(lib, entry["grad"])),
+                platform="cpu")
+        fn = _build_ffi_op(entry["op"], target, grad_target)
+        setattr(mod, entry["op"], fn)
+        _publish(entry["op"], fn, target)
+    return mod
+
+
+def _publish(op_name: str, fn: Callable, target: Optional[str] = None) -> None:
+    """Publish under the bare op name, refusing silent cross-library
+    replacement (FFI targets are library-namespaced; this registry is not)."""
+    existing = custom_ops.get(op_name)
+    if existing is not None and getattr(existing, "_ffi_target", None) != target:
+        raise ValueError(
+            f"custom op '{op_name}' is already registered "
+            f"(target {getattr(existing, '_ffi_target', None)!r}); refusing to "
+            f"replace it with {target!r} — rename one of the ops")
+    fn._ffi_target = target
+    custom_ops[op_name] = fn
+
+
+def _build_ffi_op(op_name: str, target: str, grad_target: Optional[str]):
+    """Array-level FFI call (default infer_meta: outputs mirror the first
+    input, the elementwise contract) wrapped in custom_vjp when a grad
+    handler exists, surfaced as a Tensor op through apply_op."""
+
+    def fwd_arrays(*arrays):
+        out_type = jax.ShapeDtypeStruct(arrays[0].shape, arrays[0].dtype)
+        return jax.ffi.ffi_call(target, out_type)(*arrays)
+
+    if grad_target is not None:
+        @jax.custom_vjp
+        def op(*arrays):
+            return fwd_arrays(*arrays)
+
+        def vjp_fwd(*arrays):
+            return fwd_arrays(*arrays), arrays
+
+        def vjp_bwd(res, dy):
+            grads = jax.ffi.ffi_call(
+                grad_target,
+                [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in res])(
+                    *res, dy)
+            return tuple(grads) if isinstance(grads, (list, tuple)) else (grads,)
+
+        op.defvjp(vjp_fwd, vjp_bwd)
+    else:
+        op = fwd_arrays
+
+    def tensor_op(*args):
+        from ..tensor.tensor import Tensor, apply_op
+
+        targs = tuple(a if isinstance(a, Tensor) else Tensor(jnp.asarray(a))
+                      for a in args)
+        return apply_op(op_name, op, targs)
+
+    tensor_op.__name__ = op_name
+    return tensor_op
+
+
+def register_op(name: str, forward: Callable,
+                backward: Optional[Callable] = None) -> Callable:
+    """Pure-Python/Pallas custom-op registration (the TPU-kernel path).
+
+    ``forward(*arrays) -> array`` must be jax-traceable (jnp ops or a
+    ``pallas_call``); ``backward(inputs_tuple, dy) -> tuple_of_grads`` if
+    given wires a custom VJP, else JAX differentiates the forward. The
+    returned callable consumes/produces Tensors and is recorded on the
+    eager tape; it is also available via :func:`get_op`."""
+    fn = forward
+    if backward is not None:
+        @jax.custom_vjp
+        def fn(*arrays):
+            return forward(*arrays)
+
+        fn.defvjp(lambda *arrays: (forward(*arrays), arrays),
+                  lambda res, dy: tuple(backward(res, dy)))
+
+    def tensor_op(*args):
+        from ..tensor.tensor import Tensor, apply_op
+
+        targs = tuple(a if isinstance(a, Tensor) else Tensor(jnp.asarray(a))
+                      for a in args)
+        return apply_op(name, fn, targs)
+
+    tensor_op.__name__ = name
+    _publish(name, tensor_op)
+    return tensor_op
+
+
+def get_op(name: str) -> Callable:
+    try:
+        return custom_ops[name]
+    except KeyError:
+        raise KeyError(f"no custom op '{name}' registered; known: "
+                       f"{sorted(custom_ops)}")

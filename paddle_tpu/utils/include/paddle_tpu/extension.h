@@ -1,0 +1,16 @@
+// Out-of-tree custom-op header (reference: `paddle/extension.h` +
+// `paddle/phi/capi/include/pd_kernel.h` — the stable ABI an external op
+// compiles against). The TPU-native ABI is XLA's FFI: handlers are written
+// with xla::ffi (header shipped inside jaxlib, added to the include path by
+// paddle_tpu.utils.cpp_extension.load) and surfaced to Python through an
+// exported manifest that load() reads to register every op.
+#pragma once
+
+#include "xla/ffi/api/ffi.h"
+
+// Declare the ops this library provides. Format, ';'-separated entries:
+//   <op_name>=<fwd handler symbol>[,grad=<bwd handler symbol>]
+// The bwd handler receives the fwd inputs followed by the output cotangent
+// and must return one gradient buffer per differentiable input.
+#define PD_TPU_OP_MANIFEST(str) \
+  extern "C" const char* paddle_tpu_op_manifest() { return str; }

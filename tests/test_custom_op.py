@@ -1,0 +1,199 @@
+"""Out-of-tree custom-op registration tests (SURVEY N25; round-2 verdict #4).
+
+Mirrors the reference's `test/custom_op/test_custom_relu_op_setup.py`: build
+a custom relu from C++ sources at test time, call it through the framework,
+differentiate through it. Plus the TPU-kernel path: `register_op` with a
+traceable forward + custom backward."""
+
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import cpp_extension
+
+CUSTOM_RELU_CC = textwrap.dedent("""
+    #include "paddle_tpu/extension.h"
+
+    namespace ffi = xla::ffi;
+
+    static ffi::Error ReluFwdImpl(ffi::Buffer<ffi::F32> x,
+                                  ffi::ResultBuffer<ffi::F32> y) {
+      const float* xd = x.typed_data();
+      float* yd = y->typed_data();
+      for (size_t i = 0; i < x.element_count(); ++i)
+        yd[i] = xd[i] > 0.0f ? xd[i] : 0.0f;
+      return ffi::Error::Success();
+    }
+    XLA_FFI_DEFINE_HANDLER_SYMBOL(
+        ReluFwd, ReluFwdImpl,
+        ffi::Ffi::Bind()
+            .Arg<ffi::Buffer<ffi::F32>>()
+            .Ret<ffi::Buffer<ffi::F32>>());
+
+    static ffi::Error ReluBwdImpl(ffi::Buffer<ffi::F32> x,
+                                  ffi::Buffer<ffi::F32> dy,
+                                  ffi::ResultBuffer<ffi::F32> dx) {
+      const float* xd = x.typed_data();
+      const float* dyd = dy.typed_data();
+      float* dxd = dx->typed_data();
+      for (size_t i = 0; i < x.element_count(); ++i)
+        dxd[i] = xd[i] > 0.0f ? dyd[i] : 0.0f;
+      return ffi::Error::Success();
+    }
+    XLA_FFI_DEFINE_HANDLER_SYMBOL(
+        ReluBwd, ReluBwdImpl,
+        ffi::Ffi::Bind()
+            .Arg<ffi::Buffer<ffi::F32>>()
+            .Arg<ffi::Buffer<ffi::F32>>()
+            .Ret<ffi::Buffer<ffi::F32>>());
+
+    PD_TPU_OP_MANIFEST("custom_relu=ReluFwd,grad=ReluBwd");
+""")
+
+
+@pytest.fixture(scope="module")
+def relu_module(tmp_path_factory):
+    src_dir = tmp_path_factory.mktemp("custom_relu_src")
+    src = os.path.join(src_dir, "custom_relu_op.cc")
+    with open(src, "w") as f:
+        f.write(CUSTOM_RELU_CC)
+    return cpp_extension.load(
+        name="custom_relu_lib", sources=[src],
+        build_directory=str(tmp_path_factory.mktemp("custom_relu_build")),
+        verbose=True)
+
+
+class TestCppCustomOp:
+    def test_forward_matches_numpy(self, relu_module, rng):
+        x = rng.standard_normal((4, 7)).astype(np.float32)
+        out = relu_module.custom_relu(paddle.to_tensor(x))
+        np.testing.assert_allclose(out.numpy(), np.maximum(x, 0.0))
+
+    def test_backward_through_tape(self, relu_module, rng):
+        x = rng.standard_normal((3, 5)).astype(np.float32)
+        t = paddle.to_tensor(x, stop_gradient=False)
+        out = relu_module.custom_relu(t)
+        (out * out).sum().backward()
+        expect = np.where(x > 0, 2 * np.maximum(x, 0), 0.0)
+        np.testing.assert_allclose(t.grad.numpy(), expect, rtol=1e-6)
+
+    def test_under_jit(self, relu_module, rng):
+        import jax
+        import jax.numpy as jnp
+
+        x = rng.standard_normal((8,)).astype(np.float32)
+        op = cpp_extension.get_op("custom_relu")
+
+        # compose with surrounding traced code and grad inside one jit
+        def f(a):
+            return (op(paddle.Tensor(a)) ** 2).sum()._value
+
+        v, g = jax.jit(jax.value_and_grad(f))(jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(v), (np.maximum(x, 0) ** 2).sum(),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(g),
+                                   np.where(x > 0, 2 * x, 0.0), rtol=1e-6)
+
+    def test_rebuild_is_cached_and_collisions_refused(self, relu_module,
+                                                      tmp_path):
+        src = os.path.join(tmp_path, "same.cc")
+        with open(src, "w") as f:
+            f.write(CUSTOM_RELU_CC.replace("custom_relu=", "cache_relu="))
+        m1 = cpp_extension.load("cache_probe", [src],
+                                build_directory=str(tmp_path))
+        before = set(os.listdir(tmp_path))
+        # same library again: .so reused, re-registration of the same target
+        # tolerated
+        cpp_extension.load("cache_probe", [src], build_directory=str(tmp_path))
+        assert set(os.listdir(tmp_path)) == before
+        assert hasattr(m1, "cache_relu")
+        # a DIFFERENT library claiming an existing bare op name is refused
+        src2 = os.path.join(tmp_path, "clash.cc")
+        with open(src2, "w") as f:
+            f.write(CUSTOM_RELU_CC)  # exports op name custom_relu again
+        with pytest.raises(ValueError, match="already registered"):
+            cpp_extension.load("clash_lib", [src2],
+                               build_directory=str(tmp_path))
+
+    def test_missing_manifest_errors(self, tmp_path):
+        src = os.path.join(tmp_path, "bare.cc")
+        with open(src, "w") as f:
+            f.write("extern \"C\" int nothing() { return 0; }\n")
+        with pytest.raises(RuntimeError, match="paddle_tpu_op_manifest"):
+            cpp_extension.load("bare_lib", [src],
+                              build_directory=str(tmp_path))
+
+    def test_build_error_surfaces_compiler_output(self, tmp_path):
+        src = os.path.join(tmp_path, "broken.cc")
+        with open(src, "w") as f:
+            f.write("this is not C++\n")
+        with pytest.raises(RuntimeError, match="custom-op build failed"):
+            cpp_extension.load("broken_lib", [src],
+                              build_directory=str(tmp_path))
+
+
+class TestRegisterOpPython:
+    def test_custom_vjp_op(self, rng):
+        import jax.numpy as jnp
+
+        def fwd(x, y):
+            return x * y + x
+
+        def bwd(inputs, dy):
+            x, y = inputs
+            return dy * (y + 1), dy * x
+
+        op = cpp_extension.register_op("custom_muladd", fwd, bwd)
+        x = rng.standard_normal((4,)).astype(np.float32)
+        y = rng.standard_normal((4,)).astype(np.float32)
+        tx = paddle.to_tensor(x, stop_gradient=False)
+        ty = paddle.to_tensor(y, stop_gradient=False)
+        out = op(tx, ty)
+        np.testing.assert_allclose(out.numpy(), x * y + x, rtol=1e-6)
+        out.sum().backward()
+        np.testing.assert_allclose(tx.grad.numpy(), y + 1, rtol=1e-6)
+        np.testing.assert_allclose(ty.grad.numpy(), x, rtol=1e-6)
+        assert cpp_extension.get_op("custom_muladd") is op
+
+    def test_pallas_kernel_op(self, rng):
+        """An out-of-tree Pallas kernel as a custom op (interpret mode on
+        CPU; the exact path an external TPU kernel takes)."""
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def scale_kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * 2.0
+
+        def fwd(x):
+            return pl.pallas_call(
+                scale_kernel,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                interpret=True)(x)
+
+        import jax
+
+        def bwd(inputs, dy):
+            # pallas_call has no built-in autodiff: a kernel op ships its
+            # own VJP (here also a kernel)
+            def grad_kernel(dy_ref, o_ref):
+                o_ref[...] = dy_ref[...] * 2.0
+
+            return (pl.pallas_call(
+                grad_kernel,
+                out_shape=jax.ShapeDtypeStruct(dy.shape, dy.dtype),
+                interpret=True)(dy),)
+
+        op = cpp_extension.register_op("custom_scale2", fwd, bwd)
+        x = rng.standard_normal((8, 128)).astype(np.float32)
+        t = paddle.to_tensor(x, stop_gradient=False)
+        out = op(t)
+        np.testing.assert_allclose(out.numpy(), x * 2.0, rtol=1e-6)
+        out.sum().backward()
+        np.testing.assert_allclose(t.grad.numpy(), np.full_like(x, 2.0))
+
+    def test_unknown_op_raises(self):
+        with pytest.raises(KeyError, match="no custom op"):
+            cpp_extension.get_op("never_registered")
