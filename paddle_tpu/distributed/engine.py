@@ -79,7 +79,9 @@ class DistributedTrainStep(TrainStep):
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
                  hcg: HybridCommunicateGroup, sharding_stage: Optional[int] = None,
-                 batch_specs: Optional[Sequence[P]] = None, donate: bool = True):
+                 batch_specs: Optional[Sequence[P]] = None, donate: bool = True,
+                 offload: Optional[bool] = None,
+                 gradient_merge: Optional[int] = None):
         self.hcg = hcg
         self.mesh = hcg.mesh
         if sharding_stage is None:
@@ -87,8 +89,19 @@ class DistributedTrainStep(TrainStep):
             sharding_stage = getattr(optimizer, "_sharding_stage", None) or \
                 getattr(model, "_sharding_stage", None) or 0
         self.sharding_stage = sharding_stage
+        if offload is None:
+            offload = bool(getattr(optimizer, "_sharding_offload", False))
+        self.offload = offload and self._offload_supported()
+        if offload and not self.offload:
+            import logging
+
+            logging.getLogger("paddle_tpu.distributed").warning(
+                "offload=True requested but this backend (%s) cannot compile "
+                "host-memory placements; optimizer states stay in device "
+                "memory", jax.devices()[0].platform)
         self._batch_specs = batch_specs
-        super().__init__(model, loss_fn, optimizer, donate=donate)
+        super().__init__(model, loss_fn, optimizer, donate=donate,
+                         gradient_merge=gradient_merge)
         self._place_state()
         self._compiled = jax.jit(
             self._step,
@@ -111,6 +124,12 @@ class DistributedTrainStep(TrainStep):
                            self._buffer_shardings, None),
         )
 
+    @staticmethod
+    def _offload_supported() -> bool:
+        """Host-memory-kind placements compile on TPU; CPU-XLA has no
+        annotate_device_placement implementation (probed empirically)."""
+        return jax.devices()[0].platform == "tpu"
+
     # -- sharding rules ---------------------------------------------------
     def _param_spec(self, p: Tensor) -> P:
         spec = _current_spec(p._value, self.mesh)
@@ -132,7 +151,12 @@ class DistributedTrainStep(TrainStep):
             ps = NamedSharding(mesh, self._param_spec(p))
             p._value = jax.device_put(p._value, ps)
             self._param_shardings.append(ps)
-            ss = NamedSharding(mesh, self._state_spec(p))
+            # offload (reference `group_sharded_stage3.py:85` offload=True →
+            # CPU slices): optimizer states + master weights live in host
+            # memory; XLA streams them through the update
+            ss = NamedSharding(mesh, self._state_spec(p),
+                               memory_kind="pinned_host" if self.offload
+                               else None)
             st = self.optimizer._state_for(p)
             sharded_st = {}
             for k, v in st.items():
@@ -177,14 +201,35 @@ class DistributedTrainStep(TrainStep):
                 "replicated; stage=%d)", 100.0 * sharded / total,
                 total / 1e6, n_repl, self.sharding_stage)
 
+    def _default_batch_spec(self, batch_ndim: int) -> List:
+        """ONE home for the default batch layout: dim0 over data(+sharding),
+        dim1 over sep — shared by the whole-batch shardings and the
+        gradient-merge micro-batch constraint (shifted one dim right)."""
+        spec = [None] * batch_ndim
+        spec[0] = ("data", "sharding") if self.mesh.shape["sharding"] > 1 else "data"
+        if batch_ndim >= 2 and self.mesh.shape["sep"] > 1:
+            spec[1] = "sep"
+        return spec
+
     def _batch_sharding(self, arr) -> NamedSharding:
         if self._batch_specs is not None:
             raise RuntimeError  # handled in __call__
-        spec = [None] * arr.ndim
-        spec[0] = ("data", "sharding") if self.mesh.shape["sharding"] > 1 else "data"
-        if arr.ndim >= 2 and self.mesh.shape["sep"] > 1:
-            spec[1] = "sep"
-        return NamedSharding(self.mesh, P(*spec))
+        return NamedSharding(self.mesh, P(*self._default_batch_spec(arr.ndim)))
+
+    def _constrain_micro(self, arrays):
+        """After the gradient-merge [B] → [k, B/k] reshape, re-pin the batch
+        shardings one dim to the right (micro dim replicated) so GSPMD keeps
+        the micro-batches data-parallel instead of resharding per tick."""
+        out = []
+        for i, a in enumerate(arrays):
+            if self._batch_specs is not None:
+                spec = list(self._batch_specs[i])
+                spec += [None] * (a.ndim - 1 - len(spec))
+            else:
+                spec = self._default_batch_spec(a.ndim - 1)
+            out.append(jax.lax.with_sharding_constraint(
+                a, NamedSharding(self.mesh, P(None, *spec))))
+        return out
 
     def __call__(self, *batch) -> Tensor:
         batch_arrays = []

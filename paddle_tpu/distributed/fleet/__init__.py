@@ -95,8 +95,15 @@ class Fleet:
         grads are GLOBAL arrays (the reference's cross-group norm allreduce,
         `hybrid_parallel_optimizer.py:44`, is implicit in GSPMD)."""
         optimizer._hcg = self._hcg
-        optimizer._sharding_stage = (strategy or self._strategy).sharding_stage \
-            if (strategy or self._strategy) else 0
+        st = strategy or self._strategy
+        optimizer._sharding_stage = st.sharding_stage if st else 0
+        if st and st.gradient_merge:
+            # honored by TrainStep/DistributedTrainStep: k in-jit micro-steps
+            # accumulate grads before the single update (reference
+            # `passes/auto_parallel_gradient_merge.py`)
+            cfg = st.gradient_merge_configs or {}
+            optimizer._gradient_merge_k = int(cfg.get("k_steps", 2))
+            optimizer._gradient_merge_avg = bool(cfg.get("avg", True))
         return optimizer
 
     def worker_index(self) -> int:

@@ -25,11 +25,13 @@ def group_sharded_parallel(model: Layer, optimizer, level: str, scaler=None,
                            exclude_layer=None) -> Tuple:
     if level not in _LEVELS:
         raise ValueError(f"level must be one of {sorted(_LEVELS)}, got {level!r}")
-    if offload:
-        raise NotImplementedError("offload=True: host-offloaded states planned; on TPU "
-                                  "prefer stage-3 sharding (HBM) first")
     optimizer._sharding_stage = _LEVELS[level]
     model._sharding_stage = _LEVELS[level]
+    # offload (reference `group_sharded_stage3.py:85`): optimizer-state /
+    # master-weight slices live in host memory — consumed by
+    # DistributedTrainStep as pinned_host memory-kind shardings (TPU; other
+    # backends degrade to device memory with a warning at engine build)
+    optimizer._sharding_offload = bool(offload)
     if scaler is not None:
         return model, optimizer, scaler
     return model, optimizer, None

@@ -237,10 +237,18 @@ class TrainStep:
         loss = step(x, y)   # Tensor; model/optimizer state updated in place
     """
 
-    def __init__(self, model: Layer, loss_fn: Callable, optimizer, donate: bool = True):
+    def __init__(self, model: Layer, loss_fn: Callable, optimizer, donate: bool = True,
+                 gradient_merge: Optional[int] = None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
+        # gradient merge (reference `auto_parallel_gradient_merge.py`): run k
+        # micro-steps accumulating grads IN-JIT, update once; k defaults from
+        # the fleet strategy tag stamped by distributed_optimizer
+        if gradient_merge is None:
+            gradient_merge = getattr(optimizer, "_gradient_merge_k", 1)
+        self._merge_k = max(1, int(gradient_merge or 1))
+        self._merge_avg = bool(getattr(optimizer, "_gradient_merge_avg", True))
         self._param_names = [n for n, _ in model.named_parameters()]
         self._params = [p for _, p in model.named_parameters()]
         self._trainable = [not p.stop_gradient for p in self._params]
@@ -283,21 +291,59 @@ class TrainStep:
             return [jnp.clip(g, clip.min, clip.max) for g in grads]
         raise NotImplementedError(f"clip {type(clip)} in TrainStep")
 
+    def _constrain_micro(self, arrays):
+        """Hook: re-pin shardings after the [B] → [k, B/k] micro-batch
+        reshape (DistributedTrainStep overrides to keep the batch axes on
+        the data mesh dims)."""
+        return arrays
+
     def _step(self, param_arrays, opt_states, buffer_arrays, key, lr, batch_arrays,
               check_numerics: bool = False):
+        if getattr(self, "offload", False):
+            # offloaded states arrive in host memory; TPU arithmetic cannot
+            # mix memory spaces, so stream them to device here — the update's
+            # out_shardings (pinned_host) stream the new states back
+            opt_states = [
+                {k: (jax.device_put(v, jax.memory.Space.Device)
+                     if hasattr(v, "ndim") else v) for k, v in st.items()}
+                for st in opt_states]
         masters = [st.pop("@master", None) for st in opt_states]
         compute_params = [m if m is not None else p
                           for m, p in zip(masters, param_arrays)]
 
-        def loss_of(p_arr):
+        def loss_of(p_arr, bufs, batch_mb, key_):
             run_p = [p.astype(orig.dtype) for p, orig in zip(p_arr, param_arrays)]
             with _StateSwap(self._params, run_p), \
-                    _StateSwap(self._buffers, buffer_arrays), key_scope(key), no_grad():
-                loss_t = self.loss_fn(self.model, *[Tensor(a) for a in batch_arrays])
+                    _StateSwap(self._buffers, bufs), key_scope(key_), no_grad():
+                loss_t = self.loss_fn(self.model, *[Tensor(a) for a in batch_mb])
                 new_buf = [b._value for b in self._buffers]
             return loss_t._value.astype(jnp.float32), new_buf
 
-        (loss, new_buf), grads = jax.value_and_grad(loss_of, has_aux=True)(compute_params)
+        k = self._merge_k
+        if k == 1:
+            (loss, new_buf), grads = jax.value_and_grad(loss_of, has_aux=True)(
+                compute_params, buffer_arrays, batch_arrays, key)
+        else:
+            micro = tuple(self._constrain_micro(
+                [a.reshape((k, a.shape[0] // k) + a.shape[1:])
+                 for a in batch_arrays]))
+            keys = jax.random.split(key, k)
+            zeros = [jnp.zeros_like(p) for p in compute_params]
+
+            def body(carry, xs):
+                acc, bufs, loss_sum = carry
+                mb, key_i = xs
+                (loss_i, nb), g = jax.value_and_grad(loss_of, has_aux=True)(
+                    compute_params, bufs, list(mb), key_i)
+                acc = [a + gi.astype(a.dtype) for a, gi in zip(acc, g)]
+                return (acc, nb, loss_sum + loss_i), None
+
+            (grads, new_buf, loss_sum), _ = jax.lax.scan(
+                body, (zeros, list(buffer_arrays), jnp.zeros((), jnp.float32)),
+                (micro, keys))
+            loss = loss_sum / k
+            if self._merge_avg:
+                grads = [g / k for g in grads]
         finite = None
         if check_numerics:
             finite = jnp.stack([jnp.isfinite(loss)] +
@@ -340,6 +386,12 @@ class TrainStep:
         param_arrays = [p._value for p in self._params]
         buffer_arrays = [b._value for b in self._buffers]
         batch_arrays = [b._value if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
+        if self._merge_k > 1:
+            for a in batch_arrays:
+                if a.ndim == 0 or a.shape[0] % self._merge_k:
+                    raise ValueError(
+                        f"gradient_merge k={self._merge_k} needs every batch "
+                        f"arg's dim0 divisible by k, got shape {a.shape}")
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         if get_flags("check_nan_inf")["check_nan_inf"]:
             loss, new_params, new_states, new_buf, finite = self._compiled_checked(

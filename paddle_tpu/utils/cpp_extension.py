@@ -54,6 +54,12 @@ def _compile(name: str, sources: Sequence[str], extra_cxx_flags, build_dir,
         with open(s, "rb") as f:
             tag.update(f.read())
     tag.update(" ".join(extra_cxx_flags or []).encode())
+    # the ABI the .so was built against must be part of the cache key, or a
+    # jaxlib/paddle_tpu upgrade would keep serving stale binaries from the
+    # shared tempdir cache
+    tag.update(jax.__version__.encode())
+    with open(os.path.join(_INCLUDE, "paddle_tpu", "extension.h"), "rb") as f:
+        tag.update(f.read())
     so_path = os.path.join(build_dir, f"{name}_{tag.hexdigest()[:12]}.so")
     if os.path.exists(so_path):
         return so_path
@@ -121,7 +127,13 @@ def load(name: str, sources: Sequence[str],
     so_path = _compile(name, sources, extra_cxx_flags, build_dir, verbose)
     lib = ctypes.CDLL(so_path)
     mod = _OpModule(name)
-    for entry in _parse_manifest(lib):
+    entries = _parse_manifest(lib)
+    # validate the WHOLE manifest against the registry before registering
+    # anything, so a mid-manifest collision can't leave the library half
+    # loaded
+    for entry in entries:
+        _check_collision(entry["op"], f"{name}.{entry['op']}")
+    for entry in entries:
         target = f"{name}.{entry['op']}"
         jax.ffi.register_ffi_target(
             target, jax.ffi.pycapsule(getattr(lib, entry["fwd"])),
@@ -138,15 +150,23 @@ def load(name: str, sources: Sequence[str],
     return mod
 
 
-def _publish(op_name: str, fn: Callable, target: Optional[str] = None) -> None:
-    """Publish under the bare op name, refusing silent cross-library
-    replacement (FFI targets are library-namespaced; this registry is not)."""
+def _check_collision(op_name: str, target: Optional[str]) -> None:
+    """Refuse silent replacement: only re-registering the SAME FFI target
+    (a reload of the same library) may overwrite an existing entry; two
+    python-path ops (target None) under one name always collide."""
     existing = custom_ops.get(op_name)
-    if existing is not None and getattr(existing, "_ffi_target", None) != target:
-        raise ValueError(
-            f"custom op '{op_name}' is already registered "
-            f"(target {getattr(existing, '_ffi_target', None)!r}); refusing to "
-            f"replace it with {target!r} — rename one of the ops")
+    if existing is None:
+        return
+    if target is not None and getattr(existing, "_ffi_target", None) == target:
+        return
+    raise ValueError(
+        f"custom op '{op_name}' is already registered "
+        f"(target {getattr(existing, '_ffi_target', None)!r}); refusing to "
+        f"replace it with {target!r} — rename one of the ops")
+
+
+def _publish(op_name: str, fn: Callable, target: Optional[str] = None) -> None:
+    _check_collision(op_name, target)
     fn._ffi_target = target
     custom_ops[op_name] = fn
 

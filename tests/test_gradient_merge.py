@@ -1,0 +1,170 @@
+"""gradient_merge + stage-3 offload (round-2 verdict #6).
+
+Parity targets: `passes/auto_parallel_gradient_merge.py` (k accumulation
+steps == one big-batch step) and `group_sharded_stage3.py:85` (offload=True
+moves optimizer-state slices off-device)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _mlp(seed=0):
+    paddle.seed(seed)
+    m = nn.Sequential(nn.Linear(16, 32), nn.Tanh(), nn.Linear(32, 8))
+    return m
+
+
+def _batch(rng, n=8):
+    return (rng.standard_normal((n, 16)).astype(np.float32),
+            rng.standard_normal((n, 8)).astype(np.float32))
+
+
+@pytest.fixture
+def _restore_hcg():
+    """Fleet.init publishes a global HybridCommunicateGroup; restore it so
+    these tests don't leak mesh state into unrelated files."""
+    from paddle_tpu.distributed import topology
+
+    saved = topology.get_hybrid_communicate_group()
+    yield
+    topology._hcg = saved
+
+
+class TestTrainStepGradientMerge:
+    def test_merged_k_matches_big_batch(self):
+        rng = np.random.default_rng(0)
+        x, y = _batch(rng, 8)
+
+        m1 = _mlp()
+        o1 = paddle.optimizer.AdamW(1e-2, parameters=m1.parameters())
+        s1 = paddle.jit.TrainStep(m1, lambda m, a, b: F.mse_loss(m(a), b), o1)
+
+        m2 = _mlp()
+        o2 = paddle.optimizer.AdamW(1e-2, parameters=m2.parameters())
+        s2 = paddle.jit.TrainStep(m2, lambda m, a, b: F.mse_loss(m(a), b), o2,
+                                  gradient_merge=4)
+
+        l1 = s1(paddle.to_tensor(x), paddle.to_tensor(y))
+        l2 = s2(paddle.to_tensor(x), paddle.to_tensor(y))
+        # mean-reduction loss: avg of 4 micro-grads == big-batch grad
+        np.testing.assert_allclose(float(l1.numpy()), float(l2.numpy()),
+                                   rtol=1e-5)
+        for (n, p1), (_, p2) in zip(m1.named_parameters(),
+                                    m2.named_parameters()):
+            np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=1e-4,
+                                       atol=1e-6, err_msg=n)
+
+    def test_training_converges_under_merge(self):
+        rng = np.random.default_rng(1)
+        x, y = _batch(rng, 8)
+        m = _mlp(3)
+        o = paddle.optimizer.SGD(0.1, parameters=m.parameters())
+        s = paddle.jit.TrainStep(m, lambda mm, a, b: F.mse_loss(mm(a), b), o,
+                                 gradient_merge=2)
+        losses = [float(s(paddle.to_tensor(x), paddle.to_tensor(y)).numpy())
+                  for _ in range(6)]
+        assert losses[-1] < losses[0]
+
+    def test_indivisible_batch_rejected(self):
+        m = _mlp()
+        o = paddle.optimizer.SGD(0.1, parameters=m.parameters())
+        s = paddle.jit.TrainStep(m, lambda mm, a, b: F.mse_loss(mm(a), b), o,
+                                 gradient_merge=3)
+        rng = np.random.default_rng(2)
+        x, y = _batch(rng, 8)
+        with pytest.raises(ValueError, match="divisible by k"):
+            s(paddle.to_tensor(x), paddle.to_tensor(y))
+
+    def test_fleet_strategy_tags_optimizer(self, _restore_hcg):
+        import paddle_tpu.distributed.fleet as fleet_mod
+
+        strategy = fleet_mod.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 8}
+        strategy.gradient_merge = True
+        strategy.gradient_merge_configs = {"k_steps": 4, "avg": True}
+        f = fleet_mod.Fleet()
+        f.init(is_collective=True, strategy=strategy)
+        o = paddle.optimizer.SGD(0.1, parameters=_mlp().parameters())
+        o = f.distributed_optimizer(o)
+        assert o._gradient_merge_k == 4 and o._gradient_merge_avg is True
+
+
+class TestDistributedMergeAndOffload:
+    @pytest.fixture
+    def hcg(self, _restore_hcg):
+        from paddle_tpu.distributed.fleet import DistributedStrategy, Fleet
+
+        strategy = DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                                   "pp_degree": 1, "sharding_degree": 4}
+        f = Fleet()
+        f.init(is_collective=True, strategy=strategy)
+        return f._hcg
+
+    def test_distributed_merge_matches_unmerged(self, hcg):
+        from paddle_tpu.distributed import DistributedTrainStep
+
+        rng = np.random.default_rng(3)
+        x, y = _batch(rng, 16)
+
+        m1 = _mlp(5)
+        o1 = paddle.optimizer.AdamW(1e-2, parameters=m1.parameters())
+        s1 = DistributedTrainStep(m1, lambda m, a, b: F.mse_loss(m(a), b), o1,
+                                  hcg, sharding_stage=1)
+        m2 = _mlp(5)
+        o2 = paddle.optimizer.AdamW(1e-2, parameters=m2.parameters())
+        s2 = DistributedTrainStep(m2, lambda m, a, b: F.mse_loss(m(a), b), o2,
+                                  hcg, sharding_stage=1, gradient_merge=2)
+        l1 = s1(paddle.to_tensor(x), paddle.to_tensor(y))
+        l2 = s2(paddle.to_tensor(x), paddle.to_tensor(y))
+        np.testing.assert_allclose(float(l1.numpy()), float(l2.numpy()),
+                                   rtol=1e-5)
+        for (n, p1), (_, p2) in zip(m1.named_parameters(),
+                                    m2.named_parameters()):
+            np.testing.assert_allclose(np.asarray(jax.device_get(p1._value)),
+                                       np.asarray(jax.device_get(p2._value)),
+                                       rtol=1e-4, atol=1e-6, err_msg=n)
+
+    def test_offload_request_degrades_on_cpu_and_trains(self, hcg, caplog):
+        """CPU-XLA cannot compile host placements: the request must degrade
+        with a warning, keep stage-3 semantics, and still train."""
+        import logging
+
+        from paddle_tpu.distributed import DistributedTrainStep, \
+            group_sharded_parallel
+
+        m = _mlp(7)
+        o = paddle.optimizer.AdamW(1e-2, parameters=m.parameters())
+        m, o, _ = group_sharded_parallel(m, o, "p_g_os", offload=True)
+        assert o._sharding_offload is True
+        with caplog.at_level(logging.WARNING, "paddle_tpu.distributed"):
+            step = DistributedTrainStep(m, lambda mm, a, b: F.mse_loss(mm(a), b),
+                                        o, hcg)
+        assert step.sharding_stage == 3 and step.offload is False
+        assert any("offload=True requested" in r.message for r in caplog.records)
+        rng = np.random.default_rng(9)
+        x, y = _batch(rng, 16)
+        losses = [float(step(paddle.to_tensor(x), paddle.to_tensor(y)).numpy())
+                  for _ in range(4)]
+        assert losses[-1] < losses[0]
+
+    def test_offload_shardings_request_pinned_host_when_supported(self, hcg,
+                                                                  monkeypatch):
+        """Force the support probe on: the state shardings must carry the
+        pinned_host memory kind (the actual TPU offload layout). Placement
+        fails at device_put on CPU only for the compile step, so probe the
+        sharding objects directly."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from paddle_tpu.distributed.engine import DistributedTrainStep
+
+        sh = NamedSharding(hcg.mesh, P("sharding"), memory_kind="pinned_host")
+        assert sh.memory_kind == "pinned_host"  # constructible on this backend
+        # device_put to pinned_host works on CPU too (only jit compiles fail)
+        arr = jax.device_put(np.zeros(8, np.float32), sh)
+        assert arr.sharding.memory_kind == "pinned_host"
