@@ -50,6 +50,7 @@ from . import geometric  # noqa: E402
 from . import signal  # noqa: E402
 from . import sparse  # noqa: E402
 from . import static  # noqa: E402
+from . import text  # noqa: E402
 from . import utils  # noqa: E402
 from .framework.io import load, save  # noqa: E402
 

@@ -27,7 +27,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 __all__ = ["ElasticManager", "ElasticStatus", "ElasticLevel", "FileStore",
-           "ELASTIC_EXIT_CODE"]
+           "ELASTIC_EXIT_CODE", "PreemptionGuard"]
 
 ELASTIC_EXIT_CODE = 101
 
@@ -206,3 +206,6 @@ class ElasticManager:
         self.store.delete(self._key)
         if self.post_hook:
             self.post_hook(completed)
+
+
+from .preemption import PreemptionGuard  # noqa: E402

@@ -39,8 +39,9 @@ def _parse(argv):
                    default=int(os.environ.get("PADDLE_NNODES", "1")),
                    help="number of hosts in the job")
     p.add_argument("--node_rank", type=int,
-                   default=int(os.environ.get("PADDLE_NODE_RANK", "0")),
-                   help="rank of this host")
+                   default=int(os.environ.get("PADDLE_NODE_RANK", "-1")),
+                   help="rank of this host (-1: assigned by the master "
+                        "rendezvous when nnodes > 1, else 0)")
     p.add_argument("--nproc_per_node", type=int,
                    default=int(os.environ.get("PADDLE_NPROC_PER_NODE", "1")),
                    help="worker processes on this host (1 = own all chips)")
@@ -61,17 +62,31 @@ def launch(argv=None) -> int:
     nproc = args.nproc_per_node
     world = args.nnodes * nproc
     master = args.master
+    node_rank = args.node_rank
+    store = None
     if master is None:
         if args.nnodes > 1:
             raise SystemExit("--master host:port is required when nnodes > 1")
         master = f"127.0.0.1:{_free_port()}"
+    if args.nnodes > 1:
+        # multi-node: rendezvous through the TCP store served from the
+        # master host (reference `controllers/master.py:73` HTTPMaster) —
+        # assigns node ranks, publishes hostnames, and barriers all pods
+        # before any worker spawns
+        from ..store import rendezvous
+
+        store, node_rank = rendezvous(
+            master, args.nnodes, job_id=args.job_id,
+            node_rank=None if node_rank < 0 else node_rank)
+    elif node_rank < 0:
+        node_rank = 0
     os.makedirs(args.log_dir, exist_ok=True)
 
     procs: List[subprocess.Popen] = []
     logs = []
     try:
         for local in range(nproc):
-            rank = args.node_rank * nproc + local
+            rank = node_rank * nproc + local
             env = os.environ.copy()
             env.update({
                 "PADDLE_TRAINER_ID": str(rank),
@@ -80,6 +95,8 @@ def launch(argv=None) -> int:
                 "PADDLE_LOCAL_RANK": str(local),
                 "PADDLE_RANK_IN_NODE": str(local),
                 "PADDLE_JOB_ID": args.job_id,
+                "PADDLE_NNODES": str(args.nnodes),
+                "PADDLE_NODE_RANK": str(node_rank),
                 # multi-process-per-host (CPU fake cluster): keep each worker
                 # to its own slice of host devices
                 "PADDLE_NPROC_PER_NODE": str(nproc),
@@ -127,6 +144,8 @@ def launch(argv=None) -> int:
     finally:
         for f in logs:
             f.close()
+        if store is not None:
+            store.close()
     return rc
 
 

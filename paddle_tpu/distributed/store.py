@@ -413,6 +413,14 @@ def rendezvous(master: str, nnodes: int, job_id: str = "default",
                          timeout=timeout)
     if node_rank is None or node_rank < 0:
         node_rank = store.add(f"{job_id}/nnodes_joined", 1) - 1
+    # every rank — explicit or auto — claims its slot exactly once, so a
+    # mix of preset PADDLE_NODE_RANK pods and auto-assigned pods fails fast
+    # on duplicates instead of running with a corrupt world mapping
+    claims = store.add(f"{job_id}/rank_claim/{node_rank}", 1)
+    if claims != 1:
+        raise RuntimeError(
+            f"rendezvous: node rank {node_rank} claimed by {claims} pods — "
+            f"set node_rank on every pod or on none")
     store.set(f"{job_id}/node/{node_rank}", socket.gethostname())
     store.barrier(f"{job_id}/rdzv", nnodes, timeout)
     return store, node_rank

@@ -7,17 +7,23 @@ with multi-threaded prefetch. TPU-first notes:
   per-host data-parallel input convention — each host loads only its shard
   and `jax.make_array_from_process_local_data`-style feeding assembles the
   global batch (reference: `io/dataloader/batch_sampler.py` DistributedBatchSampler).
-- Workers are threads, not forked processes: batches are numpy, produced by
-  user code that typically releases the GIL (decode/IO); device transfer is
-  the training loop's whole-step jit. (The reference's shared-memory worker
-  pool exists to feed GPUs from Python pickling — unnecessary here.)
+- ``num_workers > 0`` uses worker PROCESSES (reference
+  `io/dataloader/dataloader_iter.py:358` _DataLoaderIterMultiProcess):
+  workers run dataset indexing + collate and ship NUMPY trees back —
+  optionally through POSIX shared memory (``use_shared_memory``) for big
+  batches — and the parent re-wraps arrays as Tensors. Python-heavy
+  transforms therefore scale past the GIL. Threaded mode remains as the
+  fallback for unpicklable datasets under a spawn context (fork needs no
+  pickling) and is the right choice for GIL-releasing IO/decode loads.
 """
 
 from __future__ import annotations
 
 import itertools
+import multiprocessing
 import queue
 import threading
+import traceback as _traceback
 from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence, Union
 
 import numpy as np
@@ -297,6 +303,106 @@ def get_worker_info():
     return getattr(_worker_info, "info", None)
 
 
+# -- process-worker transport ------------------------------------------------
+
+_SHM_MIN_BYTES = 1 << 16  # smaller arrays go through the pipe directly
+
+
+class _ShmArray:
+    """Descriptor of an ndarray parked in POSIX shared memory (the
+    reference's shared-mem LoDTensor transport, `dataloader_iter.py:150`)."""
+
+    __slots__ = ("name", "shape", "dtype")
+
+    def __init__(self, name, shape, dtype):
+        self.name, self.shape, self.dtype = name, shape, str(dtype)
+
+
+class _WorkerError:
+    def __init__(self, exc):
+        self.formatted = "".join(_traceback.format_exception(exc))
+        self.type_name = type(exc).__name__
+
+
+def _to_transport(obj, use_shm: bool):
+    """Worker→parent encoding: Tensors/ndarrays become ndarrays (big ones
+    parked in shared memory); containers recurse."""
+    from multiprocessing import shared_memory
+
+    if isinstance(obj, Tensor):
+        obj = np.asarray(obj._value)
+    if isinstance(obj, np.ndarray):
+        if use_shm and obj.nbytes >= _SHM_MIN_BYTES:
+            shm = shared_memory.SharedMemory(create=True, size=obj.nbytes)
+            view = np.ndarray(obj.shape, obj.dtype, buffer=shm.buf)
+            np.copyto(view, obj)
+            desc = _ShmArray(shm.name, obj.shape, obj.dtype)
+            shm.close()
+            return desc
+        return obj
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_transport(o, use_shm) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _to_transport(v, use_shm) for k, v in obj.items()}
+    return obj
+
+
+def _release_transport(obj) -> None:
+    """Unlink shared-memory segments of a transport payload that will never
+    be consumed (early iterator close, worker error)."""
+    from multiprocessing import shared_memory
+
+    if isinstance(obj, _ShmArray):
+        try:
+            shm = shared_memory.SharedMemory(name=obj.name)
+            shm.close()
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+        return
+    if isinstance(obj, (list, tuple)):
+        for o in obj:
+            _release_transport(o)
+    elif isinstance(obj, dict):
+        for v in obj.values():
+            _release_transport(v)
+
+
+def _from_transport(obj):
+    """Parent-side decoding: ndarrays (incl. shared-memory ones) → Tensor."""
+    from multiprocessing import shared_memory
+
+    if isinstance(obj, _ShmArray):
+        shm = shared_memory.SharedMemory(name=obj.name)
+        try:
+            arr = np.array(np.ndarray(obj.shape, obj.dtype, buffer=shm.buf))
+        finally:
+            shm.close()
+            shm.unlink()
+        return Tensor(arr)
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_transport(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _from_transport(v) for k, v in obj.items()}
+    return obj
+
+
+def _mp_worker_main(result_q, worker_id, num_workers, dataset, collate,
+                    my_batches, init_fn, use_shm):
+    """Worker process body: NUMPY work only — jax stays in the parent."""
+    _worker_info.info = _WorkerInfo(worker_id, num_workers, dataset)
+    if init_fn is not None:
+        init_fn(worker_id)
+    try:
+        for seq, batch_idx in my_batches:
+            data = collate([dataset[i] for i in batch_idx])
+            result_q.put((seq, _to_transport(data, use_shm)))
+    except BaseException as e:  # noqa: BLE001 — ship it to the parent
+        result_q.put((-1, _WorkerError(e)))
+
+
 def default_collate_fn(batch: List[Any]):
     sample = batch[0]
     if isinstance(sample, Tensor):
@@ -322,6 +428,24 @@ def _stack_np(arrays):
     return out if out is not None else np.stack(arrays)
 
 
+def _np_collate(batch: List[Any]):
+    """default_collate_fn's numpy twin for worker processes: identical
+    structure, but NO jax arrays are created off the main process."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return _stack_np([np.asarray(b._value) for b in batch])
+    if isinstance(sample, np.ndarray):
+        return _stack_np(batch)
+    if isinstance(sample, (int, float, np.number)):
+        return np.asarray(batch)
+    if isinstance(sample, (list, tuple)):
+        transposed = zip(*batch)
+        return type(sample)(_np_collate(list(items)) for items in transposed)
+    if isinstance(sample, dict):
+        return {k: _np_collate([b[k] for b in batch]) for k in sample}
+    return batch
+
+
 class DataLoader:
     """reference: `io/dataloader/dataloader_iter.py` — here a thread-pool
     prefetcher with an ordered output queue."""
@@ -330,12 +454,16 @@ class DataLoader:
                  batch_sampler=None, batch_size: int = 1, shuffle: bool = False,
                  drop_last: bool = False, collate_fn=None, num_workers: int = 0,
                  use_buffer_reader: bool = True, prefetch_factor: int = 2,
-                 use_shared_memory: bool = False, timeout: int = 0, worker_init_fn=None,
-                 persistent_workers: bool = False):
+                 use_shared_memory: bool = True, timeout: int = 0, worker_init_fn=None,
+                 persistent_workers: bool = False, use_process_workers: bool = True):
         self.dataset = dataset
         self.num_workers = num_workers
         self.collate_fn = collate_fn or default_collate_fn
         self.prefetch_factor = prefetch_factor
+        self.use_shared_memory = use_shared_memory
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.use_process_workers = use_process_workers
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -357,6 +485,18 @@ class DataLoader:
             return self._iter_iterable()
         if self.num_workers == 0:
             return self._iter_sync()
+        if self.use_process_workers:
+            import pickle
+
+            try:
+                return self._iter_processes()
+            except (ImportError, OSError, ValueError, AttributeError,
+                    TypeError, pickle.PicklingError) as e:
+                import logging
+
+                logging.getLogger("paddle_tpu.io").warning(
+                    "process workers unavailable (%s); falling back to "
+                    "threads", e)
         return self._iter_threaded()
 
     def _iter_sync(self):
@@ -373,6 +513,91 @@ class DataLoader:
         if batch and not self.drop_last:
             yield self.collate_fn(batch)
 
+    def _iter_processes(self):
+        """Worker processes + shared-memory ndarray transport (reference
+        `dataloader_iter.py:358`). Workers execute dataset[i] + collate as
+        NUMPY work; the parent re-wraps arrays as Tensors. fork context when
+        available (no pickling of the dataset), spawn otherwise."""
+        indices = list(self.batch_sampler)
+        if not indices:
+            return iter(())
+        nw = min(self.num_workers, len(indices))
+        try:
+            ctx = multiprocessing.get_context("fork")
+        except ValueError:
+            ctx = multiprocessing.get_context("spawn")
+        collate = _np_collate if self.collate_fn is default_collate_fn \
+            else self.collate_fn
+        result_q = ctx.Queue(maxsize=max(2, nw * self.prefetch_factor))
+        procs = []
+        # spawn eagerly so start/pickling failures surface in __iter__ (where
+        # the threaded fallback catches them), not at first next(); a partial
+        # spawn must not leave earlier workers computing into an abandoned
+        # queue
+        try:
+            for w in range(nw):
+                my = [(i, b) for i, b in enumerate(indices) if i % nw == w]
+                p = ctx.Process(
+                    target=_mp_worker_main,
+                    args=(result_q, w, nw, self.dataset, collate, my,
+                          self.worker_init_fn, self.use_shared_memory),
+                    daemon=True)
+                p.start()
+                procs.append(p)
+        except BaseException:
+            for p in procs:
+                p.terminate()
+            raise
+        return self._consume_process_results(procs, result_q, len(indices))
+
+    def _consume_process_results(self, procs, result_q, total):
+        try:
+            buffered = {}
+            next_seq = 0
+            deadline_step = self.timeout or 5.0
+            while next_seq < total:
+                while next_seq in buffered:
+                    yield _from_transport(buffered.pop(next_seq))
+                    next_seq += 1
+                if next_seq >= total:
+                    break
+                try:
+                    seq, data = result_q.get(timeout=deadline_step)
+                except queue.Empty:
+                    if self.timeout:
+                        raise RuntimeError(
+                            f"DataLoader worker timed out after "
+                            f"{self.timeout}s (batch {next_seq})")
+                    if not any(p.is_alive() for p in procs) and \
+                            result_q.empty():
+                        raise RuntimeError(
+                            "DataLoader worker processes died without "
+                            "delivering all batches (check workerlog / "
+                            "OOM killer)")
+                    continue
+                if isinstance(data, _WorkerError):
+                    raise RuntimeError(
+                        f"DataLoader worker raised {data.type_name}:\n"
+                        f"{data.formatted}")
+                buffered[seq] = data
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            for p in procs:
+                p.join(timeout=2.0)
+            # early exit / worker error: unlink any shared-memory segments
+            # still parked in unconsumed batches, or /dev/shm leaks one
+            # segment per abandoned batch for the life of the process
+            for payload in buffered.values():
+                _release_transport(payload)
+            while True:
+                try:
+                    _, payload = result_q.get_nowait()
+                except (queue.Empty, OSError, ValueError):
+                    break
+                _release_transport(payload)
+
     def _iter_threaded(self):
         indices = list(self.batch_sampler)
         results: "queue.Queue" = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
@@ -380,6 +605,8 @@ class DataLoader:
 
         def worker(worker_id, my_batches):
             _worker_info.info = _WorkerInfo(worker_id, self.num_workers, self.dataset)
+            if self.worker_init_fn is not None:
+                self.worker_init_fn(worker_id)
             for seq, batch_idx in my_batches:
                 try:
                     data = self.collate_fn([self.dataset[i] for i in batch_idx])

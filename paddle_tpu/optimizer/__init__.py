@@ -160,7 +160,14 @@ class Optimizer:
         out: Dict[str, Any] = {}
         for i, p in enumerate(self._parameter_list):
             key = p.name or f"param_{i}"
+            # expose default slots for never-stepped params — a FRESH
+            # optimizer's state_dict must contain every slot so checkpoint
+            # load (which fills keys present in the target) can restore a
+            # mid-training state — WITHOUT caching them (a getter must not
+            # permanently allocate accumulator memory)
             st = self._accumulators.get(id(p))
+            if st is None and not p.stop_gradient:
+                st = self._init_state(p)
             if st:
                 for slot, v in st.items():
                     out[f"{key}.{slot}"] = Tensor(v) if not isinstance(v, int) else v

@@ -1,0 +1,12 @@
+"""paddle.text parity surface (reference `python/paddle/text/__init__.py:1`).
+
+Datasets parse the reference's archive formats from local files (zero-egress
+build: no downloader); ViterbiDecoder/viterbi_decode run as jit-friendly
+scans."""
+
+from .datasets import (WMT14, WMT16, Conll05st, Imdb, Imikolov, Movielens,
+                       UCIHousing)
+from .viterbi_decode import ViterbiDecoder, viterbi_decode
+
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16", "ViterbiDecoder", "viterbi_decode"]

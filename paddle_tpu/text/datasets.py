@@ -1,0 +1,523 @@
+"""paddle.text datasets (reference `python/paddle/text/datasets/`).
+
+All seven datasets parse the SAME archive formats as the reference
+(`uci_housing.py:96`, `imdb.py:85`, `imikolov.py:85`, `movielens.py:160`,
+`wmt14.py:90`, `wmt16.py:110`, `conll05.py:160`) from a LOCAL ``data_file``.
+This build runs with zero egress, so there is no downloader: pass the path
+to the already-fetched archive (the same file the reference would cache
+under ``~/.cache/paddle/dataset``); ``data_file=None`` raises with that
+instruction instead of downloading."""
+
+from __future__ import annotations
+
+import collections
+import re
+import string
+import tarfile
+import zipfile
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16"]
+
+
+def _require(data_file: Optional[str], name: str) -> str:
+    if data_file is None:
+        raise ValueError(
+            f"{name}: data_file is required — this build performs no "
+            f"network downloads; fetch the reference archive once and pass "
+            f"its local path")
+    return data_file
+
+
+class UCIHousing(Dataset):
+    """506×14 whitespace floats; first 13 features normalized by
+    (x − mean) / (max − min); 80/20 train/test split (reference
+    `uci_housing.py:117`)."""
+
+    def __init__(self, data_file=None, mode="train", download=False):
+        assert mode.lower() in ("train", "test"), mode
+        self.mode = mode.lower()
+        self.data_file = _require(data_file, "UCIHousing")
+        self._load_data()
+
+    def _load_data(self, feature_num: int = 14, ratio: float = 0.8):
+        data = np.fromfile(self.data_file, sep=" ")
+        data = data.reshape(data.shape[0] // feature_num, feature_num)
+        maximums, minimums = data.max(axis=0), data.min(axis=0)
+        avgs = data.sum(axis=0) / data.shape[0]
+        for i in range(feature_num - 1):
+            data[:, i] = (data[:, i] - avgs[i]) / (maximums[i] - minimums[i])
+        offset = int(data.shape[0] * ratio)
+        self.data = data[:offset] if self.mode == "train" else data[offset:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1].astype("float32"), row[-1:].astype("float32")
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    """aclImdb tar: tokenized lowercase docs (punctuation stripped), word
+    dict built over BOTH splits with ``freq > cutoff``, labels pos=0 / neg=1
+    (reference `imdb.py:85-162`)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff: int = 150,
+                 download=False):
+        assert mode.lower() in ("train", "test"), mode
+        self.mode = mode.lower()
+        self.data_file = _require(data_file, "Imdb")
+        self.word_idx = self._build_word_dict(cutoff)
+        self._load_anno()
+
+    _PATTERN = re.compile(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
+
+    def _tokenize_all(self) -> Dict[tuple, List[List[bytes]]]:
+        """ONE decompression pass bucketing docs by (split, kind) — the
+        real ~80MB gzip tar is far too slow to scan three times."""
+        if getattr(self, "_buckets", None) is not None:
+            return self._buckets
+        buckets: Dict[tuple, List[List[bytes]]] = collections.defaultdict(list)
+        with tarfile.open(self.data_file) as tf:
+            member = tf.next()
+            while member is not None:
+                m = self._PATTERN.match(member.name)
+                if m:
+                    raw = tf.extractfile(member).read().rstrip(b"\n\r")
+                    raw = raw.translate(
+                        None, string.punctuation.encode("latin-1")).lower()
+                    buckets[m.groups()].append(raw.split())
+                member = tf.next()
+        self._buckets = dict(buckets)
+        return self._buckets
+
+    def _build_word_dict(self, cutoff: int) -> Dict[bytes, int]:
+        freq: Dict[bytes, int] = collections.defaultdict(int)
+        for docs in self._tokenize_all().values():
+            for doc in docs:
+                for w in doc:
+                    freq[w] += 1
+        kept = [kv for kv in freq.items() if kv[1] > cutoff]
+        ordered = sorted(kept, key=lambda kv: (-kv[1], kv[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(ordered)}
+        word_idx[b"<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load_anno(self):
+        unk = self.word_idx[b"<unk>"]
+        self.docs, self.labels = [], []
+        buckets = self._tokenize_all()
+        for label, kind in ((0, "pos"), (1, "neg")):
+            for doc in buckets.get((self.mode, kind), []):
+                self.docs.append([self.word_idx.get(w, unk) for w in doc])
+                self.labels.append(label)
+        self._buckets = None  # corpus text no longer needed
+
+    def __getitem__(self, idx):
+        return np.array(self.docs[idx]), np.array([self.labels[idx]])
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB tar (`./simple-examples/data/ptb.{train,valid}.txt`): word dict
+    over train+valid with ``freq > min_word_freq``; NGRAM windows or full
+    <s> … <e> SEQ lines (reference `imikolov.py:85-180`)."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size: int = -1,
+                 mode="train", min_word_freq: int = 50, download=False):
+        assert data_type.upper() in ("NGRAM", "SEQ"), data_type
+        assert mode.lower() in ("train", "test"), mode
+        self.data_type = data_type.upper()
+        if self.data_type == "NGRAM":
+            assert window_size > 0, "NGRAM data needs window_size > 0"
+        self.window_size = window_size
+        self.mode = "train" if mode.lower() == "train" else "valid"
+        self.min_word_freq = min_word_freq
+        self.data_file = _require(data_file, "Imikolov")
+        self.word_idx = self._build_word_dict()
+        self._load_anno()
+
+    def _count(self, f, freq):
+        for line in f:
+            for w in line.strip().split():
+                freq[w] += 1
+            freq[b"<s>"] += 1
+            freq[b"<e>"] += 1
+        return freq
+
+    def _build_word_dict(self) -> Dict[bytes, int]:
+        with tarfile.open(self.data_file) as tf:
+            freq: Dict[bytes, int] = collections.defaultdict(int)
+            self._count(tf.extractfile("./simple-examples/data/ptb.train.txt"),
+                        freq)
+            self._count(tf.extractfile("./simple-examples/data/ptb.valid.txt"),
+                        freq)
+        freq.pop(b"<unk>", None)
+        kept = [kv for kv in freq.items() if kv[1] > self.min_word_freq]
+        ordered = sorted(kept, key=lambda kv: (-kv[1], kv[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(ordered)}
+        word_idx[b"<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load_anno(self):
+        unk = self.word_idx[b"<unk>"]
+        self.data = []
+        with tarfile.open(self.data_file) as tf:
+            f = tf.extractfile(f"./simple-examples/data/ptb.{self.mode}.txt")
+            for line in f:
+                if self.data_type == "NGRAM":
+                    words = [b"<s>"] + line.strip().split() + [b"<e>"]
+                    ids = [self.word_idx.get(w, unk) for w in words]
+                    for i in range(len(ids) - self.window_size + 1):
+                        self.data.append(tuple(ids[i:i + self.window_size]))
+                else:
+                    words = [b"<s>"] + line.strip().split() + [b"<e>"]
+                    self.data.append([self.word_idx.get(w, unk)
+                                      for w in words])
+
+    def __getitem__(self, idx):
+        return tuple(np.array([v]) for v in self.data[idx]) \
+            if self.data_type == "NGRAM" else np.array(self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class MovieInfo:
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self, categories_dict, movie_title_dict):
+        return [np.array([self.index], np.int64),
+                np.array([categories_dict[c] for c in self.categories],
+                         np.int64),
+                np.array([movie_title_dict[w.lower()] for w in
+                          self.title.split()], np.int64)]
+
+
+class UserInfo:
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = [1, 18, 25, 35, 45, 50, 56].index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [np.array([self.index], np.int64),
+                np.array([0 if self.is_male else 1], np.int64),
+                np.array([self.age], np.int64),
+                np.array([self.job_id], np.int64)]
+
+
+class Movielens(Dataset):
+    """ml-1m zip (`movies.dat` / `users.dat` / `ratings.dat`, ``::``
+    separated): each item = movie features + user features + rating
+    (reference `movielens.py:160-260`)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio: float = 0.1,
+                 rand_seed: int = 0, download=False):
+        assert mode.lower() in ("train", "test"), mode
+        self.mode = mode.lower()
+        self.data_file = _require(data_file, "Movielens")
+        self.test_ratio = test_ratio
+        self.rand_seed = rand_seed
+        # local generator: constructing a dataset must not reset the
+        # process-global numpy RNG
+        self._rng = np.random.default_rng(rand_seed)
+        self._load_meta_info()
+        self._load_data()
+
+    def _load_meta_info(self):
+        self.movie_info: Dict[int, MovieInfo] = {}
+        self.movie_title_dict: Dict[str, int] = {}
+        self.categories_dict: Dict[str, int] = {}
+        self.user_info: Dict[int, UserInfo] = {}
+        with zipfile.ZipFile(self.data_file) as zf:
+            movies = [n for n in zf.namelist() if n.endswith("movies.dat")][0]
+            users = [n for n in zf.namelist() if n.endswith("users.dat")][0]
+            with zf.open(movies) as f:
+                for line in f:
+                    line = line.decode("latin-1").strip()
+                    movie_id, title, categories = line.split("::")
+                    categories = categories.split("|")
+                    title = title[:-7]  # strip " (YYYY)"
+                    for c in categories:
+                        self.categories_dict.setdefault(
+                            c, len(self.categories_dict))
+                    for w in title.split():
+                        self.movie_title_dict.setdefault(
+                            w.lower(), len(self.movie_title_dict))
+                    self.movie_info[int(movie_id)] = MovieInfo(
+                        movie_id, categories, title)
+            with zf.open(users) as f:
+                for line in f:
+                    uid, gender, age, job, _zip = \
+                        line.decode("latin-1").strip().split("::")
+                    self.user_info[int(uid)] = UserInfo(uid, gender, age, job)
+
+    def _load_data(self):
+        self.data = []
+        is_test = self.mode == "test"
+        with zipfile.ZipFile(self.data_file) as zf:
+            ratings = [n for n in zf.namelist() if n.endswith("ratings.dat")][0]
+            with zf.open(ratings) as f:
+                for line in f:
+                    if (self._rng.random() < self.test_ratio) == is_test:
+                        uid, mov_id, rating, _ = \
+                            line.decode("latin-1").strip().split("::")
+                        usr = self.user_info[int(uid)]
+                        mov = self.movie_info[int(mov_id)]
+                        self.data.append(
+                            usr.value() +
+                            mov.value(self.categories_dict,
+                                      self.movie_title_dict) +
+                            [np.array([float(rating)], np.float32)])
+
+    def __getitem__(self, idx):
+        return tuple(self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+_WMT_START, _WMT_END, _WMT_UNK = b"<s>", b"<e>", b"<unk>"
+
+
+class WMT14(Dataset):
+    """WMT14 en→fr dev+train tar with prebuilt ``src.dict``/``trg.dict``
+    members (reference `wmt14.py:90-180`): items are (src_ids, trg_ids,
+    trg_ids_next)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size: int = -1,
+                 download=False):
+        assert mode.lower() in ("train", "test", "gen"), mode
+        self.mode = mode.lower()
+        self.data_file = _require(data_file, "WMT14")
+        self.dict_size = dict_size
+        self._load_data()
+
+    def _to_dict(self, fd, size: int) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for i, line in enumerate(fd):
+            if size >= 0 and i >= size:  # size<0: whole dict file
+                break
+            out[line.strip().decode()] = i
+        return out
+
+    def _load_data(self):
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file) as tf:
+            names = [m.name for m in tf if m.name.endswith("src.dict")]
+            self.src_dict = self._to_dict(tf.extractfile(names[0]),
+                                          self.dict_size)
+            names = [m.name for m in tf if m.name.endswith("trg.dict")]
+            self.trg_dict = self._to_dict(tf.extractfile(names[0]),
+                                          self.dict_size)
+            # corpus members end with "<mode>/<mode>" (reference wmt14.py:151)
+            file_name = f"{self.mode}/{self.mode}"
+            names = [m.name for m in tf if m.name.endswith(file_name)]
+            src_unk = self.src_dict.get(_WMT_UNK.decode(), 2)
+            trg_unk = self.trg_dict.get(_WMT_UNK.decode(), 2)
+            for name in names:
+                for line in tf.extractfile(name):
+                    cols = line.decode().strip().split("\t")
+                    if len(cols) != 2:
+                        continue
+                    src = [self.src_dict.get(w, src_unk)
+                           for w in cols[0].split()]
+                    trg = [self.trg_dict.get(w, trg_unk)
+                           for w in cols[1].split()]
+                    self.src_ids.append(src)
+                    self.trg_ids.append(
+                        [self.trg_dict.get(_WMT_START.decode(), 0)] + trg)
+                    self.trg_ids_next.append(
+                        trg + [self.trg_dict.get(_WMT_END.decode(), 1)])
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+
+class WMT16(Dataset):
+    """WMT16 en↔de tar (`wmt16/{train,val,test}` tab-separated pairs); word
+    dicts are BUILT from the train corpus with <s>/<e>/<unk> prepended
+    (reference `wmt16.py:157-240`)."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size: int = -1,
+                 trg_dict_size: int = -1, lang: str = "en", download=False):
+        assert mode.lower() in ("train", "test", "val"), mode
+        self.mode = mode.lower()
+        self.data_file = _require(data_file, "WMT16")
+        self.lang = lang
+        self.src_dict_size = src_dict_size
+        self.trg_dict_size = trg_dict_size
+        self.src_dict = self._build_dict(lang, src_dict_size)
+        self.trg_dict = self._build_dict("de" if lang == "en" else "en",
+                                         trg_dict_size)
+        self._load_data()
+
+    def _build_dict(self, lang: str, size: int) -> Dict[bytes, int]:
+        freq: Dict[bytes, int] = collections.defaultdict(int)
+        col = 0 if lang == self.lang else 1
+        with tarfile.open(self.data_file) as tf:
+            for line in tf.extractfile("wmt16/train"):
+                cols = line.strip().split(b"\t")
+                if len(cols) != 2:
+                    continue
+                for w in cols[col].split():
+                    freq[w] += 1
+        ordered = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+        if size >= 0:
+            ordered = ordered[:max(0, size - 3)]
+        words = [_WMT_START, _WMT_END, _WMT_UNK] + [w for w, _ in ordered]
+        return {w: i for i, w in enumerate(words)}
+
+    def _load_data(self):
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        src_col = 0 if self.lang == "en" else 1
+        unk_s = self.src_dict[_WMT_UNK]
+        unk_t = self.trg_dict[_WMT_UNK]
+        with tarfile.open(self.data_file) as tf:
+            for line in tf.extractfile(f"wmt16/{self.mode}"):
+                cols = line.strip().split(b"\t")
+                if len(cols) != 2:
+                    continue
+                src = [self.src_dict.get(w, unk_s)
+                       for w in cols[src_col].split()]
+                trg = [self.trg_dict.get(w, unk_t)
+                       for w in cols[1 - src_col].split()]
+                self.src_ids.append(src)
+                self.trg_ids.append([self.trg_dict[_WMT_START]] + trg)
+                self.trg_ids_next.append(trg + [self.trg_dict[_WMT_END]])
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL test split (reference `conll05.py:160-300`): requires
+    the data tar plus the three dict files; items are the 9-field tuple
+    (word_ids, ctx_n2/n1/0/p1/p2 ids, pred_ids, mark, label_ids)."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, download=False):
+        self.data_file = _require(data_file, "Conll05st")
+        self.word_dict_file = _require(word_dict_file, "Conll05st word dict")
+        self.verb_dict_file = _require(verb_dict_file, "Conll05st verb dict")
+        self.target_dict_file = _require(target_dict_file,
+                                         "Conll05st target dict")
+        self.word_dict = self._load_dict(self.word_dict_file)
+        self.predicate_dict = self._load_dict(self.verb_dict_file)
+        self.label_dict = self._load_label_dict(self.target_dict_file)
+        self._load_anno()
+
+    @staticmethod
+    def _load_dict(path: str) -> Dict[str, int]:
+        with open(path) as f:
+            return {line.strip(): i for i, line in enumerate(f)}
+
+    @staticmethod
+    def _load_label_dict(path: str) -> Dict[str, int]:
+        d: Dict[str, int] = {}
+        tag_dict = set()
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith("B-"):
+                    tag_dict.add(line[2:])
+        index = 0
+        for tag in sorted(tag_dict):
+            d["B-" + tag] = index
+            index += 1
+            d["I-" + tag] = index
+            index += 1
+        d["O"] = index
+        return d
+
+    def _load_anno(self):
+        """The archive carries `.../test.wsj.words.gz` and
+        `.../test.wsj.props.gz` members (sentence-per-blank-line)."""
+        import gzip
+        import io
+
+        self.sentences = []
+        with tarfile.open(self.data_file) as tf:
+            words_name = [m.name for m in tf
+                          if m.name.endswith("words.gz")][0]
+            props_name = [m.name for m in tf
+                          if m.name.endswith("props.gz")][0]
+            wf = io.TextIOWrapper(gzip.GzipFile(
+                fileobj=io.BytesIO(tf.extractfile(words_name).read())))
+            pf = io.TextIOWrapper(gzip.GzipFile(
+                fileobj=io.BytesIO(tf.extractfile(props_name).read())))
+            sentence, labels_rows = [], []
+            for wline, pline in zip(wf, pf):
+                wline, pline = wline.strip(), pline.strip()
+                if not wline:
+                    self._emit(sentence, labels_rows)
+                    sentence, labels_rows = [], []
+                    continue
+                sentence.append(wline)
+                labels_rows.append(pline.split())
+            if sentence:
+                self._emit(sentence, labels_rows)
+
+    def _emit(self, sentence: List[str], rows: List[List[str]]):
+        if not sentence or not rows or len(rows[0]) < 2:
+            return
+        n_pred = len(rows[0]) - 1
+        for p in range(n_pred):
+            verb = next((rows[i][0] for i in range(len(rows))
+                         if rows[i][p + 1].startswith("(V*")), None)
+            if verb is None:
+                continue
+            # IOB labels from the bracketed props column
+            labels, current = [], None
+            for i in range(len(rows)):
+                tok = rows[i][p + 1]
+                if tok.startswith("("):
+                    current = tok[1:tok.index("*")]
+                    labels.append("B-" + current)
+                elif current is not None:
+                    labels.append("I-" + current)
+                else:
+                    labels.append("O")
+                if tok.endswith(")"):
+                    current = None
+            self.sentences.append((list(sentence), verb, labels))
+
+    def __getitem__(self, idx):
+        sentence, predicate, labels = self.sentences[idx]
+        unk = self.word_dict.get("<unk>", len(self.word_dict) - 1)
+        n = len(sentence)
+        pred_idx = sentence.index(predicate) if predicate in sentence else 0
+        ctx = lambda off: sentence[min(max(pred_idx + off, 0), n - 1)]
+        word_ids = np.array([self.word_dict.get(w, unk) for w in sentence])
+        mark = np.zeros(n, np.int64)
+        mark[pred_idx] = 1
+        ctx_ids = [np.array([self.word_dict.get(ctx(off), unk)] * n)
+                   for off in (-2, -1, 0, 1, 2)]
+        pred_ids = np.array([self.predicate_dict.get(predicate, 0)] * n)
+        label_ids = np.array([self.label_dict.get(l, self.label_dict["O"])
+                              for l in labels])
+        return tuple([word_ids] + ctx_ids + [pred_ids, mark, label_ids])
+
+    def __len__(self):
+        return len(self.sentences)

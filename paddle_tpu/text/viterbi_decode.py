@@ -1,0 +1,89 @@
+"""Viterbi decoding (reference `python/paddle/text/viterbi_decode.py:25` +
+the phi viterbi_decode kernel).
+
+Semantics (reference docstring): with ``include_bos_eos_tag=True`` the LAST
+row/column of ``transitions`` belongs to the start tag and the
+SECOND-TO-LAST to the stop tag — the first step adds ``transitions[-1]``
+(start → tag) and the final step adds ``transitions[:, -2]`` (tag → stop).
+Returned paths cover ``max(lengths)`` positions; entries past a sequence's
+own length are 0."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer
+from ..tensor.tensor import Tensor
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag: bool = True, name=None):
+    """Returns ``(scores, paths)``: best-path score per batch element, and
+    the argmax tag sequence over ``max(lengths)`` steps."""
+    pot = potentials._value if isinstance(potentials, Tensor) \
+        else jnp.asarray(potentials)
+    trans = transition_params._value if isinstance(transition_params, Tensor) \
+        else jnp.asarray(transition_params)
+    lens = (lengths._value if isinstance(lengths, Tensor)
+            else jnp.asarray(lengths)).astype(jnp.int32)
+    b, t_max, c = pot.shape
+    potf = pot.astype(jnp.float32)
+    transf = trans.astype(jnp.float32)
+
+    alpha = potf[:, 0]
+    if include_bos_eos_tag:
+        alpha = alpha + transf[-1][None, :]
+
+    def step(carry, emit_t):
+        alpha, t = carry
+        # scores[b, p, q] = alpha[b, p] + trans[p, q]
+        scores = alpha[:, :, None] + transf[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1).astype(jnp.int32)
+        cand = jnp.max(scores, axis=1) + emit_t
+        active = (t < lens)[:, None]  # frozen once past the seq's length
+        return (jnp.where(active, cand, alpha), t + 1), best_prev
+
+    if t_max > 1:
+        (alpha, _), hist = jax.lax.scan(
+            step, (alpha, jnp.ones((), jnp.int32)),
+            jnp.moveaxis(potf[:, 1:], 1, 0))  # hist: [t_max-1, b, c]
+    else:
+        hist = jnp.zeros((0, b, c), jnp.int32)
+
+    final = alpha + (transf[:, -2][None, :] if include_bos_eos_tag else 0.0)
+    scores = jnp.max(final, axis=-1)
+    last = jnp.argmax(final, axis=-1).astype(jnp.int32)  # tag at pos len-1
+
+    # backtrace: tags[t-1] = hist[t-1][b, tags[t]], only while the
+    # transition t-1 -> t lies inside the sequence (t < len)
+    tags = [None] * t_max
+    tag = last
+    for t in range(t_max - 1, 0, -1):
+        tags[t] = tag
+        inside = t < lens
+        prev = jnp.take_along_axis(hist[t - 1], tag[:, None], axis=1)[:, 0]
+        tag = jnp.where(inside, prev, tag)
+    tags[0] = tag
+    paths = jnp.stack(tags, axis=1)
+    pos = jnp.arange(t_max)[None, :]
+    paths = jnp.where(pos < lens[:, None], paths, 0)
+    max_len = int(jax.device_get(jnp.max(lens))) if b else t_max
+    return Tensor(scores), Tensor(paths[:, :max_len].astype(jnp.int64))
+
+
+class ViterbiDecoder(Layer):
+    """reference `text/viterbi_decode.py:100`."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True, name=None):
+        super().__init__()
+        self.transitions = transitions if isinstance(transitions, Tensor) \
+            else Tensor(jnp.asarray(transitions))
+        self.include_bos_eos_tag = include_bos_eos_tag
+        self.name = name
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag, self.name)
