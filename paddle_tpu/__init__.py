@@ -49,6 +49,8 @@ from . import hub  # noqa: E402
 from . import geometric  # noqa: E402
 from . import signal  # noqa: E402
 from . import sparse  # noqa: E402
+from . import onnx  # noqa: E402
+from . import quantization  # noqa: E402
 from . import static  # noqa: E402
 from . import text  # noqa: E402
 from . import utils  # noqa: E402

@@ -68,16 +68,21 @@ def launch(argv=None) -> int:
         if args.nnodes > 1:
             raise SystemExit("--master host:port is required when nnodes > 1")
         master = f"127.0.0.1:{_free_port()}"
+    coordinator = None
     if args.nnodes > 1:
         # multi-node: rendezvous through the TCP store served from the
         # master host (reference `controllers/master.py:73` HTTPMaster) —
         # assigns node ranks, publishes hostnames, and barriers all pods
-        # before any worker spawns
+        # before any worker spawns. The store OWNS the master port for the
+        # job's lifetime, so jax.distributed's coordinator gets port+1
+        # (exported as PADDLE_COORDINATOR, consumed by init_parallel_env).
         from ..store import rendezvous
 
         store, node_rank = rendezvous(
             master, args.nnodes, job_id=args.job_id,
             node_rank=None if node_rank < 0 else node_rank)
+        mhost, mport = master.rsplit(":", 1)
+        coordinator = f"{mhost}:{int(mport) + 1}"
     elif node_rank < 0:
         node_rank = 0
     os.makedirs(args.log_dir, exist_ok=True)
@@ -97,6 +102,7 @@ def launch(argv=None) -> int:
                 "PADDLE_JOB_ID": args.job_id,
                 "PADDLE_NNODES": str(args.nnodes),
                 "PADDLE_NODE_RANK": str(node_rank),
+                **({"PADDLE_COORDINATOR": coordinator} if coordinator else {}),
                 # multi-process-per-host (CPU fake cluster): keep each worker
                 # to its own slice of host devices
                 "PADDLE_NPROC_PER_NODE": str(nproc),

@@ -32,7 +32,12 @@ def init_parallel_env(strategy=None) -> "ParallelEnv":
         return ParallelEnv()
     rank = int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("JAX_PROCESS_ID", "0")))
     nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", os.environ.get("JAX_NUM_PROCESSES", "1")))
-    master = os.environ.get("PADDLE_MASTER", os.environ.get("COORDINATOR_ADDRESS"))
+    # PADDLE_COORDINATOR: jax.distributed's own port — the launch CLI keeps
+    # PADDLE_MASTER for its TCPStore rendezvous service, which HOLDS that
+    # port for the whole job, so the coordinator must bind elsewhere
+    master = os.environ.get(
+        "PADDLE_COORDINATOR",
+        os.environ.get("PADDLE_MASTER", os.environ.get("COORDINATOR_ADDRESS")))
     if nprocs > 1:
         if master is None:
             eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")

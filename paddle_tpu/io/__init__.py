@@ -310,12 +310,23 @@ _SHM_MIN_BYTES = 1 << 16  # smaller arrays go through the pipe directly
 
 class _ShmArray:
     """Descriptor of an ndarray parked in POSIX shared memory (the
-    reference's shared-mem LoDTensor transport, `dataloader_iter.py:150`)."""
+    reference's shared-mem LoDTensor transport, `dataloader_iter.py:150`).
+    ``was_tensor`` preserves the batch's python type across the pipe."""
 
-    __slots__ = ("name", "shape", "dtype")
+    __slots__ = ("name", "shape", "dtype", "was_tensor")
 
-    def __init__(self, name, shape, dtype):
+    def __init__(self, name, shape, dtype, was_tensor=False):
         self.name, self.shape, self.dtype = name, shape, str(dtype)
+        self.was_tensor = was_tensor
+
+
+class _TensorArray:
+    """Pipe-path marker: this ndarray was a Tensor on the worker side."""
+
+    __slots__ = ("arr",)
+
+    def __init__(self, arr):
+        self.arr = arr
 
 
 class _WorkerError:
@@ -326,20 +337,22 @@ class _WorkerError:
 
 def _to_transport(obj, use_shm: bool):
     """Worker→parent encoding: Tensors/ndarrays become ndarrays (big ones
-    parked in shared memory); containers recurse."""
+    parked in shared memory) with the original type recorded, so the parent
+    reconstructs exactly what the sync loader would have yielded."""
     from multiprocessing import shared_memory
 
-    if isinstance(obj, Tensor):
+    was_tensor = isinstance(obj, Tensor)
+    if was_tensor:
         obj = np.asarray(obj._value)
     if isinstance(obj, np.ndarray):
         if use_shm and obj.nbytes >= _SHM_MIN_BYTES:
             shm = shared_memory.SharedMemory(create=True, size=obj.nbytes)
             view = np.ndarray(obj.shape, obj.dtype, buffer=shm.buf)
             np.copyto(view, obj)
-            desc = _ShmArray(shm.name, obj.shape, obj.dtype)
+            desc = _ShmArray(shm.name, obj.shape, obj.dtype, was_tensor)
             shm.close()
             return desc
-        return obj
+        return _TensorArray(obj) if was_tensor else obj
     if isinstance(obj, (list, tuple)):
         return type(obj)(_to_transport(o, use_shm) for o in obj)
     if isinstance(obj, dict):
@@ -366,10 +379,14 @@ def _release_transport(obj) -> None:
     elif isinstance(obj, dict):
         for v in obj.values():
             _release_transport(v)
+    # _TensorArray / plain ndarrays hold no shared-memory resources
 
 
-def _from_transport(obj):
-    """Parent-side decoding: ndarrays (incl. shared-memory ones) → Tensor."""
+def _from_transport(obj, tensorify: bool):
+    """Parent-side decoding. ``tensorify``: the worker ran the numpy twin of
+    the default collate, so every array becomes a Tensor (matching the sync
+    path); custom collates keep their own types (ndarray stays ndarray,
+    worker-side Tensors come back as Tensors)."""
     from multiprocessing import shared_memory
 
     if isinstance(obj, _ShmArray):
@@ -379,13 +396,15 @@ def _from_transport(obj):
         finally:
             shm.close()
             shm.unlink()
-        return Tensor(arr)
+        return Tensor(arr) if (tensorify or obj.was_tensor) else arr
+    if isinstance(obj, _TensorArray):
+        return Tensor(obj.arr)
     if isinstance(obj, np.ndarray):
-        return Tensor(obj)
+        return Tensor(obj) if tensorify else obj
     if isinstance(obj, (list, tuple)):
-        return type(obj)(_from_transport(o) for o in obj)
+        return type(obj)(_from_transport(o, tensorify) for o in obj)
     if isinstance(obj, dict):
-        return {k: _from_transport(v) for k, v in obj.items()}
+        return {k: _from_transport(v, tensorify) for k, v in obj.items()}
     return obj
 
 
@@ -426,6 +445,16 @@ def _stack_np(arrays):
 
     out = native_stack(arrays)
     return out if out is not None else np.stack(arrays)
+
+
+def _contains_tensor(obj) -> bool:
+    if isinstance(obj, Tensor):
+        return True
+    if isinstance(obj, (list, tuple)):
+        return any(_contains_tensor(o) for o in obj)
+    if isinstance(obj, dict):
+        return any(_contains_tensor(v) for v in obj.values())
+    return False
 
 
 def _np_collate(batch: List[Any]):
@@ -522,6 +551,15 @@ class DataLoader:
         if not indices:
             return iter(())
         nw = min(self.num_workers, len(indices))
+        # datasets whose items are Tensors (jax arrays) would make the
+        # FORKED child do device transfers against the parent's inherited,
+        # post-fork-inconsistent XLA runtime — probe one sample and keep
+        # such datasets on the threaded pool
+        if _contains_tensor(self.dataset[indices[0][0]]):
+            raise TypeError(
+                "dataset items contain Tensors; jax work is unsafe in "
+                "forked workers — using threads (return numpy from "
+                "__getitem__ to enable process workers)")
         try:
             ctx = multiprocessing.get_context("fork")
         except ValueError:
@@ -548,16 +586,17 @@ class DataLoader:
             for p in procs:
                 p.terminate()
             raise
-        return self._consume_process_results(procs, result_q, len(indices))
+        return self._consume_process_results(procs, result_q, len(indices),
+                                             collate is _np_collate)
 
-    def _consume_process_results(self, procs, result_q, total):
+    def _consume_process_results(self, procs, result_q, total, tensorify):
         try:
             buffered = {}
             next_seq = 0
             deadline_step = self.timeout or 5.0
             while next_seq < total:
                 while next_seq in buffered:
-                    yield _from_transport(buffered.pop(next_seq))
+                    yield _from_transport(buffered.pop(next_seq), tensorify)
                     next_seq += 1
                 if next_seq >= total:
                     break

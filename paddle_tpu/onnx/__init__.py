@@ -1,0 +1,41 @@
+"""paddle.onnx parity surface (reference `python/paddle/onnx/export.py:22`).
+
+The reference delegates to the external ``paddle2onnx`` package. This build
+runs zero-egress and the image carries no onnx library, so:
+
+- ``format="onnx"`` (the default) requires the ``onnx`` package and raises a
+  clear ImportError without it;
+- ``format="stablehlo"`` serializes the traced program through
+  ``paddle_tpu.jit.save`` — the TPU-native interchange format (StableHLO is
+  what an XLA-backed runtime consumes the way onnxruntime consumes ONNX).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+__all__ = ["export"]
+
+
+def export(layer, path: str, input_spec: Optional[Sequence] = None,
+           opset_version: int = 9, format: str = "onnx", **configs):
+    """Export ``layer`` for inference (reference `onnx/export.py:22`)."""
+    if format == "stablehlo":
+        from .. import jit
+
+        jit.save(layer, path, input_spec=list(input_spec or []))
+        return path
+    if format != "onnx":
+        raise ValueError(f"format must be 'onnx' or 'stablehlo', got {format!r}")
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "paddle_tpu.onnx.export(format='onnx') needs the 'onnx' package, "
+            "which this zero-egress image does not ship. Use "
+            "format='stablehlo' for the TPU-native serialized program "
+            "(consumed by paddle_tpu.jit.load / any StableHLO runtime)."
+        ) from e
+    raise NotImplementedError(
+        "ONNX graph emission is not implemented in this build; export with "
+        "format='stablehlo' instead")

@@ -1,0 +1,30 @@
+"""Quantization (reference `python/paddle/quantization/__init__.py:1`).
+
+PTQ + QAT with the reference's architecture — QuantConfig maps layers/types
+to quanter factories; QAT inserts trainable fake-quant simulation (straight-
+through estimator); PTQ inserts observers, then ``convert`` freezes the
+collected scales into quant-dequant ops. All quant math is jnp (jit/TPU
+friendly); observer/quanter state lives in Layer buffers so it threads
+through the compiled train step like any other buffer.
+
+Components:
+- :class:`QuantConfig` — ``add_layer_config`` / ``add_type_config`` /
+  default (activation, weight) factories (reference `config.py:60`).
+- :class:`QAT` — ``quantize(model)`` wraps Linear/Conv2D in fake-quant
+  wrappers (reference `qat.py:23`).
+- :class:`PTQ` — ``quantize(model)`` observes activation/weight ranges,
+  ``convert(model)`` freezes scales (reference `ptq.py`).
+- quanters: :class:`FakeQuanterWithAbsMaxObserver` (reference
+  `quanters/abs_max.py`); observers: :class:`AbsmaxObserver`.
+"""
+
+from .config import QuantConfig
+from .factory import QuanterFactory, quanter
+from .observers import AbsmaxObserver
+from .qat import QAT
+from .ptq import PTQ
+from .quanters import FakeQuanterWithAbsMaxObserver
+from .wrapper import QuantedLayer
+
+__all__ = ["QuantConfig", "QuanterFactory", "quanter", "AbsmaxObserver",
+           "QAT", "PTQ", "FakeQuanterWithAbsMaxObserver", "QuantedLayer"]

@@ -1,0 +1,53 @@
+"""QuantConfig (reference `quantization/config.py:60`): maps layers / layer
+types to (activation, weight) quanter factories."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple, Type
+
+from ..nn.layer.layers import Layer
+from .factory import QuanterFactory
+
+__all__ = ["QuantConfig"]
+
+_DEFAULT_QUANTABLE: Tuple[str, ...] = ("Linear", "Conv2D")
+
+
+class QuantConfig:
+    def __init__(self, activation: Optional[QuanterFactory],
+                 weight: Optional[QuanterFactory]):
+        self._activation = activation
+        self._weight = weight
+        self._layer_configs: List[Tuple[List[Layer], Optional[QuanterFactory],
+                                        Optional[QuanterFactory]]] = []
+        self._type_configs: Dict[type, Tuple[Optional[QuanterFactory],
+                                             Optional[QuanterFactory]]] = {}
+
+    def add_layer_config(self, layer, activation=None, weight=None) -> None:
+        """Per-instance override (reference `config.py:99`). The config is
+        stamped ON the layer so it survives quantize()'s deepcopy."""
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            l._quant_config = (activation, weight)
+        self._layer_configs.append((list(layers), activation, weight))
+
+    def add_type_config(self, layer_type, activation=None, weight=None) -> None:
+        """Per-class override (reference `config.py:196`)."""
+        types = layer_type if isinstance(layer_type, (list, tuple)) \
+            else [layer_type]
+        for t in types:
+            self._type_configs[t] = (activation, weight)
+
+    def _config_for(self, layer: Layer):
+        """(activation_factory, weight_factory) or None when the layer is
+        not quantized."""
+        stamped = getattr(layer, "_quant_config", None)
+        if stamped is not None:
+            return stamped
+        for t, (act, wt) in self._type_configs.items():
+            if isinstance(layer, t):
+                return act, wt
+        if type(layer).__name__ in _DEFAULT_QUANTABLE and \
+                (self._activation is not None or self._weight is not None):
+            return self._activation, self._weight
+        return None

@@ -1,0 +1,48 @@
+"""Quanter factories (reference `quantization/factory.py`): a factory binds
+a quanter class to constructor kwargs; `_instance(layer)` builds the quanter
+for one wrapped layer."""
+
+from __future__ import annotations
+
+
+class QuanterFactory:
+    def __init__(self, cls, **kwargs):
+        self.cls = cls
+        self.kwargs = kwargs
+
+    def _instance(self, layer=None):
+        return self.cls(layer=layer, **self.kwargs)
+
+    def __repr__(self):
+        return f"QuanterFactory({self.cls.__name__}, {self.kwargs})"
+
+
+def quanter(cls):
+    """Class decorator (reference `factory.quanter`): calling the decorated
+    class returns a factory instead of an instance, so
+    ``FakeQuanterWithAbsMaxObserver(moving_rate=0.9)`` can be handed to
+    QuantConfig and instantiated per wrapped layer later."""
+
+    import inspect
+
+    # positional args map onto the quanter's signature after `layer`
+    # (reference allows FakeQuanterWithAbsMaxObserver(0.9) positionally)
+    param_names = [p for p in inspect.signature(cls.__init__).parameters
+                   if p not in ("self", "layer")]
+
+    class _FactoryMaker:
+        _quanter_cls = cls
+
+        def __new__(maker_cls, *args, **kwargs):
+            if len(args) > len(param_names):
+                raise TypeError(f"{cls.__name__} takes at most "
+                                f"{len(param_names)} positional args")
+            bound = dict(zip(param_names, args))
+            overlap = set(bound) & set(kwargs)
+            if overlap:
+                raise TypeError(f"{cls.__name__} got multiple values for "
+                                f"{sorted(overlap)}")
+            return QuanterFactory(cls, **bound, **kwargs)
+
+    _FactoryMaker.__name__ = cls.__name__
+    return _FactoryMaker

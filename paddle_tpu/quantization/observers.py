@@ -1,0 +1,46 @@
+"""PTQ observers (reference `quantization/observers/abs_max.py`)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer
+from ..tensor.tensor import Tensor, apply_op
+from .factory import quanter
+
+__all__ = ["AbsmaxObserver"]
+
+
+class _AbsmaxObserverLayer(Layer):
+    """Records the running max(|x|) of everything it sees; the data passes
+    through unchanged (calibration phase of PTQ)."""
+
+    def __init__(self, layer=None, bit_length: int = 8):
+        super().__init__()
+        self.bit_length = int(bit_length)
+        self.register_buffer("absmax",
+                             Tensor(jnp.zeros((1,), jnp.float32),
+                                    stop_gradient=True))
+
+    def scales(self) -> Tensor:
+        return self._buffers["absmax"]
+
+    def quant_axis(self):
+        return None
+
+    def forward(self, x):
+        if not isinstance(x, Tensor):
+            x = Tensor(jnp.asarray(x))
+        buf = self._buffers["absmax"]
+        old = buf._value
+
+        def fn(xv):
+            m = jnp.max(jnp.abs(xv)).reshape((1,)).astype(jnp.float32)
+            return xv, jnp.maximum(old, m)
+
+        out, new_max = apply_op("absmax_observe", fn, (x,), multi_out=True)
+        buf._value = new_max._value
+        return out
+
+
+AbsmaxObserver = quanter(_AbsmaxObserverLayer)

@@ -1,0 +1,73 @@
+"""PTQ (reference `quantization/ptq.py`)."""
+
+from __future__ import annotations
+
+import copy
+
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer
+from ..tensor.tensor import Tensor, apply_op
+from .config import QuantConfig
+from .qat import _wrap_model
+from .wrapper import QuantedLayer
+
+__all__ = ["PTQ"]
+
+
+class _FrozenQuantDequant(Layer):
+    """Fixed-scale int8 quant→dequant (what PTQ.convert freezes observers
+    into)."""
+
+    def __init__(self, scale: float, bit_length: int = 8):
+        super().__init__()
+        self.scale = float(scale)
+        self.qmax = float(2 ** (bit_length - 1) - 1)
+
+    def forward(self, x):
+        if not isinstance(x, Tensor):
+            x = Tensor(jnp.asarray(x))
+        s, qmax = max(self.scale, 1e-9), self.qmax
+
+        def fn(xv):
+            return jnp.round(jnp.clip(xv / s * qmax, -qmax, qmax)) * s / qmax
+
+        return apply_op("quant_dequant", fn, (x,))
+
+
+class PTQ:
+    """Post-training quantization: ``quantize`` inserts observers (data
+    passes through unchanged while ranges are recorded during calibration),
+    ``convert`` freezes the observed absmax into quant-dequant ops."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        return _wrap_model(model, self._config, inplace)
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def visit(layer: Layer):
+            for name, sub in list(layer._sub_layers.items()):
+                if isinstance(sub, QuantedLayer):
+                    for qname in ("activation_quanter", "weight_quanter"):
+                        q = sub._sub_layers.get(qname)
+                        if q is not None and hasattr(q, "scales"):
+                            scale = float(jnp.asarray(
+                                q.scales()._value).reshape(-1)[0])
+                            bits = getattr(q, "bit_length", 8)
+                            sub._sub_layers[qname] = _FrozenQuantDequant(
+                                scale, bits)
+                            if qname == "activation_quanter":
+                                sub._a = sub._sub_layers[qname]
+                            else:
+                                sub._w = sub._sub_layers[qname]
+                else:
+                    visit(sub)
+
+        visit(model)
+        model.eval()
+        return model

@@ -1,0 +1,54 @@
+"""QAT (reference `quantization/qat.py:23`)."""
+
+from __future__ import annotations
+
+import copy
+
+from ..nn.layer.layers import Layer
+from .config import QuantConfig
+from .wrapper import QuantedLayer
+
+__all__ = ["QAT"]
+
+
+def _wrap_model(model: Layer, config: QuantConfig, inplace: bool) -> Layer:
+    if not inplace:
+        model = copy.deepcopy(model)
+
+    def visit(layer: Layer):
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, QuantedLayer):
+                continue
+            cfg = config._config_for(sub)
+            if cfg is not None:
+                act, wt = cfg
+                layer._sub_layers[name] = QuantedLayer(
+                    sub,
+                    act._instance(sub) if act is not None else None,
+                    wt._instance(sub) if wt is not None else None)
+            else:
+                visit(sub)
+
+    visit(model)
+    return model
+
+
+class QAT:
+    """Quantization-aware training: inserts fake quanters (STE) into the
+    model so training sees quantization error."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        return _wrap_model(model, self._config, inplace)
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        """Freeze: after training, the quanters hold their final scales;
+        eval-mode forwards apply them deterministically (reference convert
+        replaces with quant/dequant ops — here the same layer in eval mode
+        IS that op)."""
+        if not inplace:
+            model = copy.deepcopy(model)
+        model.eval()
+        return model

@@ -1,0 +1,99 @@
+"""QAT fake quanters (reference `quantization/quanters/abs_max.py`
+FakeQuanterWithAbsMaxObserverLayer)."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer
+from ..tensor.tensor import Tensor, apply_op
+from .factory import quanter
+
+__all__ = ["FakeQuanterWithAbsMaxObserver"]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _fake_quant(x, scale, qmax):
+    s = jnp.maximum(scale, 1e-9)
+    return jnp.round(jnp.clip(x / s * qmax, -qmax, qmax)) * s / qmax
+
+
+def _fq_fwd(x, scale, qmax):
+    return _fake_quant(x, scale, qmax), (x, scale)
+
+
+def _fq_bwd(qmax, res, dy):
+    # straight-through estimator with range clipping
+    x, scale = res
+    s = jnp.maximum(scale, 1e-9)
+    inside = (jnp.abs(x) <= s).astype(dy.dtype)
+    return dy * inside, jnp.zeros_like(scale)
+
+
+_fake_quant.defvjp(_fq_fwd, _fq_bwd)
+
+
+class _FakeQuanterAbsMaxLayer(Layer):
+    """Moving-average absmax scale + fake quant with STE. The scale is a
+    BUFFER, so it threads through the compiled train step like any model
+    state (match: reference abs_max.py state `_scale`/`_state`)."""
+
+    def __init__(self, layer=None, moving_rate: float = 0.9,
+                 bit_length: int = 8, dtype="float32"):
+        super().__init__()
+        self.moving_rate = float(moving_rate)
+        self.bit_length = int(bit_length)
+        self._qmax = float(2 ** (self.bit_length - 1) - 1)
+        self.register_buffer("scale",
+                             Tensor(jnp.zeros((1,), jnp.float32),
+                                    stop_gradient=True))
+        self.register_buffer("inited",
+                             Tensor(jnp.zeros((1,), jnp.float32),
+                                    stop_gradient=True))
+
+    def scales(self) -> Tensor:
+        return self._buffers["scale"]
+
+    def quant_axis(self):
+        return None  # per-tensor
+
+    def forward(self, x):
+        if not isinstance(x, Tensor):
+            x = Tensor(jnp.asarray(x))
+        qmax = self._qmax
+        rate = self.moving_rate
+        scale_buf = self._buffers["scale"]
+        inited_buf = self._buffers["inited"]
+
+        if self.training:
+            # buffer state enters fn by CLOSURE and leaves as an extra
+            # output; the mutation happens outside so jax.vjp never captures
+            # a tracer into the buffer (the batch_norm running-stat pattern)
+            old_scale = scale_buf._value
+            seen = inited_buf._value > 0
+
+            def fn(xv):
+                absmax = jnp.max(jnp.abs(xv)).reshape((1,)).astype(jnp.float32)
+                new_scale = jnp.where(seen, rate * old_scale +
+                                      (1 - rate) * absmax, absmax)
+                return (_fake_quant(xv, new_scale[0].astype(xv.dtype), qmax),
+                        new_scale)
+
+            out, new_scale_t = apply_op("fake_quant_absmax", fn, (x,),
+                                        multi_out=True)
+            scale_buf._value = new_scale_t._value
+            inited_buf._value = jnp.ones((1,), jnp.float32)
+            return out
+
+        frozen = scale_buf._value[0]
+
+        def fn(xv):
+            return _fake_quant(xv, frozen.astype(xv.dtype), qmax)
+
+        return apply_op("fake_quant_absmax", fn, (x,))
+
+
+FakeQuanterWithAbsMaxObserver = quanter(_FakeQuanterAbsMaxLayer)

@@ -1,0 +1,50 @@
+"""Quantization wrappers: the layers QAT/PTQ substitute for Linear/Conv2D
+(reference `quantization/wrapper.py` + `imperative/qat.py` quanted layers)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer
+from ..tensor.tensor import Tensor
+
+__all__ = ["QuantedLayer"]
+
+
+class QuantedLayer(Layer):
+    """Wraps one leaf layer: activations go through ``a_quanter`` (observer
+    in PTQ, fake quanter in QAT); the weight is quantized via ``w_quanter``
+    on the fly; the wrapped layer's forward then runs with the (fake-)
+    quantized weight. state_dict keys keep the original layer's names."""
+
+    def __init__(self, layer: Layer, a_quanter=None, w_quanter=None):
+        super().__init__()
+        self.add_sublayer("layer", layer)
+        if a_quanter is not None:
+            self.add_sublayer("activation_quanter", a_quanter)
+        if w_quanter is not None:
+            self.add_sublayer("weight_quanter", w_quanter)
+        self._a = a_quanter
+        self._w = w_quanter
+
+    @property
+    def wrapped(self) -> Layer:
+        return self._sub_layers["layer"]
+
+    def forward(self, x, *args, **kwargs):
+        layer = self.wrapped
+        if self._a is not None:
+            x = self._a(x)
+        if self._w is not None and "weight" in layer._parameters:
+            w = layer._parameters["weight"]
+            qw = self._w(w)
+            # swap the Tensor OBJECT so ops inside the wrapped forward record
+            # the fake-quant output (grads flow through the STE back to w);
+            # swapping just the value would silently detach the quantizer
+            layer._parameters["weight"] = qw
+            try:
+                out = layer(x, *args, **kwargs)
+            finally:
+                layer._parameters["weight"] = w
+            return out
+        return layer(x, *args, **kwargs)

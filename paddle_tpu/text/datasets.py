@@ -364,21 +364,30 @@ class WMT16(Dataset):
         self.lang = lang
         self.src_dict_size = src_dict_size
         self.trg_dict_size = trg_dict_size
-        self.src_dict = self._build_dict(lang, src_dict_size)
-        self.trg_dict = self._build_dict("de" if lang == "en" else "en",
-                                         trg_dict_size)
+        # ONE decompression pass over wmt16/train builds both frequency
+        # tables (the archive is hundreds of MB gzipped)
+        src_freq, trg_freq = self._count_train()
+        self.src_dict = self._to_word_dict(src_freq, src_dict_size)
+        self.trg_dict = self._to_word_dict(trg_freq, trg_dict_size)
         self._load_data()
 
-    def _build_dict(self, lang: str, size: int) -> Dict[bytes, int]:
-        freq: Dict[bytes, int] = collections.defaultdict(int)
-        col = 0 if lang == self.lang else 1
+    def _count_train(self):
+        src_freq: Dict[bytes, int] = collections.defaultdict(int)
+        trg_freq: Dict[bytes, int] = collections.defaultdict(int)
+        src_col = 0 if self.lang == "en" else 1
         with tarfile.open(self.data_file) as tf:
             for line in tf.extractfile("wmt16/train"):
                 cols = line.strip().split(b"\t")
                 if len(cols) != 2:
                     continue
-                for w in cols[col].split():
-                    freq[w] += 1
+                for w in cols[src_col].split():
+                    src_freq[w] += 1
+                for w in cols[1 - src_col].split():
+                    trg_freq[w] += 1
+        return src_freq, trg_freq
+
+    @staticmethod
+    def _to_word_dict(freq: Dict[bytes, int], size: int) -> Dict[bytes, int]:
         ordered = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
         if size >= 0:
             ordered = ordered[:max(0, size - 3)]
@@ -484,10 +493,11 @@ class Conll05st(Dataset):
             return
         n_pred = len(rows[0]) - 1
         for p in range(n_pred):
-            verb = next((rows[i][0] for i in range(len(rows))
-                         if rows[i][p + 1].startswith("(V*")), None)
-            if verb is None:
+            pred_idx = next((i for i in range(len(rows))
+                             if rows[i][p + 1].startswith("(V*")), None)
+            if pred_idx is None:
                 continue
+            verb = rows[pred_idx][0]
             # IOB labels from the bracketed props column
             labels, current = [], None
             for i in range(len(rows)):
@@ -501,13 +511,14 @@ class Conll05st(Dataset):
                     labels.append("O")
                 if tok.endswith(")"):
                     current = None
-            self.sentences.append((list(sentence), verb, labels))
+            # keep the ROW index of the (V* match: finding the verb's word
+            # in the sentence again would break on repeated surface forms
+            self.sentences.append((list(sentence), verb, pred_idx, labels))
 
     def __getitem__(self, idx):
-        sentence, predicate, labels = self.sentences[idx]
+        sentence, predicate, pred_idx, labels = self.sentences[idx]
         unk = self.word_dict.get("<unk>", len(self.word_dict) - 1)
         n = len(sentence)
-        pred_idx = sentence.index(predicate) if predicate in sentence else 0
         ctx = lambda off: sentence[min(max(pred_idx + off, 0), n - 1)]
         word_ids = np.array([self.word_dict.get(w, unk) for w in sentence])
         mark = np.zeros(n, np.int64)

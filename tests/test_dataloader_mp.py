@@ -62,12 +62,12 @@ class SlowPython(Dataset):
 
     def __getitem__(self, i):
         acc = 0
-        for k in range(60000):
+        for k in range(150000):
             acc = (acc + k * i) % 97
         return np.asarray([acc], np.float32)
 
     def __len__(self):
-        return 24
+        return 32
 
 
 class TestProcessWorkers:
@@ -105,16 +105,33 @@ class TestProcessWorkers:
             list(DataLoader(Exploding(), batch_size=2, num_workers=2))
         assert "boom at 5" in str(ei.value)
 
-    def test_custom_collate_runs_in_worker(self):
+    def test_custom_collate_runs_in_worker_and_keeps_types(self):
         def collate(batch):
             return np.stack(batch) * 2.0
 
         out = list(DataLoader(Arange(8, 4), batch_size=4, num_workers=2,
                               collate_fn=lambda b: collate([x for x, _ in b])))
         assert len(out) == 2
-        first = out[0]
-        arr = first.numpy() if hasattr(first, "numpy") else np.asarray(first)
-        np.testing.assert_array_equal(arr[1], np.full(4, 2.0, np.float32))
+        # a custom collate returning ndarray must yield ndarray in EVERY
+        # worker mode (same type as the num_workers=0 path)
+        assert isinstance(out[0], np.ndarray)
+        np.testing.assert_array_equal(out[0][1], np.full(4, 2.0, np.float32))
+
+    def test_tensor_items_fall_back_to_threads(self):
+        import paddle_tpu as paddle
+
+        class TensorDS(Dataset):
+            def __getitem__(self, i):
+                return paddle.to_tensor(np.full(4, i, np.float32))
+
+            def __len__(self):
+                return 8
+
+        # jax arrays are unsafe in forked children: loader must degrade to
+        # threads and still produce correct batches
+        out = list(DataLoader(TensorDS(), batch_size=4, num_workers=2))
+        assert len(out) == 2
+        np.testing.assert_array_equal(out[0].numpy()[1], np.full(4, 1.0))
 
     def test_worker_init_fn_called(self):
         calls = []
@@ -149,4 +166,6 @@ class TestProcessWorkers:
         t0 = time.perf_counter()
         list(DataLoader(ds, batch_size=4, num_workers=2))
         process = time.perf_counter() - t0
-        assert process < threaded * 1.1  # GIL-bound work scales only with procs
+        # GIL-bound work only scales with processes; generous margin keeps
+        # this stable on loaded CI boxes
+        assert process < threaded * 1.25
