@@ -127,7 +127,7 @@ def bench_resnet(on_accel: bool, peak: float):
     from paddle_tpu.vision.models import resnet50, resnet18
 
     if on_accel:
-        model, batch, hw, steps, warmup, name = resnet50(), 64, 224, 8, 2, "resnet50"
+        model, batch, hw, steps, warmup, name = resnet50(), 192, 224, 8, 2, "resnet50"
         flops_fwd = 4.089e9  # @224, standard accounting
     else:
         model, batch, hw, steps, warmup, name = resnet18(), 4, 64, 2, 1, "resnet18"

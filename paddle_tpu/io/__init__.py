@@ -493,6 +493,7 @@ class DataLoader:
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
         self.use_process_workers = use_process_workers
+        self._tensor_items: Optional[bool] = None
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -553,9 +554,12 @@ class DataLoader:
         nw = min(self.num_workers, len(indices))
         # datasets whose items are Tensors (jax arrays) would make the
         # FORKED child do device transfers against the parent's inherited,
-        # post-fork-inconsistent XLA runtime — probe one sample and keep
-        # such datasets on the threaded pool
-        if _contains_tensor(self.dataset[indices[0][0]]):
+        # post-fork-inconsistent XLA runtime — probe one sample (cached:
+        # this is a property of the dataset, and __getitem__ may be an
+        # expensive decode) and keep such datasets on the threaded pool
+        if self._tensor_items is None:
+            self._tensor_items = _contains_tensor(self.dataset[indices[0][0]])
+        if self._tensor_items:
             raise TypeError(
                 "dataset items contain Tensors; jax work is unsafe in "
                 "forked workers — using threads (return numpy from "

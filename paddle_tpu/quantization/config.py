@@ -3,14 +3,15 @@ types to (activation, weight) quanter factories."""
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple, Type
+import itertools
+from typing import Dict, Optional, Tuple
 
 from ..nn.layer.layers import Layer
 from .factory import QuanterFactory
 
 __all__ = ["QuantConfig"]
 
-_DEFAULT_QUANTABLE: Tuple[str, ...] = ("Linear", "Conv2D")
+_config_ids = itertools.count()
 
 
 class QuantConfig:
@@ -18,18 +19,18 @@ class QuantConfig:
                  weight: Optional[QuanterFactory]):
         self._activation = activation
         self._weight = weight
-        self._layer_configs: List[Tuple[List[Layer], Optional[QuanterFactory],
-                                        Optional[QuanterFactory]]] = []
+        # per-instance stamps carry this token so (a) they survive
+        # quantize()'s deepcopy of the model and (b) a stamp written by one
+        # QuantConfig can never leak into another config's routing
+        self._token = next(_config_ids)
         self._type_configs: Dict[type, Tuple[Optional[QuanterFactory],
                                              Optional[QuanterFactory]]] = {}
 
     def add_layer_config(self, layer, activation=None, weight=None) -> None:
-        """Per-instance override (reference `config.py:99`). The config is
-        stamped ON the layer so it survives quantize()'s deepcopy."""
+        """Per-instance override (reference `config.py:99`)."""
         layers = layer if isinstance(layer, (list, tuple)) else [layer]
         for l in layers:
-            l._quant_config = (activation, weight)
-        self._layer_configs.append((list(layers), activation, weight))
+            l._quant_config = (self._token, activation, weight)
 
     def add_type_config(self, layer_type, activation=None, weight=None) -> None:
         """Per-class override (reference `config.py:196`)."""
@@ -38,16 +39,22 @@ class QuantConfig:
         for t in types:
             self._type_configs[t] = (activation, weight)
 
+    def _default_quantable(self, layer: Layer) -> bool:
+        from ..nn.layer.common import Linear
+        from ..nn.layer.conv import Conv1D, Conv2D, Conv3D
+
+        return isinstance(layer, (Linear, Conv1D, Conv2D, Conv3D))
+
     def _config_for(self, layer: Layer):
         """(activation_factory, weight_factory) or None when the layer is
         not quantized."""
         stamped = getattr(layer, "_quant_config", None)
-        if stamped is not None:
-            return stamped
+        if stamped is not None and stamped[0] == self._token:
+            return stamped[1], stamped[2]
         for t, (act, wt) in self._type_configs.items():
             if isinstance(layer, t):
                 return act, wt
-        if type(layer).__name__ in _DEFAULT_QUANTABLE and \
+        if self._default_quantable(layer) and \
                 (self._activation is not None or self._weight is not None):
             return self._activation, self._weight
         return None

@@ -58,13 +58,19 @@ class PTQ:
                         if q is not None and hasattr(q, "scales"):
                             scale = float(jnp.asarray(
                                 q.scales()._value).reshape(-1)[0])
+                            if scale <= 0.0:
+                                raise RuntimeError(
+                                    f"PTQ.convert: quanter '{qname}' of "
+                                    f"'{name}' saw no calibration data "
+                                    f"(scale is 0) — run forwards on a "
+                                    f"calibration set before convert()")
                             bits = getattr(q, "bit_length", 8)
-                            sub._sub_layers[qname] = _FrozenQuantDequant(
-                                scale, bits)
-                            if qname == "activation_quanter":
-                                sub._a = sub._sub_layers[qname]
-                            else:
-                                sub._w = sub._sub_layers[qname]
+                            frozen = _FrozenQuantDequant(scale, bits)
+                            sub._sub_layers[qname] = frozen
+                            object.__setattr__(
+                                sub,
+                                "_a" if qname == "activation_quanter"
+                                else "_w", frozen)
                 else:
                     visit(sub)
 

@@ -89,6 +89,11 @@ class _FakeQuanterAbsMaxLayer(Layer):
             return out
 
         frozen = scale_buf._value[0]
+        if not isinstance(frozen, jax.core.Tracer) and float(frozen) <= 0.0:
+            raise RuntimeError(
+                "fake quanter used in eval mode before any training/"
+                "calibration forward set its scale — the output would "
+                "collapse to ~0")
 
         def fn(xv):
             return _fake_quant(xv, frozen.astype(xv.dtype), qmax)

@@ -24,8 +24,11 @@ class QuantedLayer(Layer):
             self.add_sublayer("activation_quanter", a_quanter)
         if w_quanter is not None:
             self.add_sublayer("weight_quanter", w_quanter)
-        self._a = a_quanter
-        self._w = w_quanter
+        # bypass Layer.__setattr__: these are ALIASES of the registered
+        # sublayers above, not a second registration (a duplicate would
+        # double every quanter buffer in state_dict/sublayers())
+        object.__setattr__(self, "_a", a_quanter)
+        object.__setattr__(self, "_w", w_quanter)
 
     @property
     def wrapped(self) -> Layer:

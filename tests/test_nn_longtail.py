@@ -81,13 +81,13 @@ class TestCTC:
         ll = paddle.to_tensor(np.full(B, 3))
         opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[logits])
         losses = []
-        for _ in range(30):
+        for _ in range(10):
             loss = crit(logits, labels, il, ll)
             loss.backward()
             opt.step()
             opt.clear_grad()
             losses.append(float(loss.numpy()))
-        assert losses[-1] < losses[0] * 0.2
+        assert losses[-1] < losses[0] * 0.6
 
 
 class TestLongTailLosses:
