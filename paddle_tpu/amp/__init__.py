@@ -294,21 +294,22 @@ def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16"
                             jnp.issubdtype(v._value.dtype, jnp.floating) and \
                             v._value.dtype != _dt:
                         return v.astype(_dt)
+                    if isinstance(v, tuple) and hasattr(v, "_fields"):
+                        return type(v)(*(_cast(o) for o in v))  # namedtuple
                     if isinstance(v, (list, tuple)):
                         return type(v)(_cast(o) for o in v)
                     if isinstance(v, dict):
                         return {k: _cast(o) for k, o in v.items()}
                     return v
 
-                # NOTE: binds THIS instance; deepcopying a decorated model
-                # keeps calling the original's forward — decorate the copy
-                # instead of copying the decorated model
-                orig_forward = model.forward
-
-                def _o2_forward(*args, **kwargs):
-                    return orig_forward(*_cast(list(args)),
-                                        **{k: _cast(v)
-                                           for k, v in kwargs.items()})
+                # NOTE: binds THIS instance's forward (via the default arg so
+                # a multi-model decorate doesn't share one closure cell);
+                # deepcopying a decorated model keeps calling the original's
+                # forward — decorate the copy instead of copying the
+                # decorated model
+                def _o2_forward(*args, _fwd=model.forward, **kwargs):
+                    return _fwd(*_cast(list(args)),
+                                **{k: _cast(v) for k, v in kwargs.items()})
 
                 object.__setattr__(model, "forward", _o2_forward)
                 object.__setattr__(model, "_amp_o2_wrapped", True)

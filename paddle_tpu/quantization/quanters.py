@@ -89,11 +89,16 @@ class _FakeQuanterAbsMaxLayer(Layer):
             return out
 
         frozen = scale_buf._value[0]
-        if not isinstance(frozen, jax.core.Tracer) and float(frozen) <= 0.0:
-            raise RuntimeError(
-                "fake quanter used in eval mode before any training/"
-                "calibration forward set its scale — the output would "
-                "collapse to ~0")
+        # one concrete host read per quanter, not per call (the scale is
+        # frozen in eval mode)
+        if not getattr(self, "_scale_checked", False) and \
+                not isinstance(frozen, jax.core.Tracer):
+            if float(frozen) <= 0.0:
+                raise RuntimeError(
+                    "fake quanter used in eval mode before any training/"
+                    "calibration forward set its scale — the output would "
+                    "collapse to ~0")
+            object.__setattr__(self, "_scale_checked", True)
 
         def fn(xv):
             return _fake_quant(xv, frozen.astype(xv.dtype), qmax)
