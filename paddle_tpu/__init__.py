@@ -49,6 +49,7 @@ from . import hub  # noqa: E402
 from . import geometric  # noqa: E402
 from . import signal  # noqa: E402
 from . import sparse  # noqa: E402
+from . import inference  # noqa: E402
 from . import onnx  # noqa: E402
 from . import quantization  # noqa: E402
 from . import static  # noqa: E402

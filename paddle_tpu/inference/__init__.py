@@ -1,0 +1,168 @@
+"""paddle.inference parity surface (reference `python/paddle/inference/` +
+`paddle/fluid/inference/api/analysis_predictor.h:100`).
+
+The reference's AnalysisPredictor loads a saved program, runs an IR-pass
+pipeline and serves ZeroCopyTensor handles. The TPU-native serving engine is
+the StableHLO artifact written by ``jit.save`` (or
+``onnx.export(format="stablehlo")``), executed by ``jit.load``'s
+TranslatedLayer; this module offers the reference's handle-based predictor
+API on top of it:
+
+    config = paddle.inference.Config(path)      # the jit.save prefix
+    predictor = paddle.inference.create_predictor(config)
+    inp = predictor.get_input_handle(predictor.get_input_names()[0])
+    inp.copy_from_cpu(batch_np)
+    predictor.run()
+    out = predictor.get_output_handle(predictor.get_output_names()[0])
+    result = out.copy_to_cpu()
+
+GPU/TRT/MKLDNN toggles are accepted and recorded but are no-ops: on TPU the
+XLA pipeline replaces the IR-pass/TensorRT machinery wholesale.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "create_predictor", "PrecisionType"]
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Half = "float16"
+    Bfloat16 = "bfloat16"
+    Int8 = "int8"
+
+
+class Config:
+    """reference `paddle.inference.Config` shape: holds the model path and
+    accepted-but-inert device/optimization knobs."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        # jit.save writes <prefix>.pdmodel/<prefix>.pdiparams; accept either
+        # the prefix or the .pdmodel path
+        path = prog_file or ""
+        for suffix in (".pdmodel", ".pdiparams"):
+            if path.endswith(suffix):
+                path = path[: -len(suffix)]
+        self._path = path
+        if params_file is not None:
+            expected = path + ".pdiparams"
+            if params_file != expected:
+                raise ValueError(
+                    f"params_file must be the prefix's sidecar "
+                    f"({expected!r}); jit.save writes both files under one "
+                    f"prefix, got {params_file!r}")
+        self._enable_memory_optim = True
+        self._device = "tpu"
+
+    def model_path(self) -> str:
+        return self._path
+
+    # accepted no-op knobs (the XLA pipeline subsumes them)
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
+                       precision=PrecisionType.Float32) -> None:
+        self._device = "gpu"
+
+    def disable_gpu(self) -> None:
+        self._device = "cpu"
+
+    def enable_memory_optim(self, x: bool = True) -> None:
+        self._enable_memory_optim = x
+
+    def enable_mkldnn(self) -> None:
+        pass
+
+    def enable_tensorrt_engine(self, *a, **k) -> None:
+        pass
+
+    def switch_ir_optim(self, x: bool = True) -> None:
+        pass
+
+    def set_cpu_math_library_num_threads(self, n: int) -> None:
+        pass
+
+
+class _Handle:
+    """ZeroCopyTensor-shaped handle (copy_from_cpu / copy_to_cpu / shape)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._data: Optional[np.ndarray] = None
+
+    def copy_from_cpu(self, data) -> None:
+        # a real COPY (reference ZeroCopyTensor contract): the caller may
+        # reuse its batch buffer after this call
+        self._data = np.array(data)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._data is None:
+            raise RuntimeError(f"handle '{self.name}' holds no data yet")
+        return self._data
+
+    def shape(self) -> List[int]:
+        return [] if self._data is None else list(self._data.shape)
+
+    def reshape(self, shape) -> None:
+        if self._data is not None:
+            self._data = self._data.reshape(shape)
+
+
+class Predictor:
+    """Handle-based predictor over a ``jit.load``-ed StableHLO program."""
+
+    def __init__(self, config: Config):
+        from ..jit import load as jit_load
+
+        self._config = config
+        self._layer = jit_load(config.model_path())
+        if not callable(self._layer):
+            raise ValueError(
+                f"{config.model_path()!r} has no .pdmodel program (jit.save "
+                f"was called without input_spec, leaving only the params "
+                f"sidecar) — re-export with input_spec so the serving graph "
+                f"is serialized")
+        exported = getattr(self._layer, "_exported", None)
+        n_in = len(exported.in_avals) if exported is not None and \
+            hasattr(exported, "in_avals") else 1
+        self._input_names = [f"input_{i}" for i in range(max(1, n_in))]
+        self._inputs: Dict[str, _Handle] = {
+            n: _Handle(n) for n in self._input_names}
+        self._outputs: Dict[str, _Handle] = {}
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> _Handle:
+        return self._inputs[name]
+
+    def run(self) -> None:
+        from ..tensor.tensor import Tensor
+
+        args = []
+        for n in self._input_names:
+            h = self._inputs[n]
+            if h._data is None:
+                raise RuntimeError(f"input '{n}' not set; call "
+                                   f"copy_from_cpu first")
+            args.append(Tensor(np.asarray(h._data)))
+        out = self._layer(*args)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        self._outputs = {}
+        for i, o in enumerate(outs):
+            h = _Handle(f"output_{i}")
+            h._data = np.asarray(o.numpy() if hasattr(o, "numpy") else o)
+            self._outputs[h.name] = h
+
+    def get_output_names(self) -> List[str]:
+        return list(self._outputs)
+
+    def get_output_handle(self, name: str) -> _Handle:
+        return self._outputs[name]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -1,0 +1,57 @@
+"""paddle.inference predictor tests (SURVEY N18 capability: reference
+`inference/api/analysis_predictor.h:100` handle-based serving, here over the
+jit.save StableHLO artifact)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.inference import Config, PrecisionType, create_predictor
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+    path = str(tmp_path_factory.mktemp("pred") / "net")
+    paddle.jit.save(model, path, input_spec=[paddle.jit.InputSpec([2, 8])])
+    return path, model
+
+
+class TestPredictor:
+    def test_handle_roundtrip_matches_layer(self, saved_model, rng):
+        path, model = saved_model
+        predictor = create_predictor(Config(path))
+        names = predictor.get_input_names()
+        assert names == ["input_0"]
+        x = rng.standard_normal((2, 8)).astype(np.float32)
+        h = predictor.get_input_handle(names[0])
+        h.copy_from_cpu(x)
+        assert h.shape() == [2, 8]
+        predictor.run()
+        out_names = predictor.get_output_names()
+        assert out_names == ["output_0"]
+        out = predictor.get_output_handle(out_names[0]).copy_to_cpu()
+        np.testing.assert_allclose(
+            out, model(paddle.to_tensor(x)).numpy(), rtol=1e-5)
+
+    def test_config_accepts_pdmodel_suffix_and_knobs(self, saved_model):
+        path, _ = saved_model
+        cfg = Config(path + ".pdmodel")
+        assert cfg.model_path() == path
+        cfg.enable_memory_optim()
+        cfg.enable_mkldnn()
+        cfg.switch_ir_optim(False)
+        cfg.enable_use_gpu(100, 0, PrecisionType.Half)  # inert on TPU
+        predictor = create_predictor(cfg)
+        assert predictor.get_input_names()
+
+    def test_errors(self, saved_model):
+        path, _ = saved_model
+        predictor = create_predictor(Config(path))
+        with pytest.raises(RuntimeError, match="not set"):
+            predictor.run()
+        h = predictor.get_input_handle("input_0")
+        with pytest.raises(RuntimeError, match="holds no data"):
+            h.copy_to_cpu()
