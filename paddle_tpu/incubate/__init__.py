@@ -7,6 +7,8 @@ namespace keeps the reference's import paths working."""
 
 from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
+from . import asp  # noqa: F401
+from . import autotune  # noqa: F401
 
 # reference exposes paddle.incubate.softmax_mask_fuse upcast variants etc.
 # at top level; the fused functional surface lives in incubate.nn.functional.

@@ -1,0 +1,47 @@
+"""Auto-tuning config (reference `python/paddle/incubate/autotune.py:24`).
+
+The reference's kernel autotune exhaustively searches cuDNN algorithms and
+caches winners; on TPU that search IS the XLA/Mosaic compiler's job
+(autotuned while lowering). `set_config` therefore validates and RECORDS
+the knobs for API parity — every section is inert at runtime, which is the
+honest TPU translation (there is no cuDNN-style algorithm choice to make;
+`get_config` exposes what was set)."""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+__all__ = ["set_config", "get_config"]
+
+_config = {
+    "kernel": {"enable": False, "tuning_range": [1, 10]},
+    "layout": {"enable": False},
+    "dataloader": {"enable": False},
+}
+
+
+def set_config(config: Optional[dict] = None) -> None:
+    """Accepts the reference's dict or a JSON file path."""
+    if config is None:
+        for section in _config.values():
+            section["enable"] = True
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    if not isinstance(config, dict):
+        raise TypeError("config must be None, a dict, or a JSON file path")
+    for key, val in config.items():
+        if key not in _config:
+            raise ValueError(f"unknown autotune section {key!r}; "
+                             f"known: {sorted(_config)}")
+        unknown = set(val) - set(_config[key])
+        if unknown:
+            raise ValueError(f"unknown key(s) {sorted(unknown)} in autotune "
+                             f"section {key!r}; known: {sorted(_config[key])}")
+        _config[key].update(val)
+
+
+def get_config() -> dict:
+    return {k: dict(v) for k, v in _config.items()}

@@ -306,3 +306,85 @@ class TestMoEUtils:
         assert buf.shape == [2, 2, 2]  # third expert-0 token dropped
         back = global_gather(buf, local, g)
         np.testing.assert_allclose(back.numpy()[2], 0.0)  # dropped → zeros
+
+
+class TestASP:
+    """incubate.asp 2:4 sparsity (reference `incubate/asp/asp.py:216,302`)."""
+
+    def setup_method(self):
+        from paddle_tpu.incubate.asp import ASPHelper
+
+        ASPHelper.reset()
+
+    def test_mask_1d_properties(self):
+        from paddle_tpu.incubate import asp
+
+        rng = np.random.default_rng(0)
+        w = rng.standard_normal((8, 16)).astype(np.float32)
+        mask = asp.get_mask_1d(w, 2, 4)
+        assert asp.check_mask_1d(mask, 2, 4)
+        assert asp.calculate_density(w * mask) == pytest.approx(0.5)
+        # kept entries are each group's two largest magnitudes
+        g = np.abs(w[0, :4])
+        kept = mask[0, :4].astype(bool)
+        assert set(np.argsort(g)[-2:]) == set(np.nonzero(kept)[0])
+
+    def test_prune_model_and_density(self):
+        from paddle_tpu.incubate import asp
+
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+        asp.prune_model(m)
+        for layer in (m[0], m[2]):
+            assert asp.calculate_density(layer.weight) == pytest.approx(0.5)
+            assert asp.check_mask_1d(layer.weight.numpy(), 2, 4)
+
+    def test_decorated_optimizer_keeps_pattern(self):
+        from paddle_tpu.incubate import asp
+
+        paddle.seed(1)
+        m = nn.Sequential(nn.Linear(16, 32), nn.Tanh(), nn.Linear(32, 4))
+        opt = paddle.optimizer.Adam(1e-2, parameters=m.parameters())
+        asp.prune_model(m)
+        opt = asp.decorate(opt)
+        rng = np.random.default_rng(2)
+        x = paddle.to_tensor(rng.standard_normal((8, 16)).astype(np.float32))
+        y = paddle.to_tensor(rng.standard_normal((8, 4)).astype(np.float32))
+        import paddle_tpu.nn.functional as F
+
+        losses = []
+        for _ in range(5):
+            loss = F.mse_loss(m(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+        # the 2:4 pattern survived training
+        assert asp.check_mask_1d(m[0].weight.numpy(), 2, 4)
+        assert asp.calculate_density(m[0].weight) == pytest.approx(0.5)
+
+    def test_excluded_layers(self):
+        from paddle_tpu.incubate import asp
+
+        m = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))
+        asp.set_excluded_layers(["0"])
+        asp.prune_model(m)
+        assert asp.calculate_density(m[0].weight) == 1.0
+        assert asp.calculate_density(m[1].weight) == pytest.approx(0.5)
+        asp.reset_excluded_layers()
+
+
+class TestAutotune:
+    def test_set_config_forms(self, tmp_path):
+        from paddle_tpu.incubate import autotune
+
+        autotune.set_config({"kernel": {"enable": True,
+                                        "tuning_range": [1, 3]}})
+        assert autotune.get_config()["kernel"]["enable"] is True
+        p = tmp_path / "at.json"
+        p.write_text('{"dataloader": {"enable": true}}')
+        autotune.set_config(str(p))
+        assert autotune.get_config()["dataloader"]["enable"] is True
+        with pytest.raises(ValueError, match="unknown autotune section"):
+            autotune.set_config({"nope": {}})
