@@ -25,3 +25,4 @@ from .store import TCPKVStore, TCPStore, rendezvous  # noqa: F401
 from .watchdog import CommWatchdog  # noqa: F401
 from .topology import (CommGroup, HybridCommunicateGroup, build_mesh,  # noqa: F401
                        get_hybrid_communicate_group, set_hybrid_communicate_group)
+from . import rpc  # noqa: E402,F401

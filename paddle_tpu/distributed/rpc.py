@@ -1,0 +1,252 @@
+"""paddle.distributed.rpc (reference `python/paddle/distributed/rpc/rpc.py`
+— brpc-backed worker-to-worker python RPC; SURVEY N23).
+
+TPU-native translation: every worker runs a small threaded RPC server;
+workers discover each other through the job's TCPStore (the same rendezvous
+medium the launcher uses, `distributed/store.py`) and exchange
+length-prefixed pickled (fn, args, kwargs) calls over raw sockets —
+matching the reference's semantics (it likewise ships pickled python
+between trusted job workers; this is an intra-job control channel, not an
+open endpoint).
+
+    rpc.init_rpc("worker0", rank=0, world_size=2, master_endpoint="ip:port")
+    fut = rpc.rpc_async("worker1", max, args=(3, 5))
+    assert fut.wait() == 5
+    rpc.shutdown()
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+from .store import TCPStore, _recv_exact, rendezvous
+
+__all__ = ["init_rpc", "shutdown", "rpc_sync", "rpc_async",
+           "get_worker_info", "get_all_worker_infos",
+           "get_current_worker_info", "WorkerInfo"]
+
+_DEFAULT_RPC_TIMEOUT = 30.0
+
+
+class WorkerInfo:
+    """reference `rpc.py` WorkerInfo(name, rank, ip, port)."""
+
+    def __init__(self, name: str, rank: int, ip: str, port: int):
+        self.name, self.rank, self.ip, self.port = name, rank, ip, port
+
+    def __repr__(self):
+        return (f"WorkerInfo(name={self.name!r}, rank={self.rank}, "
+                f"ip={self.ip!r}, port={self.port})")
+
+
+class _State:
+    store: Optional[TCPStore] = None
+    server: Optional[socket.socket] = None
+    server_thread: Optional[threading.Thread] = None
+    pool: Optional[ThreadPoolExecutor] = None
+    client_pool: Optional[ThreadPoolExecutor] = None
+    current: Optional[WorkerInfo] = None
+    workers: Dict[str, WorkerInfo] = {}
+    stop = threading.Event()
+
+
+def _send_blob(sock: socket.socket, blob: bytes) -> None:
+    sock.sendall(struct.pack("!Q", len(blob)) + blob)
+
+
+def _recv_blob(sock: socket.socket) -> bytes:
+    (n,) = struct.unpack("!Q", _recv_exact(sock, 8))
+    return _recv_exact(sock, n)
+
+
+def _serve(conn: socket.socket) -> None:
+    try:
+        with conn:
+            blob = _recv_blob(conn)
+            fn, args, kwargs = pickle.loads(blob)
+            try:
+                result = ("ok", fn(*args, **kwargs))
+            except BaseException as e:  # ship the failure to the caller
+                result = ("err", e)
+            try:
+                payload = pickle.dumps(result,
+                                       protocol=pickle.HIGHEST_PROTOCOL)
+            except Exception as e:  # unpicklable result/exception: describe it
+                payload = pickle.dumps(
+                    ("err", RuntimeError(
+                        f"rpc result not picklable: {e!r} (result was "
+                        f"{type(result[1]).__name__})")))
+            _send_blob(conn, payload)
+    except (OSError, ConnectionError):
+        pass  # caller gone / shutdown race
+
+
+def _server_loop(srv: socket.socket, pool: ThreadPoolExecutor) -> None:
+    while not _State.stop.is_set():
+        try:
+            conn, _ = srv.accept()
+        except OSError:
+            return  # socket closed by shutdown()
+        pool.submit(_serve, conn)
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None) -> None:
+    """Register this worker and discover the others (reference `rpc.py:73`;
+    env defaults PADDLE_WORKER_NAME/PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM/
+    PADDLE_MASTER_ENDPOINT honored like the reference)."""
+    import os
+
+    if _State.current is not None:
+        raise RuntimeError("init_rpc already called; shutdown() first")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", -1)) if rank is None else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 0)) \
+        if world_size is None else world_size
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER_ENDPOINT")
+    if not master_endpoint or world_size <= 0:
+        raise ValueError("init_rpc needs world_size and master_endpoint")
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("0.0.0.0", 0))
+    srv.listen(64)
+    port = srv.getsockname()[1]
+
+    store = None
+    try:
+        store, node_rank = rendezvous(
+            master_endpoint, world_size, job_id="rpc",
+            node_rank=None if rank is None or rank < 0 else rank)
+        ip = socket.gethostbyname(socket.gethostname())
+        info = WorkerInfo(name, node_rank, ip, port)
+        store.set(f"rpc/worker/{name}",
+                  pickle.dumps((name, node_rank, ip, port)))
+        # wait until every worker published, then snapshot the directory
+        import time
+
+        t0 = time.time()
+        while True:
+            keys = list(store.keys("rpc/worker/"))
+            if len(keys) >= world_size:
+                break
+            if time.time() - t0 > _DEFAULT_RPC_TIMEOUT * 10:
+                raise TimeoutError(f"only {len(keys)}/{world_size} rpc "
+                                   f"workers registered")
+            time.sleep(0.05)
+        workers = {}
+        for k in keys:
+            wname, wrank, wip, wport = pickle.loads(store.get(k))
+            workers[wname] = WorkerInfo(wname, wrank, wip, wport)
+    except BaseException:
+        # failed mid-init: nothing is published to _State, so shutdown()
+        # would be a no-op — release the bound socket/store here
+        srv.close()
+        if store is not None:
+            store.close()
+        raise
+
+    _State.stop.clear()
+    _State.store = store
+    _State.server = srv
+    # separate pools: blocked outbound client calls must never starve the
+    # threads that serve INCOMING requests (mutual-callback deadlock)
+    _State.pool = ThreadPoolExecutor(max_workers=8,
+                                     thread_name_prefix="paddle-rpc-srv")
+    _State.client_pool = ThreadPoolExecutor(
+        max_workers=8, thread_name_prefix="paddle-rpc-cli")
+    _State.current = info
+    _State.workers = workers
+    _State.server_thread = threading.Thread(
+        target=_server_loop, args=(srv, _State.pool), daemon=True)
+    _State.server_thread.start()
+
+
+def _call(to: str, fn, args, kwargs, timeout: float):
+    try:
+        target = _State.workers[to]
+    except KeyError:
+        raise ValueError(f"unknown rpc worker {to!r}; known: "
+                         f"{sorted(_State.workers)}")
+    with socket.create_connection((target.ip, target.port),
+                                  timeout=timeout) as sock:
+        sock.settimeout(timeout)
+        _send_blob(sock, pickle.dumps((fn, tuple(args or ()), kwargs or {}),
+                                      protocol=pickle.HIGHEST_PROTOCOL))
+        status, payload = pickle.loads(_recv_blob(sock))
+    if status == "err":
+        raise payload
+    return payload
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None,
+             timeout: float = _DEFAULT_RPC_TIMEOUT):
+    """Blocking call on worker ``to`` (reference `rpc.py:143`)."""
+    if _State.current is None:
+        raise RuntimeError("call init_rpc first")
+    return _call(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None,
+              timeout: float = _DEFAULT_RPC_TIMEOUT) -> Future:
+    """Future-returning call (reference `rpc.py:183`; ``.wait()`` like the
+    reference's FutureWrapper)."""
+    if _State.current is None:
+        raise RuntimeError("call init_rpc first")
+    fut = _State.client_pool.submit(_call, to, fn, args, kwargs, timeout)
+    fut.wait = fut.result  # reference FutureWrapper API
+    return fut
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    return _State.workers[name]
+
+
+def get_all_worker_infos() -> List[WorkerInfo]:
+    return sorted(_State.workers.values(), key=lambda w: w.rank)
+
+
+def get_current_worker_info() -> WorkerInfo:
+    if _State.current is None:
+        raise RuntimeError("call init_rpc first")
+    return _State.current
+
+
+def shutdown() -> None:
+    """Barrier with the other workers, then tear the server down (reference
+    `rpc.py:278` performs the same world-synchronized exit)."""
+    if _State.current is None:
+        return
+    import time
+
+    try:
+        _State.store.add("rpc/shutdown", 1)
+        t0 = time.time()
+        # add(, 0) reads the counter without bumping it
+        while _State.store.add("rpc/shutdown", 0) < len(_State.workers):
+            if time.time() - t0 > _DEFAULT_RPC_TIMEOUT:
+                break
+            time.sleep(0.05)
+    except Exception:
+        pass
+    _State.stop.set()
+    try:
+        _State.server.close()
+    except OSError:
+        pass
+    _State.pool.shutdown(wait=False)
+    if _State.client_pool is not None:
+        _State.client_pool.shutdown(wait=False)
+    try:
+        _State.store.close()
+    except Exception:
+        pass
+    _State.current = None
+    _State.workers = {}
+    _State.store = None

@@ -36,6 +36,9 @@ def set_config(config: Optional[dict] = None) -> None:
         if key not in _config:
             raise ValueError(f"unknown autotune section {key!r}; "
                              f"known: {sorted(_config)}")
+        if not isinstance(val, dict):
+            raise ValueError(f"autotune section {key!r} must map to a dict "
+                             f"of options, got {type(val).__name__}")
         unknown = set(val) - set(_config[key])
         if unknown:
             raise ValueError(f"unknown key(s) {sorted(unknown)} in autotune "

@@ -255,6 +255,12 @@ class TrainStep:
         self._buffers = [b for _, b in model.named_buffers()]
         self._lr_mults = [getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
                           for p in self._params]
+        # ASP (incubate.asp): pruned params carry n:m masks that must be
+        # re-applied after every update — the eager path does it via the
+        # decorated optimizer.step, which this fused step never calls
+        from ..incubate.asp import ASPHelper
+
+        self._asp_masks = [ASPHelper._masks.get(id(p)) for p in self._params]
         self._compiled = jax.jit(self._step,
                                  donate_argnums=(0, 1) if donate else ())
         # FLAGS_check_nan_inf variant: same step + per-grad finite flags
@@ -352,13 +358,21 @@ class TrainStep:
         new_params, new_states = [], []
         for i, (p_arr, g, st) in enumerate(zip(compute_params, grads, opt_states)):
             if not self._trainable[i]:
-                new_params.append(p_arr)
+                if masters[i] is not None:
+                    # frozen low-precision param: restore the popped master
+                    # slot so the state pytree keeps its structure (pjit
+                    # out_shardings include @master for every bf16 param)
+                    st = dict(st)
+                    st["@master"] = masters[i]
+                new_params.append(param_arrays[i])
                 new_states.append(st)
                 continue
             np_, ns = self.optimizer._update_rule(
                 p_arr, g.astype(p_arr.dtype), st, lr * self._lr_mults[i],
                 param_meta=self._params[i])
             ns = {**st, **ns}  # keep untouched slots: stable state pytree
+            if self._asp_masks[i] is not None:
+                np_ = np_ * self._asp_masks[i].astype(np_.dtype)
             if masters[i] is not None:
                 ns = dict(ns)
                 ns["@master"] = np_

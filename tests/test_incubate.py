@@ -388,3 +388,24 @@ class TestAutotune:
         assert autotune.get_config()["dataloader"]["enable"] is True
         with pytest.raises(ValueError, match="unknown autotune section"):
             autotune.set_config({"nope": {}})
+
+    def test_pattern_survives_compiled_train_step(self):
+        """The fused TrainStep never calls optimizer.step, so masks are
+        re-applied inside the compiled update (review regression)."""
+        from paddle_tpu.incubate import asp
+
+        paddle.seed(2)
+        m = nn.Sequential(nn.Linear(16, 32), nn.Tanh(), nn.Linear(32, 4))
+        opt = paddle.optimizer.Adam(1e-2, parameters=m.parameters())
+        asp.prune_model(m)
+        import paddle_tpu.nn.functional as F
+
+        step = paddle.jit.TrainStep(
+            m, lambda mm, a, b: F.mse_loss(mm(a), b), opt)
+        rng = np.random.default_rng(4)
+        x = paddle.to_tensor(rng.standard_normal((8, 16)).astype(np.float32))
+        y = paddle.to_tensor(rng.standard_normal((8, 4)).astype(np.float32))
+        losses = [float(step(x, y).numpy()) for _ in range(4)]
+        assert losses[-1] < losses[0]
+        assert asp.check_mask_1d(m[0].weight.numpy(), 2, 4)
+        assert asp.calculate_density(m[0].weight) == pytest.approx(0.5)

@@ -1,0 +1,76 @@
+"""paddle.distributed.rpc tests (SURVEY N23: reference
+`distributed/rpc/rpc.py` — init_rpc / rpc_sync / rpc_async / worker infos /
+synchronized shutdown), run as two real processes on localhost."""
+
+import multiprocessing as mp
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import paddle_tpu.distributed.rpc as rpc
+
+    def add(a, b):
+        return a + b
+
+    def whoami():
+        return rpc.get_current_worker_info().name
+
+    def boom():
+        raise ValueError("remote boom")
+
+    name = sys.argv[1]
+    endpoint = sys.argv[2]
+    rpc.init_rpc(name, rank=int(sys.argv[3]), world_size=2,
+                 master_endpoint=endpoint)
+    infos = rpc.get_all_worker_infos()
+    assert [w.name for w in infos] == ["worker0", "worker1"], infos
+    peer = "worker1" if name == "worker0" else "worker0"
+    assert rpc.rpc_sync(peer, add, args=(2, 3)) == 5
+    fut = rpc.rpc_async(peer, whoami)
+    assert fut.wait() == peer
+    try:
+        rpc.rpc_sync(peer, boom)
+        raise SystemExit("expected remote exception")
+    except ValueError as e:
+        assert "remote boom" in str(e)
+    assert rpc.get_worker_info(peer).rank != rpc.get_current_worker_info().rank
+    rpc.shutdown()
+    print("RPC_OK", name)
+""")
+
+
+@pytest.mark.slow
+def test_two_worker_rpc(tmp_path):
+    script = tmp_path / "rpc_worker.py"
+    script.write_text(WORKER)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"}
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), f"worker{i}", f"127.0.0.1:{port}",
+         str(i)], env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for i in range(2)]
+    outs = [p.communicate(timeout=120)[0].decode() for p in procs]
+    assert all(p.returncode == 0 for p in procs), outs
+    for i, out in enumerate(outs):
+        assert f"RPC_OK worker{i}" in out, out
+
+
+def test_errors_without_init():
+    import paddle_tpu.distributed.rpc as rpc
+
+    with pytest.raises(RuntimeError, match="init_rpc"):
+        rpc.rpc_sync("nobody", max, args=(1, 2))
+    with pytest.raises(RuntimeError, match="init_rpc"):
+        rpc.get_current_worker_info()
+    rpc.shutdown()  # no-op before init
