@@ -70,11 +70,32 @@ def ulysses_attention(q, k, v, mesh: Optional[Mesh] = None, sep_axis: str = "sep
             f"divisible by the sep degree ({n}) — the head-sharded phase "
             "splits both")
 
+    from ...ops import pallas_eligible, pallas_interpret_mode
+    from ...ops.sharded import mesh_ulysses_flash, mesh_ulysses_flash_supported
+
     _U = P.UNCONSTRAINED
     # only the swapped dim is pinned: batch/head/feature dims keep whatever
     # sharding the surrounding program gives them (dp/tp must survive)
     seq_spec = P(_U, sep_axis, _U, _U)
     head_spec = P(_U, _U, sep_axis, _U)
+
+    if n > 1 and pallas_eligible("use_flash_attention") and \
+            mesh_ulysses_flash_supported(mesh, q.shape, k.shape,
+                                         has_mask=False, dropout_p=0.0,
+                                         causal=is_causal, sep_axis=sep_axis):
+        interp = pallas_interpret_mode()
+
+        def flash_fn(qv, kv, vv):
+            out = mesh_ulysses_flash(qv, kv, vv, mesh, causal=is_causal,
+                                     scale=scale, interpret=interp,
+                                     sep_axis=sep_axis)
+            try:  # hand the result back seq-sharded for the surrounding code
+                return jax.lax.with_sharding_constraint(
+                    out, NamedSharding(mesh, seq_spec))
+            except (ValueError, TypeError):
+                return out
+
+        return apply_op("ulysses_flash_attention", flash_fn, (q, k, v))
 
     def fn(qv, kv, vv):
         from ...ops.attention import sdpa_reference

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 __all__ = ["active_mesh", "mesh_flash_supported", "mesh_flash_attention",
+           "mesh_ulysses_flash_supported", "mesh_ulysses_flash",
            "mesh_rms_norm_supported", "mesh_rms_norm",
            "mesh_rope_supported", "mesh_rope"]
 
@@ -145,6 +146,69 @@ def mesh_flash_attention(q, k, v, mesh: Mesh, *, causal: bool = False,
         def body(ql, kl, vl):
             return flash_attention(ql, kl, vl, scale, causal, bq, bk,
                                    interpret)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses flash attention (head-sharded phase)
+# ---------------------------------------------------------------------------
+def _ulysses_heads(mesh: Mesh, sep_axis: str) -> Tuple[str, ...]:
+    return tuple(a for a in ("model", sep_axis) if _size(mesh, a) > 1)
+
+
+def _ulysses_spec(mesh: Mesh, sep_axis: str) -> P:
+    """Attention-phase layout [b, s, h, d]: FULL sequence per device, heads
+    sharded over model×sep. Entering a shard_map with this spec from
+    seq-sharded activations IS the Ulysses all-to-all (GSPMD emits it), and
+    leaving through a seq-sharded constraint is the second one."""
+    return P(_dim_entry(_batch_axes(mesh)), None,
+             _dim_entry(_ulysses_heads(mesh, sep_axis)), None)
+
+
+def _ulysses_local_shapes(mesh, q_shape, k_shape, sep_axis):
+    b, sq, hq, d = q_shape
+    _, sk, hkv, _ = k_shape
+    dp = math.prod(_size(mesh, a) for a in _batch_axes(mesh)) or 1
+    hdeg = math.prod(_size(mesh, a) for a in _ulysses_heads(mesh, sep_axis)) or 1
+    if b % dp or hq % hdeg or hkv % hdeg:
+        return None
+    return ((b // dp, sq, hq // hdeg, d), (b // dp, sk, hkv // hdeg, d))
+
+
+def mesh_ulysses_flash_supported(mesh: Mesh, q_shape, k_shape, *,
+                                 has_mask: bool, dropout_p: float,
+                                 causal: bool, sep_axis: str = "sep") -> bool:
+    from .pallas import flash_attention_supported
+
+    local = _ulysses_local_shapes(mesh, q_shape, k_shape, sep_axis)
+    if local is None:
+        return False
+    lq, lk = local
+    bq, bk = _auto_block(lq[1]), _auto_block(lk[1])
+    if bq is None or bk is None:
+        return False
+    return flash_attention_supported(lq, lk, has_mask=has_mask,
+                                     dropout_p=dropout_p, causal=causal,
+                                     block_q=bq, block_k=bk)
+
+
+def mesh_ulysses_flash(q, k, v, mesh: Mesh, *, causal: bool = False,
+                       scale: Optional[float] = None,
+                       interpret: bool = False, sep_axis: str = "sep"):
+    """GLOBAL [b, s, h, d] → global out with the Pallas flash kernel running
+    on full sequences for the local head subset (the Ulysses attention
+    phase); the head↔seq all-to-alls fall out of the spec transitions."""
+    from .pallas import flash_attention
+
+    spec = _ulysses_spec(mesh, sep_axis)
+    lq, lk = _ulysses_local_shapes(mesh, q.shape, k.shape, sep_axis)
+    bq, bk = _auto_block(lq[1]), _auto_block(lk[1])
+
+    def body(ql, kl, vl):
+        return flash_attention(ql, kl, vl, scale, causal, bq, bk, interpret)
 
     fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
