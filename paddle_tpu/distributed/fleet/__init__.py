@@ -102,7 +102,8 @@ class Fleet:
             # accumulate grads before the single update (reference
             # `passes/auto_parallel_gradient_merge.py`)
             cfg = st.gradient_merge_configs or {}
-            optimizer._gradient_merge_k = int(cfg.get("k_steps", 2))
+            # reference default is k_steps=1 (a no-op until configured)
+            optimizer._gradient_merge_k = int(cfg.get("k_steps", 1))
             optimizer._gradient_merge_avg = bool(cfg.get("avg", True))
         return optimizer
 

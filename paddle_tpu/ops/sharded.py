@@ -204,8 +204,18 @@ def mesh_ulysses_flash(q, k, v, mesh: Mesh, *, causal: bool = False,
     from .pallas import flash_attention
 
     spec = _ulysses_spec(mesh, sep_axis)
-    lq, lk = _ulysses_local_shapes(mesh, q.shape, k.shape, sep_axis)
+    local = _ulysses_local_shapes(mesh, q.shape, k.shape, sep_axis)
+    if local is None:
+        raise ValueError(
+            f"Ulysses flash needs batch divisible by the data degree and "
+            f"q/kv heads divisible by model*{sep_axis}; got q{tuple(q.shape)} "
+            f"k{tuple(k.shape)} on mesh {dict(mesh.shape)} — check "
+            f"mesh_ulysses_flash_supported first")
+    lq, lk = local
     bq, bk = _auto_block(lq[1]), _auto_block(lk[1])
+    if bq is None or bk is None:
+        raise ValueError(f"sequence lengths {lq[1]}/{lk[1]} are not "
+                         f"8-aligned for the flash kernel tiling")
 
     def body(ql, kl, vl):
         return flash_attention(ql, kl, vl, scale, causal, bq, bk, interpret)

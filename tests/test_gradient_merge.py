@@ -154,17 +154,58 @@ class TestDistributedMergeAndOffload:
                   for _ in range(4)]
         assert losses[-1] < losses[0]
 
-    def test_offload_shardings_request_pinned_host_when_supported(self, hcg,
-                                                                  monkeypatch):
-        """Force the support probe on: the state shardings must carry the
-        pinned_host memory kind (the actual TPU offload layout). Placement
-        fails at device_put on CPU only for the compile step, so probe the
-        sharding objects directly."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
+    def test_frozen_bf16_param_with_multi_precision(self, hcg):
+        """Review regression: a frozen (stop_gradient) bf16 param under
+        multi_precision used to desync the state pytree (@master popped but
+        not restored) and crash pjit; it must train, keep the frozen param
+        bit-identical, and keep its dtype."""
+        import jax.numpy as jnp
+
+        m = _mlp(13)
+        first = m[0]
+        first.weight._value = first.weight._value.astype(jnp.bfloat16)
+        first.weight.stop_gradient = True
+        o = paddle.optimizer.AdamW(1e-2, parameters=m.parameters(),
+                                   multi_precision=True)
+        from paddle_tpu.distributed import DistributedTrainStep
+
+        def loss_fn(mm, a, b):
+            return F.mse_loss(mm(a).astype("float32"), b)
+
+        step = DistributedTrainStep(m, loss_fn, o, hcg, sharding_stage=1)
+        frozen_before = np.asarray(jax.device_get(
+            first.weight._value.astype(jnp.float32)))
+        rng = np.random.default_rng(17)
+        x, y = _batch(rng, 16)
+        step(paddle.to_tensor(x), paddle.to_tensor(y))
+        assert first.weight._value.dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(first.weight._value.astype(jnp.float32))),
+            frozen_before)
+
+    def test_offload_shardings_request_pinned_host_when_forced(self, hcg,
+                                                               monkeypatch):
+        """Force the support probe on and build the real engine: the
+        optimizer-state/master-weight shardings must carry the pinned_host
+        memory kind (the TPU offload layout). device_put to pinned_host
+        works on CPU — only COMPILING such a program doesn't — so the engine
+        build (which places state) runs for real; the step is not called."""
         from paddle_tpu.distributed.engine import DistributedTrainStep
 
-        sh = NamedSharding(hcg.mesh, P("sharding"), memory_kind="pinned_host")
-        assert sh.memory_kind == "pinned_host"  # constructible on this backend
-        # device_put to pinned_host works on CPU too (only jit compiles fail)
-        arr = jax.device_put(np.zeros(8, np.float32), sh)
-        assert arr.sharding.memory_kind == "pinned_host"
+        monkeypatch.setattr(DistributedTrainStep, "_offload_supported",
+                            staticmethod(lambda: True))
+        m = _mlp(11)
+        o = paddle.optimizer.AdamW(1e-2, parameters=m.parameters(),
+                                   multi_precision=True)
+        o._sharding_stage = 3
+        o._sharding_offload = True
+        step = DistributedTrainStep(m, lambda mm, a, b: F.mse_loss(mm(a), b),
+                                    o, hcg)
+        assert step.offload is True
+        kinds = {k: (v.memory_kind if v is not None else None)
+                 for k, v in step._state_shardings[0].items()}
+        assert kinds["moment1"] == "pinned_host"
+        assert kinds["moment2"] == "pinned_host"
+        # the states were actually PLACED there
+        st = step.optimizer._accumulators[id(step._params[0])]
+        assert st["moment1"].sharding.memory_kind == "pinned_host"

@@ -175,6 +175,37 @@ class TestDatasets:
         with pytest.raises(ValueError, match="no network downloads"):
             UCIHousing(data_file=None)
 
+    def test_conll05st(self, tmp_path):
+        from paddle_tpu.text import Conll05st
+
+        words = "The\ncat\nchased\nthe\nmouse\n\nBirds\nfly\n\n"
+        # props: one predicate column; "chased" is the verb of sentence 1,
+        # "fly" of sentence 2 (and "The" repeats surface forms elsewhere)
+        props = ("-\t(A0*\n-\t*)\nchased\t(V*)\n-\t(A1*\n-\t*)\n\n"
+                 "-\t(A0*)\nfly\t(V*)\n\n")
+        tar_p = tmp_path / "conll05st-tests.tar.gz"
+        with tarfile.open(tar_p, "w:gz") as tf:
+            for member, text in (("conll05st/test.wsj.words.gz", words),
+                                 ("conll05st/test.wsj.props.gz", props)):
+                blob = gzip.compress(text.encode())
+                _add_bytes(tf, member, blob)
+        (tmp_path / "wordDict.txt").write_text(
+            "the\ncat\nchased\nmouse\nbirds\nfly\n<unk>\n")
+        (tmp_path / "verbDict.txt").write_text("chased\nfly\n")
+        (tmp_path / "targetDict.txt").write_text("B-A0\nB-A1\nB-V\nO\n")
+        ds = Conll05st(data_file=str(tar_p),
+                       word_dict_file=str(tmp_path / "wordDict.txt"),
+                       verb_dict_file=str(tmp_path / "verbDict.txt"),
+                       target_dict_file=str(tmp_path / "targetDict.txt"))
+        assert len(ds) == 2
+        item = ds[0]
+        assert len(item) == 9  # words, 5 ctx, predicate, mark, labels
+        word_ids, c_n2, c_n1, c_0, c_p1, c_p2, pred, mark, labels = item
+        assert word_ids.shape == (5,)
+        np.testing.assert_array_equal(mark, [0, 0, 1, 0, 0])  # (V* row
+        assert (c_0 == c_0[0]).all()  # ctx features broadcast per position
+        assert labels.shape == (5,)
+
 
 def brute_force_viterbi(pot, trans, length, bos_eos):
     c = pot.shape[-1]
