@@ -17,7 +17,8 @@ from .communication import (P2POp, ReduceOp, all_gather, all_reduce, all_to_all,
                             scatter, scatter_stack, send, stream, wait)
 from .engine import (DistributedTrainStep, GPipeLayers, ScannedLayers,  # noqa: F401
                      gpipe_spmd_step)
-from .pipeline_1f1b import OneFOneBLayers, make_1f1b_schedule  # noqa: F401
+from .pipeline_1f1b import (OneFOneBLayers, make_1f1b_schedule,  # noqa: F401
+                            schedule_efficiency)
 from .parallel import (DataParallel, ParallelEnv, get_rank, get_world_size,  # noqa: F401
                        init_parallel_env, is_initialized)
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401

@@ -19,10 +19,22 @@ into ONE XLA program:
   is exactly the useful work: ``P*M*v`` forwards + ``P*M*v`` backwards vs
   GPipe's ``P*v*(M+P-1)`` of each.
 - The backward is hand-written (1F1B cannot come from autodiff of the forward
-  scan): each forward stashes only its *input* activation in a circular buffer
-  whose depth is the schedule's true max-in-flight (the 1F1B memory bound:
-  O(P) instead of GPipe's O(M+P)); the backward tick recomputes the segment
-  forward under ``jax.vjp`` and accumulates parameter grads in the scan carry.
+  scan).  Two activation policies (``recompute=`` knob, reference parity:
+  `fleet/meta_parallel/pp_utils/utils.py:1` recompute toggle):
+  * **recompute** — each forward stashes only its *input* activation in a
+    circular buffer whose depth is the schedule's true max-in-flight (the
+    1F1B memory bound: O(P) instead of GPipe's O(M+P)); the backward tick
+    recomputes the segment forward under ``jax.vjp``.  Memory-optimal, pays
+    one extra forward per segment.
+  * **stash** — the forward tick runs ``jax.vjp`` immediately and carries the
+    vjp *residuals* in the circular buffer (param-valued residuals are
+    deduped by tracer identity and rebuilt from the weight stacks at
+    backward time, so the buffer holds activations only); the backward tick
+    is then a pure transpose with no recompute.  Costs O(P)×residual memory,
+    saves ~1/3 of segment flops.
+  ``recompute="auto"`` (default) stashes when the estimated residual buffer
+  fits ``stash_budget_bytes`` (default: 25% of device memory, 1 GiB when the
+  backend does not report a limit), else recomputes.
 - The loss is fused into the last segment, so the only cross-stage data
   besides the activation/cotangent ring hops is ONE scalar psum — this
   replaces the full-output masked-psum broadcast of the GPipe path.
@@ -46,7 +58,7 @@ from ..nn.layer.layers import Layer
 from ..tensor.tensor import Tensor
 from .engine import GPipeLayers
 
-__all__ = ["make_1f1b_schedule", "OneFOneBLayers"]
+__all__ = ["make_1f1b_schedule", "schedule_efficiency", "OneFOneBLayers"]
 
 
 # ---------------------------------------------------------------------------
@@ -130,12 +142,34 @@ def make_1f1b_schedule(num_stages: int, num_microbatches: int,
                          f"to be a multiple of the pipe degree ({p})")
     events = [_stage_events(s, p, m, v) for s in range(p)]
 
+    # per-lane queues: f events and b events each keep THEIR order, but the
+    # f/b interleaving flexes per tick — the reference's strict f,b,f,b
+    # alternation head-of-line-blocks the lockstep tick assignment (a stage
+    # whose next-in-order b is not yet ready would idle its f lane even when
+    # the next f IS ready, halving steady-state occupancy at v=1).  An
+    # in-flight cap (the stage's 1F1B warmup depth + 1) keeps the activation
+    # memory bound at O(P) exactly like the strict order does.
+    fq = [[(c, i) for k, c, i in ev if k == "f"] for ev in events]
+    bq = [[(c, i) for k, c, i in ev if k == "b"] for ev in events]
+    # lockstep steady-state in-flight: the f-chain reaches stage s at tick
+    # s and the matching b returns at tick 2(p-1)-s, so a gap-free FB tick
+    # train needs 2(p-1-s)+1 slots at v=1 (double the async 1F1B bound —
+    # both lanes fire in ONE tick here).  For interleaved v>1 the classic
+    # warmup depth + 1 already achieves the analytic occupancy.
+    if v == 1:
+        cap = [2 * (p - 1 - s) + 1 for s in range(p)]
+    else:
+        cap = [sum(1 for k, _, _ in ev[:next((j for j, e in enumerate(ev)
+                                              if e[0] == "b"), len(ev))]) + 1
+               for ev in events]  # warmup micro-steps + 1
+    fp = [0] * p
+    bp = [0] * p
+
     tick_f: Dict[Tuple[int, int, int], int] = {}  # (chunk, mb, stage) -> tick
     tick_b: Dict[Tuple[int, int, int], int] = {}
     done: List[List[Tuple[str, int, int, int]]] = [[] for _ in range(p)]
-    ptr = [0] * p
     t = 0
-    while any(ptr[s] < len(events[s]) for s in range(p)):
+    while any(fp[s] < len(fq[s]) or bp[s] < len(bq[s]) for s in range(p)):
         if t > 8 * (m * v + p) + 16:
             raise RuntimeError("1F1B schedule failed to converge")
         taken_any = False
@@ -159,16 +193,16 @@ def make_1f1b_schedule(num_stages: int, num_microbatches: int,
             return succ in tick_b and tick_b[succ] < t
 
         for s in range(p):
-            lanes_used = set()
-            for _ in range(2):  # up to one f and one b per tick
-                if ptr[s] >= len(events[s]):
-                    break
-                kind, c, i = events[s][ptr[s]]
-                if kind in lanes_used or not ready(s, kind, c, i):
-                    break
-                this_tick.append((s, kind, c, i))
-                lanes_used.add(kind)
-                ptr[s] += 1
+            if bp[s] < len(bq[s]) and ready(s, "b", *bq[s][bp[s]]):
+                c, i = bq[s][bp[s]]
+                this_tick.append((s, "b", c, i))
+                bp[s] += 1
+                taken_any = True
+            if (fp[s] < len(fq[s]) and fp[s] - bp[s] < cap[s]
+                    and ready(s, "f", *fq[s][fp[s]])):
+                c, i = fq[s][fp[s]]
+                this_tick.append((s, "f", c, i))
+                fp[s] += 1
                 taken_any = True
         for s, kind, c, i in this_tick:
             (tick_f if kind == "f" else tick_b)[(c, i, s)] = t
@@ -234,6 +268,27 @@ def make_1f1b_schedule(num_stages: int, num_microbatches: int,
             "busy_micro_steps": busy}
 
 
+def schedule_efficiency(sched: Dict, bwd_cost: float = 2.0,
+                        fwd_cost: float = 1.0) -> float:
+    """Lockstep efficiency of the ACTUAL tick tables: each tick lasts as long
+    as the busiest stage (devices sync at the end-of-tick ppermute), so
+    wall = Σ_t max_s(stage s's work at tick t) and ideal = one stage's total
+    useful work (every stage does the same M·v forwards + M·v backwards).
+    ``bwd_cost`` is the backward micro-step cost in forward units: 2.0 for
+    the stash policy (pure transpose), 3.0 for recompute (+1 forward).
+
+    This is the engine's own schedule measured in work units — it replaces
+    the analytic M/(M+P-1) (which ignores warmup/cooldown asymmetry and the
+    f-vs-b cost split)."""
+    tbl = sched["tables"]
+    m, v = sched["num_microbatches"], sched["num_chunks"]
+    per_stage = (np.where(tbl["F_C"] >= 0, fwd_cost, 0.0)
+                 + np.where(tbl["B_C"] >= 0, bwd_cost, 0.0))  # [T, P]
+    wall = float(per_stage.max(axis=1).sum())
+    ideal = m * v * (fwd_cost + bwd_cost)
+    return ideal / wall
+
+
 # ---------------------------------------------------------------------------
 # compiled engine
 # ---------------------------------------------------------------------------
@@ -253,11 +308,15 @@ class OneFOneBLayers(GPipeLayers):
 
     def __init__(self, layers: Sequence[Layer], mesh: Mesh,
                  num_microbatches: int, loss_fn: Callable,
-                 num_virtual_stages: int = 1, pipe_axis: str = "pipe"):
+                 num_virtual_stages: int = 1, pipe_axis: str = "pipe",
+                 recompute="auto", stash_budget_bytes: Optional[int] = None):
         p = max(1, mesh.shape[pipe_axis])
         v = int(num_virtual_stages)
         if v < 1:
             raise ValueError("num_virtual_stages must be >= 1")
+        if recompute not in (True, False, "auto"):
+            raise ValueError(f"recompute={recompute!r}: must be True, False "
+                             "or 'auto'")
         if len(layers) % (p * v) != 0:
             raise ValueError(f"{len(layers)} layers not divisible by pipe "
                              f"degree {p} x virtual stages {v}")
@@ -273,7 +332,19 @@ class OneFOneBLayers(GPipeLayers):
         self._v = v
         self._ell = ell
         self._loss_fn = loss_fn
+        self._recompute = recompute
+        self._stash_budget = stash_budget_bytes
+        self.stash_by_key: Dict = {}  # per compiled shape: True = stash mode
         self._cache = {}
+
+    def _budget_bytes(self) -> int:
+        if self._stash_budget is not None:
+            return int(self._stash_budget)
+        try:
+            stats = list(self._mesh.devices.flat)[0].memory_stats()
+            return int(stats["bytes_limit"] * 0.25)
+        except Exception:
+            return 1 << 30
 
     # -- eval forward (global order, un-pipelined) --------------------------
     def forward(self, x, *extra):
@@ -303,17 +374,10 @@ class OneFOneBLayers(GPipeLayers):
         return apply_op("vpp_forward", fn, tuple([x] + stacked))
 
     # -- compiled 1F1B ------------------------------------------------------
-    def _build(self):
-        mesh, axis = self._mesh, self._pipe_axis
-        p = mesh.shape[axis]
-        m, v, ell = self.num_microbatches, self._v, self._ell
-        sched = make_1f1b_schedule(p, m, v)
-        tbl, T = sched["tables"], sched["T"]
-        Df, Da, Dg = sched["Df"], sched["Da"], sched["Dg"]
+    def _make_seg_fwd(self):
         template_params = [dict(self._template.named_parameters())[n]
                            for n in self._stack_names]
         template = self._template
-        loss_fn = self._loss_fn
         from ..jit import _StateSwap
 
         def seg_fwd(chunk_stacks, h):
@@ -325,15 +389,88 @@ class OneFOneBLayers(GPipeLayers):
             h2, _ = jax.lax.scan(body, h, tuple(chunk_stacks))
             return h2
 
-        def seg_loss(chunk_stacks, h, y_mb):
-            out = seg_fwd(chunk_stacks, h)
+        return seg_fwd
+
+    def _probe_stash(self, act_shape, act_dtype):
+        """Abstractly trace one segment's ``jax.vjp`` to learn the residual
+        leaf shapes and which leaves ARE the parameter chunk (tracer
+        identity) — those are rebuilt from the weight stacks at backward
+        time instead of being stashed. Returns (leaf_shapes, param_map)."""
+        ell = self._ell
+        seg_fwd = self._make_seg_fwd()
+        chunk_sds = [jax.ShapeDtypeStruct(
+            (ell,) + tuple(self._parameters[n.replace(".", "__")].shape[1:]),
+            self._parameters[n.replace(".", "__")].dtype)
+            for n in self._stack_names]
+        h_sd = jax.ShapeDtypeStruct(act_shape, act_dtype)
+        box = {}
+
+        def probe(ch, h):
+            _, vjp_fn = jax.vjp(seg_fwd, ch, h)
+            leaves, _ = jax.tree_util.tree_flatten(vjp_fn)
+            ids = {id(c): j for j, c in enumerate(ch)}
+            box["pmap"] = [ids.get(id(l)) for l in leaves]
+            return leaves
+
+        leaf_sds = jax.eval_shape(probe, chunk_sds, h_sd)
+        return list(leaf_sds), box["pmap"]
+
+    def _decide_stash(self, xv):
+        """Resolve the recompute knob for this input shape: returns
+        (stash, probe_or_None). auto = stash when the residual ring buffer
+        (Da slots x activation-valued residuals + the loss-cotangent ring)
+        fits the budget."""
+        if self._recompute is True:
+            return False, None
+        mb = xv.shape[0] // self.num_microbatches
+        act_shape = (mb,) + tuple(xv.shape[1:])
+        try:
+            leaf_sds, pmap = self._probe_stash(act_shape, xv.dtype)
+        except Exception as e:
+            if self._recompute is False:
+                raise RuntimeError(
+                    f"recompute=False requested but the stash probe failed "
+                    f"(segment not vjp-traceable outside the mesh?): {e!r}")
+            return False, None
+        p = self._mesh.shape[self._pipe_axis]
+        sched = self._sched()
+        stash_bytes = sched["Da"] * (
+            sum(int(np.prod(s.shape)) * s.dtype.itemsize
+                for s, j in zip(leaf_sds, pmap) if j is None)
+            + int(np.prod(act_shape)) * jnp.dtype(xv.dtype).itemsize)
+        stash = (True if self._recompute is False
+                 else stash_bytes <= self._budget_bytes())
+        return stash, ((leaf_sds, pmap) if stash else None)
+
+    def _sched(self) -> Dict:
+        if getattr(self, "_sched_cache", None) is None:
+            self._sched_cache = make_1f1b_schedule(
+                self._mesh.shape[self._pipe_axis], self.num_microbatches,
+                self._v)
+        return self._sched_cache
+
+    def _build(self, stash: bool = False, probe=None):
+        mesh, axis = self._mesh, self._pipe_axis
+        p = mesh.shape[axis]
+        m, v, ell = self.num_microbatches, self._v, self._ell
+        sched = self._sched()
+        tbl, T = sched["tables"], sched["T"]
+        Df, Da, Dg = sched["Df"], sched["Da"], sched["Dg"]
+        loss_fn = self._loss_fn
+        seg_fwd = self._make_seg_fwd()
+
+        def loss_val(out, y_mb):
             l = loss_fn(Tensor(out), Tensor(y_mb))
-            l = l._value if isinstance(l, Tensor) else l
-            return jnp.asarray(l, jnp.float32)
+            return jnp.asarray(l._value if isinstance(l, Tensor) else l,
+                               jnp.float32)
+
+        def seg_loss(chunk_stacks, h, y_mb):
+            return loss_val(seg_fwd(chunk_stacks, h), y_mb)
 
         n_tab = len(tbl)
         tab_names = sorted(tbl)
         tab_consts = [jnp.asarray(tbl[k]) for k in tab_names]
+        tdbox = {}  # vjp treedef, filled while tracing do_f (before do_b)
 
         def sharded_step(xv, yv, *tabs_and_stacks):
             tabs = dict(zip(tab_names, tabs_and_stacks[:n_tab]))
@@ -362,6 +499,116 @@ class OneFOneBLayers(GPipeLayers):
             loss0 = vary(jnp.zeros((), jnp.float32))
             perm_f = [(s, (s + 1) % p) for s in range(p)]
             perm_b = [(s, (s - 1) % p) for s in range(p)]
+
+            def accum_chunk_grads(gacc, dchunk, bc):
+                c0 = jnp.clip(bc, 0, v - 1) * ell
+                new_gacc = []
+                for acc_st, d in zip(gacc, dchunk):
+                    cur = jax.lax.dynamic_slice_in_dim(acc_st, c0, ell, 0)
+                    new_gacc.append(jax.lax.dynamic_update_slice_in_dim(
+                        acc_st, cur + d, c0, 0))
+                return tuple(new_gacc)
+
+            def tick_stash(carry, row):
+                """Stash policy: the fwd lane runs jax.vjp NOW and the
+                residual leaves ride the rbuf ring (param-valued residuals
+                rebuilt from the stacks); the bwd lane is a pure transpose.
+                All buffer reads use the pre-tick carry (writes are grouped
+                at the end), so same-tick slot reuse is hazard-free."""
+                leaf_sds, pmap = probe
+                stash_idx = [i for i, j in enumerate(pmap) if j is None]
+                fbuf, gbuf, rbuf, lbuf, gacc, loss_acc = carry
+                g = lambda k: jnp.take(row[k], stage)
+                fc, fi, fsrc, fst = g("F_C"), g("F_I"), g("F_SRC"), g("F_STASH")
+                bc, bi, ba, bg = g("B_C"), g("B_I"), g("B_A"), g("B_G")
+                rf, rb = g("RF"), g("RB")
+
+                # ---- forward lane: segment vjp, loss cotangent for the
+                # last global segment (no seg recompute anywhere)
+                def do_f(_):
+                    h_in = jnp.where(
+                        fsrc >= 0, fbuf[jnp.clip(fsrc, 0, Df - 1)],
+                        xs[jnp.clip(fi, 0, m - 1)])
+                    chunk = chunk_of(fc)
+                    out, vjp_fn = jax.vjp(seg_fwd, chunk, h_in)
+                    leaves, td = jax.tree_util.tree_flatten(vjp_fn)
+                    tdbox["td"] = td
+                    if len(leaves) != len(pmap):
+                        raise RuntimeError(
+                            "stash probe disagreed with the traced segment "
+                            "vjp — use recompute=True")
+                    for i, j in enumerate(pmap):
+                        if j is not None and leaves[i] is not chunk[j]:
+                            raise RuntimeError(
+                                "stash param-dedup mismatch — use "
+                                "recompute=True")
+                    is_last = jnp.logical_and(fc == v - 1, stage == p - 1)
+
+                    def last_branch(o):
+                        y_mb = ys[jnp.clip(fi, 0, m - 1)]
+                        l, lvjp = jax.vjp(lambda ov: loss_val(ov, y_mb), o)
+                        (dy,) = lvjp(vary(jnp.asarray(1.0 / m, jnp.float32)))
+                        return (vary(jnp.zeros(act_shape, adt)), vary(l / m),
+                                vary(dy.astype(adt)))
+
+                    def mid_branch(o):
+                        return (vary(o), vary(jnp.zeros((), jnp.float32)),
+                                vary(jnp.zeros(act_shape, adt)))
+
+                    send, dl, dy = jax.lax.cond(is_last, last_branch,
+                                                mid_branch, out)
+                    return send, dl, dy, tuple(vary(leaves[i])
+                                               for i in stash_idx)
+
+                def skip_f(_):
+                    return (vary(jnp.zeros(act_shape, adt)),
+                            vary(jnp.zeros((), jnp.float32)),
+                            vary(jnp.zeros(act_shape, adt)),
+                            tuple(vary(jnp.zeros(tuple(leaf_sds[i].shape),
+                                                 leaf_sds[i].dtype))
+                                  for i in stash_idx))
+
+                send_f, dl, dy_last, new_leaves = jax.lax.cond(
+                    fc >= 0, do_f, skip_f, 0)
+                loss_acc = loss_acc + dl
+
+                # ---- backward lane: rebuild vjp from stashed residuals
+                def do_b(gacc):
+                    chunk = chunk_of(bc)
+                    leaves, k = [], 0
+                    for i, j in enumerate(pmap):
+                        if j is not None:
+                            leaves.append(chunk[j])
+                        else:
+                            leaves.append(
+                                rbuf[k][jnp.clip(ba, 0, Da - 1)])
+                            k += 1
+                    vjp_fn = jax.tree_util.tree_unflatten(tdbox["td"], leaves)
+                    dy = jnp.where(bg >= 0, gbuf[jnp.clip(bg, 0, Dg - 1)],
+                                   lbuf[jnp.clip(ba, 0, Da - 1)])
+                    dchunk, dh = vjp_fn(dy)
+                    return accum_chunk_grads(gacc, dchunk, bc), dh
+
+                def skip_b(gacc):
+                    return gacc, vary(jnp.zeros(act_shape, adt))
+
+                gacc, send_b = jax.lax.cond(bc >= 0, do_b, skip_b, gacc)
+
+                # ---- ring hops + ALL buffer writes (reads were above)
+                recv_f = jax.lax.ppermute(send_f, axis, perm_f)
+                recv_b = jax.lax.ppermute(send_b, axis, perm_b)
+                fbuf = jnp.where(rf >= 0,
+                                 fbuf.at[jnp.clip(rf, 0, Df - 1)].set(recv_f),
+                                 fbuf)
+                gbuf = jnp.where(rb >= 0,
+                                 gbuf.at[jnp.clip(rb, 0, Dg - 1)].set(recv_b),
+                                 gbuf)
+                slot = jnp.clip(fst, 0, Da - 1)
+                rbuf = tuple(
+                    jnp.where(fc >= 0, rb_.at[slot].set(lv), rb_)
+                    for rb_, lv in zip(rbuf, new_leaves))
+                lbuf = jnp.where(fc >= 0, lbuf.at[slot].set(dy_last), lbuf)
+                return (fbuf, gbuf, rbuf, lbuf, gacc, loss_acc), None
 
             def tick(carry, row):
                 fbuf, gbuf, abuf, gacc, loss_acc = carry
@@ -443,8 +690,18 @@ class OneFOneBLayers(GPipeLayers):
                                  gbuf)
                 return (fbuf, gbuf, abuf, gacc, loss_acc), None
 
-            (_, _, _, gacc, loss_acc), _ = jax.lax.scan(
-                tick, (fbuf0, gbuf0, abuf0, gacc0, loss0), tabs)
+            if stash:
+                leaf_sds, pmap = probe
+                rbuf0 = tuple(
+                    vary(jnp.zeros((Da,) + tuple(s.shape), s.dtype))
+                    for s, j in zip(leaf_sds, pmap) if j is None)
+                lbuf0 = vary(jnp.zeros((Da,) + act_shape, adt))
+                (_, _, _, _, gacc, loss_acc), _ = jax.lax.scan(
+                    tick_stash, (fbuf0, gbuf0, rbuf0, lbuf0, gacc0, loss0),
+                    tabs)
+            else:
+                (_, _, _, gacc, loss_acc), _ = jax.lax.scan(
+                    tick, (fbuf0, gbuf0, abuf0, gacc0, loss0), tabs)
             loss = jax.lax.psum(loss_acc, axis)
             return (loss,) + gacc
 
@@ -471,7 +728,9 @@ class OneFOneBLayers(GPipeLayers):
                              f"num_microbatches {self.num_microbatches}")
         key = (xv.shape, str(xv.dtype), yv.shape, str(yv.dtype))
         if key not in self._cache:
-            self._cache[key] = self._build()
+            stash, probe = self._decide_stash(xv)
+            self.stash_by_key[key] = stash
+            self._cache[key] = self._build(stash, probe)
         stacks = [self._parameters[n.replace(".", "__")]._value
                   for n in self._stack_names]
         out = self._cache[key](xv, yv, *stacks)

@@ -11,6 +11,7 @@ import pytest
 pytestmark = pytest.mark.slow
 
 import jax
+import jax.numpy as jnp
 
 import paddle_tpu as paddle
 import paddle_tpu.distributed as dist
@@ -241,6 +242,81 @@ class TestOneFOneBCompiled:
                             for b in host_blocks])[eng._row_order]
             np.testing.assert_allclose(np.asarray(grads[k]), ref,
                                        rtol=1e-4, atol=1e-5)
+
+    def test_stash_vs_recompute_knob(self):
+        """Round-3 verdict #6: OneFOneBLayers(recompute=...) — pipe-4,
+        identical losses AND grads in both modes, and the stash program
+        executes fewer flops (no segment recompute in backward)."""
+        from paddle_tpu.distributed import OneFOneBLayers
+
+        mesh4 = build_mesh(dp=1, pp=4, sharding=1, sep=1, mp=1,
+                           devices=jax.devices()[:4])
+        rng = np.random.default_rng(21)
+        x = rng.standard_normal((8, 16)).astype(np.float32)
+        y = rng.standard_normal((8, 16)).astype(np.float32)
+        results = {}
+        for mode in (True, False):
+            eng = OneFOneBLayers(make_blocks(4, 16, seed=17), mesh4,
+                                 num_microbatches=4, loss_fn=self._loss(),
+                                 recompute=mode)
+            loss, grads = eng.loss_and_grads(paddle.to_tensor(x),
+                                             paddle.to_tensor(y))
+            key = next(iter(eng.stash_by_key))
+            assert eng.stash_by_key[key] == (not mode)
+            results[mode] = (float(loss.numpy()),
+                             [np.asarray(g) for g in grads], eng)
+        np.testing.assert_allclose(results[True][0], results[False][0],
+                                   rtol=1e-6)
+        for ga, gb in zip(results[True][1], results[False][1]):
+            np.testing.assert_allclose(ga, gb, rtol=1e-4, atol=1e-6)
+
+        # fewer flops: compare XLA cost analysis of the two compiled steps
+        def flops(eng):
+            key = next(iter(eng._cache))
+            xv, yv = jnp.asarray(x), jnp.asarray(y)
+            stacks = [eng._parameters[n.replace(".", "__")]._value
+                      for n in eng._stack_names]
+            lowered = eng._cache[key].lower(xv, yv, *stacks)
+            return lowered.compile().cost_analysis()["flops"]
+
+        f_rec, f_stash = flops(results[True][2]), flops(results[False][2])
+        assert f_stash < f_rec, (f_stash, f_rec)
+
+    def test_auto_mode_budget(self):
+        """auto: tiny residuals → stash; a 0-byte budget → recompute."""
+        from paddle_tpu.distributed import OneFOneBLayers
+
+        mesh2 = build_mesh(dp=1, pp=2, sharding=1, sep=1, mp=1,
+                           devices=jax.devices()[:2])
+        rng = np.random.default_rng(23)
+        x = rng.standard_normal((4, 8)).astype(np.float32)
+        y = rng.standard_normal((4, 8)).astype(np.float32)
+        eng = OneFOneBLayers(make_blocks(2, 8, seed=19), mesh2, 2,
+                             self._loss(), stash_budget_bytes=0)
+        eng.loss_and_grads(paddle.to_tensor(x), paddle.to_tensor(y))
+        assert eng.stash_by_key[next(iter(eng.stash_by_key))] is False
+        eng2 = OneFOneBLayers(make_blocks(2, 8, seed=19), mesh2, 2,
+                              self._loss())
+        eng2.loss_and_grads(paddle.to_tensor(x), paddle.to_tensor(y))
+        assert eng2.stash_by_key[next(iter(eng2.stash_by_key))] is True
+        with pytest.raises(ValueError, match="recompute"):
+            OneFOneBLayers(make_blocks(2, 8), mesh2, 2, self._loss(),
+                           recompute="sometimes")
+
+    def test_schedule_efficiency_helper(self):
+        from paddle_tpu.distributed import make_1f1b_schedule, schedule_efficiency
+
+        s = make_1f1b_schedule(4, 8, 1)
+        eff = schedule_efficiency(s, bwd_cost=2.0)
+        # the real schedule sits near (but not exactly at) M/(M+P-1)
+        assert 0.5 < eff < 1.0
+        assert abs(eff - 8 / 11) < 0.15
+        # recompute backwards cost more, lowering lockstep efficiency is
+        # not guaranteed, but the helper must stay in (0, 1]
+        assert 0.0 < schedule_efficiency(s, bwd_cost=3.0) <= 1.0
+        # more microbatches → higher efficiency
+        assert (schedule_efficiency(make_1f1b_schedule(4, 16, 1))
+                > schedule_efficiency(make_1f1b_schedule(4, 4, 1)))
 
     def test_schedule_dependencies_and_errors(self):
         from paddle_tpu.distributed import OneFOneBLayers, make_1f1b_schedule
