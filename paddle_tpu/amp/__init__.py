@@ -264,6 +264,50 @@ class GradScaler(AmpScaler):
         self._unscale(optimizer)
 
 
+def _wrap_o2_forward(model, dt) -> None:
+    """Install the O2 input-cast wrapper on THIS instance's forward (bound
+    via default args so a multi-model decorate doesn't share one closure
+    cell), plus a ``__deepcopy__`` that re-wraps the copy's OWN forward —
+    without it a deepcopied decorated model would keep calling the original
+    model's forward (and so compute with the original's parameters)."""
+    if getattr(model, "_amp_o2_wrapped", False):
+        return
+
+    def _cast(v, _dt=dt):
+        if hasattr(v, "_value") and \
+                jnp.issubdtype(v._value.dtype, jnp.floating) and \
+                v._value.dtype != _dt:
+            return v.astype(_dt)
+        if isinstance(v, tuple) and hasattr(v, "_fields"):
+            return type(v)(*(_cast(o) for o in v))  # namedtuple
+        if isinstance(v, (list, tuple)):
+            return type(v)(_cast(o) for o in v)
+        if isinstance(v, dict):
+            return {k: _cast(o) for k, o in v.items()}
+        return v
+
+    def _o2_forward(*args, _fwd=model.forward, **kwargs):
+        return _fwd(*_cast(list(args)),
+                    **{k: _cast(v) for k, v in kwargs.items()})
+
+    def _o2_deepcopy(memo, _model=model, _dt=dt):
+        import copy as _copy
+
+        new = type(_model).__new__(type(_model))
+        memo[id(_model)] = new
+        state = dict(_model.__dict__)
+        for k in ("forward", "_amp_o2_wrapped", "__deepcopy__"):
+            state.pop(k, None)  # drop the wrapper bound to the ORIGINAL
+        for k, v in state.items():
+            new.__dict__[k] = _copy.deepcopy(v, memo)
+        _wrap_o2_forward(new, _dt)
+        return new
+
+    object.__setattr__(model, "forward", _o2_forward)
+    object.__setattr__(model, "_amp_o2_wrapped", True)
+    object.__setattr__(model, "__deepcopy__", _o2_deepcopy)
+
+
 def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16",
              master_weight=None, save_dtype=None):
     """AMP O2: cast model params to half dtype, keep norm params fp32, arm
@@ -288,31 +332,7 @@ def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16"
             # (measured: fp32 convs cost ResNet-50 ~5x MFU on v5e). Wrap
             # forward itself — a pre-hook would miss keyword args and
             # container-nested tensors.
-            if not getattr(model, "_amp_o2_wrapped", False):
-                def _cast(v, _dt=dt):
-                    if hasattr(v, "_value") and \
-                            jnp.issubdtype(v._value.dtype, jnp.floating) and \
-                            v._value.dtype != _dt:
-                        return v.astype(_dt)
-                    if isinstance(v, tuple) and hasattr(v, "_fields"):
-                        return type(v)(*(_cast(o) for o in v))  # namedtuple
-                    if isinstance(v, (list, tuple)):
-                        return type(v)(_cast(o) for o in v)
-                    if isinstance(v, dict):
-                        return {k: _cast(o) for k, o in v.items()}
-                    return v
-
-                # NOTE: binds THIS instance's forward (via the default arg so
-                # a multi-model decorate doesn't share one closure cell);
-                # deepcopying a decorated model keeps calling the original's
-                # forward — decorate the copy instead of copying the
-                # decorated model
-                def _o2_forward(*args, _fwd=model.forward, **kwargs):
-                    return _fwd(*_cast(list(args)),
-                                **{k: _cast(v) for k, v in kwargs.items()})
-
-                object.__setattr__(model, "forward", _o2_forward)
-                object.__setattr__(model, "_amp_o2_wrapped", True)
+            _wrap_o2_forward(model, dt)
     if optimizers is not None:
         single_opt = not isinstance(optimizers, (list, tuple))
         opt_list = [optimizers] if single_opt else list(optimizers)

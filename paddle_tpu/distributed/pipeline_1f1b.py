@@ -395,25 +395,41 @@ class OneFOneBLayers(GPipeLayers):
         """Abstractly trace one segment's ``jax.vjp`` to learn the residual
         leaf shapes and which leaves ARE the parameter chunk (tracer
         identity) — those are rebuilt from the weight stacks at backward
-        time instead of being stashed. Returns (leaf_shapes, param_map)."""
-        ell = self._ell
+        time instead of being stashed. Returns (leaf_shapes, param_map).
+
+        The trace runs under ``shard_map`` on the engine's own mesh: the
+        VMA (varying-manual-axes) context changes the vjp residual
+        structure (constants that fold to literals in a plain trace become
+        explicit residuals), so probing outside the mesh would disagree
+        with the real tick trace."""
+        ell, axis = self._ell, self._pipe_axis
         seg_fwd = self._make_seg_fwd()
-        chunk_sds = [jax.ShapeDtypeStruct(
-            (ell,) + tuple(self._parameters[n.replace(".", "__")].shape[1:]),
+        stack_sds = [jax.ShapeDtypeStruct(
+            tuple(self._parameters[n.replace(".", "__")].shape),
             self._parameters[n.replace(".", "__")].dtype)
             for n in self._stack_names]
         h_sd = jax.ShapeDtypeStruct(act_shape, act_dtype)
         box = {}
 
-        def probe(ch, h):
-            _, vjp_fn = jax.vjp(seg_fwd, ch, h)
+        def inner(h, *stks):
+            try:
+                h = jax.lax.pcast(h, (axis,), to="varying")
+            except ValueError:
+                pass
+            chunk = [s[:ell] for s in stks]
+            _, vjp_fn = jax.vjp(seg_fwd, chunk, h)
             leaves, _ = jax.tree_util.tree_flatten(vjp_fn)
-            ids = {id(c): j for j, c in enumerate(ch)}
+            ids = {id(c): j for j, c in enumerate(chunk)}
             box["pmap"] = [ids.get(id(l)) for l in leaves]
-            return leaves
+            box["leaves"] = [jax.ShapeDtypeStruct(tuple(l.shape), l.dtype)
+                             for l in leaves]
+            return jnp.zeros((1,), jnp.float32)
 
-        leaf_sds = jax.eval_shape(probe, chunk_sds, h_sd)
-        return list(leaf_sds), box["pmap"]
+        sm = jax.shard_map(inner, mesh=self._mesh, axis_names={axis},
+                           in_specs=(P(),) + (P(axis),) * len(stack_sds),
+                           out_specs=P())
+        jax.eval_shape(sm, h_sd, *stack_sds)
+        return box["leaves"], box["pmap"]
 
     def _decide_stash(self, xv):
         """Resolve the recompute knob for this input shape: returns
@@ -432,7 +448,6 @@ class OneFOneBLayers(GPipeLayers):
                     f"recompute=False requested but the stash probe failed "
                     f"(segment not vjp-traceable outside the mesh?): {e!r}")
             return False, None
-        p = self._mesh.shape[self._pipe_axis]
         sched = self._sched()
         stash_bytes = sched["Da"] * (
             sum(int(np.prod(s.shape)) * s.dtype.itemsize
@@ -535,8 +550,10 @@ class OneFOneBLayers(GPipeLayers):
                     tdbox["td"] = td
                     if len(leaves) != len(pmap):
                         raise RuntimeError(
-                            "stash probe disagreed with the traced segment "
-                            "vjp — use recompute=True")
+                            f"stash probe disagreed with the traced segment "
+                            f"vjp ({len(pmap)} probe leaves vs {len(leaves)} "
+                            f"traced: {[tuple(l.shape) for l in leaves]}) — "
+                            "use recompute=True")
                     for i, j in enumerate(pmap):
                         if j is not None and leaves[i] is not chunk[j]:
                             raise RuntimeError(

@@ -9,6 +9,15 @@ matching the reference's semantics (it likewise ships pickled python
 between trusted job workers; this is an intra-job control channel, not an
 open endpoint).
 
+Every frame is authenticated with HMAC-SHA256 over a per-job secret that
+rank 0 publishes through the TCPStore at init: a frame whose tag does not
+verify is dropped BEFORE unpickling, so reaching the ephemeral port is not
+enough to inject code — the peer must also hold the job secret. The server
+binds to the interface that routes to the rendezvous master (or
+``PADDLE_LOCAL_IP``), not 0.0.0.0, and the same address is advertised to
+peers (``gethostbyname(gethostname())`` resolves to 127.0.1.1 on some
+distros, silently breaking cross-host calls).
+
     rpc.init_rpc("worker0", rank=0, world_size=2, master_endpoint="ip:port")
     fut = rpc.rpc_async("worker1", max, args=(3, 5))
     assert fut.wait() == 5
@@ -17,6 +26,7 @@ open endpoint).
 
 from __future__ import annotations
 
+import hmac
 import pickle
 import socket
 import struct
@@ -31,6 +41,10 @@ __all__ = ["init_rpc", "shutdown", "rpc_sync", "rpc_async",
            "get_current_worker_info", "WorkerInfo"]
 
 _DEFAULT_RPC_TIMEOUT = 30.0
+# cap on one frame's payload, checked BEFORE any buffering: the length
+# prefix is attacker-controlled pre-auth, so an unauthenticated peer must
+# not be able to make the server allocate unbounded memory
+_MAX_FRAME_BYTES = 256 * 1024 * 1024
 
 
 class WorkerInfo:
@@ -52,22 +66,55 @@ class _State:
     client_pool: Optional[ThreadPoolExecutor] = None
     current: Optional[WorkerInfo] = None
     workers: Dict[str, WorkerInfo] = {}
+    secret: bytes = b""
     stop = threading.Event()
 
 
-def _send_blob(sock: socket.socket, blob: bytes) -> None:
-    sock.sendall(struct.pack("!Q", len(blob)) + blob)
+def _send_blob(sock: socket.socket, blob: bytes, secret: bytes) -> None:
+    tag = hmac.new(secret, blob, "sha256").digest()
+    sock.sendall(struct.pack("!Q", len(blob)) + tag + blob)
 
 
-def _recv_blob(sock: socket.socket) -> bytes:
+def _recv_blob(sock: socket.socket, secret: bytes) -> bytes:
+    """Receive one frame and verify its HMAC BEFORE the payload is ever
+    unpickled; raises PermissionError on tag mismatch."""
     (n,) = struct.unpack("!Q", _recv_exact(sock, 8))
-    return _recv_exact(sock, n)
+    if n > _MAX_FRAME_BYTES:
+        raise PermissionError(f"rpc frame length {n} exceeds cap")
+    tag = _recv_exact(sock, 32)
+    blob = _recv_exact(sock, n)
+    if not hmac.compare_digest(tag, hmac.new(secret, blob, "sha256").digest()):
+        raise PermissionError("rpc frame failed HMAC authentication")
+    return blob
+
+
+def _local_ip(master_endpoint: str) -> str:
+    """The address peers should dial: PADDLE_LOCAL_IP if set, else the
+    interface that routes to the rendezvous master (UDP connect trick — no
+    packet is sent)."""
+    import os
+
+    ip = os.environ.get("PADDLE_LOCAL_IP")
+    if ip:
+        return ip
+    host, _, port = master_endpoint.rpartition(":")
+    probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        probe.connect((host, int(port)))
+        return probe.getsockname()[0]
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+    finally:
+        probe.close()
 
 
 def _serve(conn: socket.socket) -> None:
     try:
         with conn:
-            blob = _recv_blob(conn)
+            try:
+                blob = _recv_blob(conn, _State.secret)
+            except PermissionError:
+                return  # unauthenticated frame: drop silently
             fn, args, kwargs = pickle.loads(blob)
             try:
                 result = ("ok", fn(*args, **kwargs))
@@ -81,7 +128,7 @@ def _serve(conn: socket.socket) -> None:
                     ("err", RuntimeError(
                         f"rpc result not picklable: {e!r} (result was "
                         f"{type(result[1]).__name__})")))
-            _send_blob(conn, payload)
+            _send_blob(conn, payload, _State.secret)
     except (OSError, ConnectionError):
         pass  # caller gone / shutdown race
 
@@ -113,9 +160,10 @@ def init_rpc(name: str, rank: Optional[int] = None,
     if not master_endpoint or world_size <= 0:
         raise ValueError("init_rpc needs world_size and master_endpoint")
 
+    ip = _local_ip(master_endpoint)
     srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    srv.bind(("0.0.0.0", 0))
+    srv.bind((ip, 0))  # the rendezvous-facing interface, never 0.0.0.0
     srv.listen(64)
     port = srv.getsockname()[1]
 
@@ -124,7 +172,14 @@ def init_rpc(name: str, rank: Optional[int] = None,
         store, node_rank = rendezvous(
             master_endpoint, world_size, job_id="rpc",
             node_rank=None if rank is None or rank < 0 else rank)
-        ip = socket.gethostbyname(socket.gethostname())
+        # per-job frame-auth secret: rank 0 mints it, everyone reads it
+        # through the store before any RPC socket accepts traffic
+        import secrets as _secrets
+
+        if node_rank == 0:
+            store.set("rpc/secret", _secrets.token_hex(32).encode())
+        store.wait(["rpc/secret"], timeout=_DEFAULT_RPC_TIMEOUT * 10)
+        secret = bytes(store.get("rpc/secret"))
         info = WorkerInfo(name, node_rank, ip, port)
         store.set(f"rpc/worker/{name}",
                   pickle.dumps((name, node_rank, ip, port)))
@@ -154,6 +209,7 @@ def init_rpc(name: str, rank: Optional[int] = None,
 
     _State.stop.clear()
     _State.store = store
+    _State.secret = secret
     _State.server = srv
     # separate pools: blocked outbound client calls must never starve the
     # threads that serve INCOMING requests (mutual-callback deadlock)
@@ -178,8 +234,9 @@ def _call(to: str, fn, args, kwargs, timeout: float):
                                   timeout=timeout) as sock:
         sock.settimeout(timeout)
         _send_blob(sock, pickle.dumps((fn, tuple(args or ()), kwargs or {}),
-                                      protocol=pickle.HIGHEST_PROTOCOL))
-        status, payload = pickle.loads(_recv_blob(sock))
+                                      protocol=pickle.HIGHEST_PROTOCOL),
+                   _State.secret)
+        status, payload = pickle.loads(_recv_blob(sock, _State.secret))
     if status == "err":
         raise payload
     return payload
@@ -249,4 +306,5 @@ def shutdown() -> None:
         pass
     _State.current = None
     _State.workers = {}
+    _State.secret = b""
     _State.store = None

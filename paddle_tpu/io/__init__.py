@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import itertools
 import multiprocessing
+import pickle
 import queue
 import threading
 import traceback as _traceback
@@ -335,6 +336,30 @@ class _WorkerError:
         self.type_name = type(exc).__name__
 
 
+class _UnpicklableBatch:
+    """Structured worker→parent signal: a custom collate produced a batch
+    that cannot cross the mp queue — the parent should rerun the epoch on
+    the threaded pool instead of dying mid-iteration."""
+
+    def __init__(self, reason: str):
+        self.reason = reason
+
+
+class _PickledBatch:
+    """Custom-collate payload already serialized by the worker (the eager
+    validation dump IS the transport — the mp queue then only re-pickles a
+    flat bytes object, so nothing is serialized twice)."""
+
+    __slots__ = ("blob",)
+
+    def __init__(self, blob: bytes):
+        self.blob = blob
+
+
+class _PicklingFallback(Exception):
+    pass
+
+
 def _to_transport(obj, use_shm: bool):
     """Worker→parent encoding: Tensors/ndarrays become ndarrays (big ones
     parked in shared memory) with the original type recorded, so the parent
@@ -409,15 +434,32 @@ def _from_transport(obj, tensorify: bool):
 
 
 def _mp_worker_main(result_q, worker_id, num_workers, dataset, collate,
-                    my_batches, init_fn, use_shm):
-    """Worker process body: NUMPY work only — jax stays in the parent."""
+                    my_batches, init_fn, use_shm, validate_pickle):
+    """Worker process body: NUMPY work only — jax stays in the parent.
+
+    ``validate_pickle`` (set for CUSTOM collates, whose outputs are not
+    guaranteed ndarray-shaped): mp.Queue pickles in a background feeder
+    thread where a PicklingError is unreachable, so the batch is dumped
+    eagerly here first; an unpicklable batch becomes a structured
+    _UnpicklableBatch signal instead of a mid-iteration crash."""
     _worker_info.info = _WorkerInfo(worker_id, num_workers, dataset)
     if init_fn is not None:
         init_fn(worker_id)
     try:
         for seq, batch_idx in my_batches:
             data = collate([dataset[i] for i in batch_idx])
-            result_q.put((seq, _to_transport(data, use_shm)))
+            payload = _to_transport(data, use_shm)
+            if validate_pickle:
+                try:
+                    blob = pickle.dumps(payload,
+                                        protocol=pickle.HIGHEST_PROTOCOL)
+                except Exception as e:  # noqa: BLE001
+                    _release_transport(payload)
+                    result_q.put((-2, _UnpicklableBatch(repr(e))))
+                    return
+                result_q.put((seq, _PickledBatch(blob)))
+            else:
+                result_q.put((seq, payload))
     except BaseException as e:  # noqa: BLE001 — ship it to the parent
         result_q.put((-1, _WorkerError(e)))
 
@@ -476,8 +518,17 @@ def _np_collate(batch: List[Any]):
 
 
 class DataLoader:
-    """reference: `io/dataloader/dataloader_iter.py` — here a thread-pool
-    prefetcher with an ordered output queue."""
+    """reference: `io/dataloader/dataloader_iter.py` — process workers with
+    shared-memory transport by default, falling back to a thread-pool
+    prefetcher with an ordered output queue.
+
+    Notes on the process path: the parent issues ONE extra
+    ``dataset[first_index]`` call per DataLoader (cached) to probe whether
+    items contain Tensors (jax work is unsafe in forked workers — such
+    datasets stay on threads); custom-collate batches must survive pickling
+    through the mp queue — an unpicklable batch triggers a logged
+    thread-pool fallback at epoch start (mid-epoch it raises, telling you
+    to set ``use_process_workers=False``)."""
 
     def __init__(self, dataset, feed_list=None, places=None, return_list: bool = True,
                  batch_sampler=None, batch_size: int = 1, shuffle: bool = False,
@@ -516,10 +567,8 @@ class DataLoader:
         if self.num_workers == 0:
             return self._iter_sync()
         if self.use_process_workers:
-            import pickle
-
             try:
-                return self._iter_processes()
+                gen = self._iter_processes()  # spawn failures surface HERE
             except (ImportError, OSError, ValueError, AttributeError,
                     TypeError, pickle.PicklingError) as e:
                 import logging
@@ -527,7 +576,34 @@ class DataLoader:
                 logging.getLogger("paddle_tpu.io").warning(
                     "process workers unavailable (%s); falling back to "
                     "threads", e)
+            else:
+                return self._wrap_process_iter(gen)
         return self._iter_threaded()
+
+    def _wrap_process_iter(self, gen):
+        """Mid-iteration escape hatch: a worker that produced an
+        unpicklable custom-collate batch signals _PicklingFallback — rerun
+        the epoch on the threaded pool if nothing was yielded yet."""
+        yielded = 0
+        try:
+            for item in gen:
+                yield item
+                yielded += 1
+        except _PicklingFallback as e:
+            if yielded:
+                raise RuntimeError(
+                    f"DataLoader custom collate produced an unpicklable "
+                    f"batch after {yielded} batches were already delivered "
+                    f"({e}); cannot fall back to threads mid-epoch — set "
+                    "use_process_workers=False") from e
+            import logging
+
+            logging.getLogger("paddle_tpu.io").warning(
+                "custom collate output not picklable (%s); falling back "
+                "to threads", e)
+            # reuse the indices the process path already materialized — a
+            # one-shot (generator) batch_sampler must not be iterated twice
+            yield from self._iter_threaded(indices=self._mp_indices)
 
     def _iter_sync(self):
         for batch_idx in self.batch_sampler:
@@ -548,7 +624,7 @@ class DataLoader:
         `dataloader_iter.py:358`). Workers execute dataset[i] + collate as
         NUMPY work; the parent re-wraps arrays as Tensors. fork context when
         available (no pickling of the dataset), spawn otherwise."""
-        indices = list(self.batch_sampler)
+        indices = self._mp_indices = list(self.batch_sampler)
         if not indices:
             return iter(())
         nw = min(self.num_workers, len(indices))
@@ -582,7 +658,8 @@ class DataLoader:
                 p = ctx.Process(
                     target=_mp_worker_main,
                     args=(result_q, w, nw, self.dataset, collate, my,
-                          self.worker_init_fn, self.use_shared_memory),
+                          self.worker_init_fn, self.use_shared_memory,
+                          collate is not _np_collate),
                     daemon=True)
                 p.start()
                 procs.append(p)
@@ -618,10 +695,14 @@ class DataLoader:
                             "delivering all batches (check workerlog / "
                             "OOM killer)")
                     continue
+                if isinstance(data, _UnpicklableBatch):
+                    raise _PicklingFallback(data.reason)
                 if isinstance(data, _WorkerError):
                     raise RuntimeError(
                         f"DataLoader worker raised {data.type_name}:\n"
                         f"{data.formatted}")
+                if isinstance(data, _PickledBatch):
+                    data = pickle.loads(data.blob)
                 buffered[seq] = data
         finally:
             for p in procs:
@@ -632,17 +713,26 @@ class DataLoader:
             # early exit / worker error: unlink any shared-memory segments
             # still parked in unconsumed batches, or /dev/shm leaks one
             # segment per abandoned batch for the life of the process
-            for payload in buffered.values():
+            def _release(payload):
+                if isinstance(payload, _PickledBatch):
+                    try:  # shm descriptors live inside the pickled blob
+                        payload = pickle.loads(payload.blob)
+                    except Exception:
+                        return
                 _release_transport(payload)
+
+            for payload in buffered.values():
+                _release(payload)
             while True:
                 try:
                     _, payload = result_q.get_nowait()
                 except (queue.Empty, OSError, ValueError):
                     break
-                _release_transport(payload)
+                _release(payload)
 
-    def _iter_threaded(self):
-        indices = list(self.batch_sampler)
+    def _iter_threaded(self, indices=None):
+        if indices is None:
+            indices = list(self.batch_sampler)
         results: "queue.Queue" = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
         done = object()
 

@@ -395,7 +395,18 @@ class TrainStep:
 
     def __call__(self, *batch) -> Tensor:
         from ..framework.flags import get_flags
+        from ..incubate.asp import ASPHelper
 
+        # ASP masks are baked into the compiled program as constants; a
+        # prune_model/decorate AFTER construction would otherwise train
+        # dense silently (advisor round 3) — detect and refuse
+        for i, p in enumerate(self._params):
+            if ASPHelper._masks.get(id(p)) is not self._asp_masks[i]:
+                raise RuntimeError(
+                    f"ASP mask for parameter {self._param_names[i]!r} "
+                    "changed after this TrainStep was compiled; call "
+                    "asp.prune_model BEFORE building the TrainStep (or "
+                    "rebuild it)")
         states = self._opt_states()
         param_arrays = [p._value for p in self._params]
         buffer_arrays = [b._value for b in self._buffers]

@@ -29,10 +29,15 @@ class GPTConfig:
     layer_norm_eps: float = 1e-5
     dropout: float = 0.0
     initializer_range: float = 0.02
+    recompute: bool = False  # rematerialize each block (jax.checkpoint)
+    # explicit head_dim decouples the per-head width from hidden/heads so a
+    # Megatron-style TP slice (heads/tp at full head_dim) is expressible —
+    # reference: fleet mp_layers head-split `mpu/mp_layers.py:335`
+    head_dim: int = None  # type: ignore[assignment]
 
-    @property
-    def head_dim(self):
-        return self.hidden_size // self.num_attention_heads
+    def __post_init__(self):
+        if self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
 
 
 def gpt_tiny(**kw) -> GPTConfig:
@@ -80,7 +85,8 @@ class GPTBlock(nn.Layer):
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         attn = F.scaled_dot_product_attention(q, k, v, is_causal=True,
                                               dropout_p=cfg.dropout, training=self.training)
-        x = x + self.dropout(self.out_proj(reshape(attn, [b, s, cfg.hidden_size])))
+        x = x + self.dropout(self.out_proj(
+            reshape(attn, [b, s, cfg.num_attention_heads * cfg.head_dim])))
         x = x + self.dropout(self.fc_out(F.gelu(self.fc_in(self.ln_2(x)))))
         return x
 
@@ -107,8 +113,14 @@ class GPTModel(nn.Layer):
                 f"{self.config.max_position_embeddings}")
         pos = Tensor(jnp.arange(s))
         x = self.drop(self.wte(input_ids) + self.wpe(pos))
-        for block in self.h:
-            x = block(x)
+        if self.config.recompute:
+            from ..distributed.fleet_utils import recompute
+
+            for block in self.h:
+                x = recompute(block, x)
+        else:
+            for block in self.h:
+                x = block(x)
         return self.ln_f(x)
 
 

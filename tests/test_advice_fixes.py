@@ -1,6 +1,8 @@
-"""Regression tests for the round-1 advisor findings (ADVICE.md): batch_norm
+"""Regression tests for advisor findings (ADVICE.md). Round 1: batch_norm
 eager gradients, pool ceil_mode/return_mask, AmpScaler.minimize contract,
-interpolate align_corners, AdamW lr_ratio."""
+interpolate align_corners, AdamW lr_ratio. Round 3: rpc frame auth, ASP
+masks registered after TrainStep compilation, DataLoader unpicklable custom
+collate, deepcopy of an O2-decorated model."""
 
 import numpy as np
 import pytest
@@ -153,6 +155,144 @@ class TestAdamWLrRatio:
         opt.step()
         np.testing.assert_allclose(m.weight.numpy(), w0)
         assert not np.allclose(m.bias.numpy(), b0)
+
+
+class TestRpcFrameAuth:
+    def test_hmac_roundtrip_and_tamper_rejection(self):
+        import socket
+        import threading
+
+        from paddle_tpu.distributed.rpc import _recv_blob, _send_blob
+
+        secret = b"s3cret"
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        got = {}
+
+        def receiver(expect_secret):
+            conn, _ = srv.accept()
+            with conn:
+                try:
+                    got["blob"] = _recv_blob(conn, expect_secret)
+                except PermissionError as e:
+                    got["err"] = e
+
+        # 1) same secret → payload arrives
+        t = threading.Thread(target=receiver, args=(secret,))
+        t.start()
+        with socket.create_connection(("127.0.0.1", port)) as c:
+            _send_blob(c, b"payload", secret)
+        t.join()
+        assert got.pop("blob") == b"payload"
+
+        # 2) wrong secret (tampered/foreign frame) → rejected BEFORE pickle
+        t = threading.Thread(target=receiver, args=(secret,))
+        t.start()
+        with socket.create_connection(("127.0.0.1", port)) as c:
+            _send_blob(c, b"payload", b"wrong-secret")
+        t.join()
+        srv.close()
+        assert isinstance(got.get("err"), PermissionError)
+
+    def test_local_ip_resolves_routable_interface(self):
+        from paddle_tpu.distributed.rpc import _local_ip
+
+        ip = _local_ip("127.0.0.1:12345")
+        assert ip.startswith("127.")
+        import os
+
+        os.environ["PADDLE_LOCAL_IP"] = "10.1.2.3"
+        try:
+            assert _local_ip("127.0.0.1:1") == "10.1.2.3"
+        finally:
+            del os.environ["PADDLE_LOCAL_IP"]
+
+
+class TestAspLateMask:
+    def test_prune_after_trainstep_compilation_raises(self):
+        from paddle_tpu.incubate import asp
+
+        asp.ASPHelper.reset()
+        paddle.seed(0)
+        m = nn.Linear(8, 8)
+        opt = paddle.optimizer.SGD(0.1, parameters=m.parameters())
+        step = paddle.jit.TrainStep(m, lambda mm, x, y: ((mm(x) - y) ** 2).mean(),
+                                    opt)
+        x = paddle.rand([4, 8])
+        y = paddle.rand([4, 8])
+        float(step(x, y).numpy())  # dense step works
+        asp.prune_model(m)  # masks registered AFTER compilation
+        try:
+            with pytest.raises(RuntimeError, match="ASP mask.*changed"):
+                step(x, y)
+        finally:
+            asp.ASPHelper.reset()
+
+    def test_prune_before_trainstep_still_masks(self):
+        from paddle_tpu.incubate import asp
+
+        asp.ASPHelper.reset()
+        paddle.seed(1)
+        m = nn.Linear(8, 8)
+        asp.prune_model(m)
+        opt = paddle.optimizer.SGD(0.1, parameters=m.parameters())
+        step = paddle.jit.TrainStep(m, lambda mm, x, y: ((mm(x) - y) ** 2).mean(),
+                                    opt)
+        float(step(paddle.rand([4, 8]), paddle.rand([4, 8])).numpy())
+        w = m.weight.numpy()
+        # 2:4 sparsity held through the fused update
+        assert asp.check_mask_1d(w.T) or asp.check_mask_1d(w)
+        asp.ASPHelper.reset()
+
+
+class TestDataLoaderPicklingFallback:
+    def test_unpicklable_custom_collate_falls_back_to_threads(self, caplog):
+        import logging
+
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class DS(Dataset):
+            def __getitem__(self, i):
+                return np.float32(i)
+
+            def __len__(self):
+                return 8
+
+        def collate(batch):  # output closes over a lambda → unpicklable
+            return {"value": np.stack(batch), "fn": lambda: None}
+
+        dl = DataLoader(DS(), batch_size=2, num_workers=2, collate_fn=collate)
+        with caplog.at_level(logging.WARNING, logger="paddle_tpu.io"):
+            out = list(dl)
+        assert len(out) == 4 and callable(out[0]["fn"])
+        assert any("not picklable" in r.message or "falling back" in r.message
+                   for r in caplog.records)
+
+
+class TestAmpO2Deepcopy:
+    def test_deepcopy_rebinds_forward_to_the_copy(self):
+        import copy
+
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        opt = paddle.optimizer.SGD(0.1, parameters=m.parameters())
+        m, opt = paddle.amp.decorate(m, opt, level="O2", dtype="bfloat16")
+        x = paddle.rand([2, 4])
+        before = m(x).numpy()
+
+        m2 = copy.deepcopy(m)
+        np.testing.assert_allclose(m2(x).numpy(), before, rtol=1e-3)
+        # zero the ORIGINAL's weights: the copy must be unaffected (the old
+        # bug kept the copy's forward bound to the original's parameters)
+        for p in m.parameters():
+            p.set_value(np.zeros(p.shape, dtype="float32"))
+        assert np.allclose(m(x).numpy(), 0.0)
+        np.testing.assert_allclose(m2(x).numpy(), before, rtol=1e-3)
+        # the copy's params are its own objects
+        assert {id(p) for p in m.parameters()}.isdisjoint(
+            {id(p) for p in m2.parameters()})
 
 
 class TestAmpScalerContract:

@@ -23,6 +23,80 @@ def mesh_222():
     yield dist.get_hybrid_communicate_group()
 
 
+class TestStrategyKnobAudit:
+    """Round-3 verdict #10: every DistributedStrategy knob is either honored
+    or rejected loudly — no silent catch-all (reference proto
+    `distributed_strategy.proto:359`)."""
+
+    @pytest.fixture(autouse=True)
+    def _restore_hcg(self, mesh_222):
+        yield  # fleet.init calls here replace the global HCG — restore it
+        set_hybrid_communicate_group(mesh_222)
+
+    def test_unknown_knob_raises(self):
+        s = dist.fleet.DistributedStrategy()
+        with pytest.raises(ValueError, match="unknown DistributedStrategy"):
+            s.not_a_real_knob = True
+
+    def test_unhonored_proto_knob_rejected_when_non_default(self):
+        s = dist.fleet.DistributedStrategy()
+        with pytest.raises(ValueError, match="does not honor"):
+            s.dgc = True
+        with pytest.raises(ValueError, match="does not honor"):
+            s.localsgd = True
+        s.dgc = False  # default value is harmless and accepted
+
+    def test_no_silent_extra_dict(self):
+        s = dist.fleet.DistributedStrategy()
+        assert not hasattr(type(s), "extra")
+        with pytest.raises(ValueError):
+            s.extra = {"whatever": 1}
+
+    def test_config_dict_typo_rejected_at_init(self):
+        s = dist.fleet.DistributedStrategy()
+        s.hybrid_configs = {"dp_degre": 8}  # typo'd key
+        with pytest.raises(ValueError, match="unknown key.*dp_degre"):
+            dist.fleet.init(is_collective=True, strategy=s)
+
+    def test_gradient_merge_config_keys_validated(self):
+        s = dist.fleet.DistributedStrategy()
+        s.gradient_merge = True
+        s.gradient_merge_configs = {"k_step": 4}  # should be k_steps
+        with pytest.raises(ValueError, match="k_step"):
+            dist.fleet.init(is_collective=True, strategy=s)
+
+    def test_asp_knob_is_honored(self):
+        from paddle_tpu.incubate import asp
+
+        asp.ASPHelper.reset()
+        s = dist.fleet.DistributedStrategy()
+        s.asp = True
+        s.hybrid_configs = {"dp_degree": 8}
+        dist.fleet.init(is_collective=True, strategy=s)
+        paddle.seed(3)
+        m = nn.Linear(8, 8)
+        asp.prune_model(m)
+        opt = paddle.optimizer.SGD(0.1, parameters=m.parameters())
+        opt = dist.fleet.distributed_optimizer(opt)
+        loss = (m(paddle.rand([2, 8])) ** 2).sum()
+        loss.backward()
+        opt.step()
+        w = m.weight.numpy()
+        assert asp.check_mask_1d(w.T) or asp.check_mask_1d(w)
+        asp.ASPHelper.reset()
+
+    def test_sharding_offload_knob_wires_through(self):
+        s = dist.fleet.DistributedStrategy()
+        s.sharding = True
+        s.sharding_configs = {"stage": 3, "offload": True}
+        s.hybrid_configs = {"dp_degree": 2, "sharding_degree": 4}
+        dist.fleet.init(is_collective=True, strategy=s)
+        opt = paddle.optimizer.SGD(0.1, parameters=nn.Linear(2, 2).parameters())
+        opt = dist.fleet.distributed_optimizer(opt)
+        assert opt._sharding_offload is True
+        assert opt._sharding_stage == 3
+
+
 class TestTopology:
     def test_mesh_axes_and_degrees(self, mesh_222):
         hcg = mesh_222
