@@ -1,0 +1,211 @@
+"""Autoregressive decoding: static-shape KV cache + ``GenerationMixin``.
+
+Reference capability: the serving attention stack —
+`/root/reference/paddle/phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu:1`
+(single-token cached attention), `block_multi_head_attention_kernel.cu:1`
+(paged cache), and the python surface
+`/root/reference/python/paddle/incubate/nn/functional/fused_transformer.py:976`
+(``fused_multi_transformer`` with ``cache_kvs``).  There decode is a ring of
+fused CUDA kernels driven from python; the TPU-native translation compiles
+the ENTIRE generation — prefill, every decode step, cache updates, sampling,
+the eos latch — into ONE XLA program (``lax.scan`` over the decode steps),
+so there is no per-token dispatch at all.
+
+Design (TPU-first):
+- the cache is a list of per-layer ``(k, v)`` arrays of STATIC shape
+  ``[batch, prompt+max_new, kv_heads, head_dim]``; the write position is a
+  traced scalar (``lax.dynamic_update_slice``), so shapes never change and
+  there is exactly one compile per (batch, prompt_len, max_new, sampling
+  config) signature.
+- decode attends over the full static cache with an additive position mask
+  (``col <= pos``) — the XLA fusion of (cache write + masked attention) is
+  the analogue of the reference's masked_multihead_attention kernel.
+- greedy / temperature / top-k / top-p sampling run inside the same
+  program via ``jax.random``; finished rows are latched on eos and emit
+  ``pad_token_id`` while the others continue (static shapes).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import no_grad
+from ..tensor.tensor import Tensor
+
+__all__ = ["GenerationMixin", "cached_attention"]
+
+
+def cached_attention(q, k_new, v_new, cache_k, cache_v, pos):
+    """Write ``k_new``/``v_new`` into the static cache at ``pos`` and attend
+    ``q`` over the cache prefix (absolute-position causal mask).
+
+    q: [b, s, h, d]; k_new/v_new: [b, s, kv, d]; cache_k/v: [b, C, kv, d];
+    ``pos``: traced or static int scalar — absolute position of q's first
+    token.  Returns (out [b, s, h, d], new_cache_k, new_cache_v).
+
+    Match: masked_multihead_attention_kernel.cu:1 (the decode s=1 case) —
+    one fused cache-update + attention, no [C, C] matrix, no dynamic shape.
+    """
+    b, s, h, d = q.shape
+    kv = k_new.shape[2]
+    C = cache_k.shape[1]
+    cache_k = jax.lax.dynamic_update_slice_in_dim(
+        cache_k, k_new.astype(cache_k.dtype), pos, 1)
+    cache_v = jax.lax.dynamic_update_slice_in_dim(
+        cache_v, v_new.astype(cache_v.dtype), pos, 1)
+    k = cache_k
+    v = cache_v
+    if kv != h:  # GQA: broadcast kv groups up to the query heads
+        k = jnp.repeat(k, h // kv, axis=2)
+        v = jnp.repeat(v, h // kv, axis=2)
+    scores = jnp.einsum("bshd,bchd->bhsc", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) / jnp.sqrt(float(d))
+    col = jnp.arange(C)[None, None, None, :]
+    row = pos + jnp.arange(s)[None, None, :, None]
+    scores = jnp.where(col <= row, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhsc,bchd->bshd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype), cache_k, cache_v
+
+
+class GenerationMixin:
+    """``model.generate(input_ids, max_new_tokens=...)`` for causal-LM
+    Layers whose forward accepts ``kv_cache``/``position_offset`` and then
+    returns ``(logits, new_cache)`` (LlamaForCausalLM, GPTForCausalLM).
+
+    Returns the paddle/PaddleNLP-shaped pair ``(ids, scores)``: generated
+    token ids ``[batch, <=max_new_tokens]`` (prompt NOT included) and the
+    per-token log-probability of each chosen token."""
+
+    def _kv_cache_spec(self) -> Tuple[int, int, int]:
+        """(num_layers, kv_heads, head_dim) — override per model family."""
+        cfg = self.config
+        kv = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
+        return cfg.num_hidden_layers, kv, cfg.head_dim
+
+    # -- public API --------------------------------------------------------
+    @no_grad()
+    def generate(self, input_ids, max_new_tokens: int = 64,
+                 do_sample: bool = False, top_k: int = 0, top_p: float = 1.0,
+                 temperature: float = 1.0,
+                 eos_token_id: Optional[int] = None,
+                 pad_token_id: Optional[int] = None, seed: int = 0):
+        """Greedy (``do_sample=False``) or sampled decoding with a static
+        KV cache, fully jit-compiled (prefill + scan over decode steps).
+
+        ``input_ids``: int Tensor/array [batch, prompt_len] (no padding —
+        batched ragged prompts need left-padding + attention_mask, which
+        this v1 does not implement).  Rows that emit ``eos_token_id`` are
+        latched and emit ``pad_token_id`` (default: eos) afterwards."""
+        ids = input_ids._value if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        if ids.ndim != 2:
+            raise ValueError(f"input_ids must be [batch, seq], got {ids.shape}")
+        b, prompt = int(ids.shape[0]), int(ids.shape[1])
+        max_new = int(max_new_tokens)
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = prompt + max_new
+        max_pos = self.config.max_position_embeddings
+        if total > max_pos:
+            raise ValueError(
+                f"prompt ({prompt}) + max_new_tokens ({max_new}) = {total} "
+                f"exceeds max_position_embeddings {max_pos}")
+        eos = -1 if eos_token_id is None else int(eos_token_id)
+        pad = eos if pad_token_id is None else int(pad_token_id)
+        sig = (b, prompt, max_new, bool(do_sample), int(top_k),
+               float(top_p), float(temperature), eos, pad)
+        cache: Dict = self.__dict__.setdefault("_generate_cache", {})
+        if sig not in cache:
+            cache[sig] = self._build_generate(*sig)
+        params = [p for _, p in self.named_parameters()]
+        buffers = [bf for _, bf in self.named_buffers()]
+        out_ids, scores = cache[sig](
+            [p._value for p in params], [bf._value for bf in buffers],
+            ids.astype(jnp.int32), jax.random.PRNGKey(seed))
+        return Tensor(out_ids), Tensor(scores)
+
+    # -- compiled program --------------------------------------------------
+    def _build_generate(self, b, prompt, max_new, do_sample, top_k, top_p,
+                        temperature, eos, pad):
+        from ..jit import _StateSwap
+
+        params = [p for _, p in self.named_parameters()]
+        buffers = [bf for _, bf in self.named_buffers()]
+        n_layers, kv_heads, head_dim = self._kv_cache_spec()
+        total = prompt + max_new
+        model = self
+
+        def sample_tok(logits, key):
+            logits = logits.astype(jnp.float32)
+            logprobs_full = jax.nn.log_softmax(logits, axis=-1)
+            if not do_sample:
+                tok = jnp.argmax(logits, axis=-1)
+            else:
+                scaled = logits / max(temperature, 1e-6)
+                if top_k and top_k > 0:
+                    k_eff = min(int(top_k), scaled.shape[-1])
+                    kth = jnp.sort(scaled, axis=-1)[:, -k_eff][:, None]
+                    scaled = jnp.where(scaled < kth,
+                                       jnp.finfo(jnp.float32).min, scaled)
+                if top_p < 1.0:
+                    srt = jnp.sort(scaled, axis=-1)[:, ::-1]
+                    cdf = jnp.cumsum(jax.nn.softmax(srt, axis=-1), axis=-1)
+                    # smallest set with cumulative prob >= top_p (the
+                    # chosen token itself always survives)
+                    cutoff_idx = jnp.sum(cdf < top_p, axis=-1)
+                    kth = jnp.take_along_axis(srt, cutoff_idx[:, None],
+                                              axis=-1)
+                    scaled = jnp.where(scaled < kth,
+                                       jnp.finfo(jnp.float32).min, scaled)
+                tok = jax.random.categorical(key, scaled, axis=-1)
+            logp = jnp.take_along_axis(logprobs_full, tok[:, None],
+                                       axis=-1)[:, 0]
+            return tok.astype(jnp.int32), logp
+
+        def step_model(ids_slice, caches, offset):
+            logits, caches = model(Tensor(ids_slice), kv_cache=caches,
+                                   position_offset=offset)
+            return logits._value, caches
+
+        def fn(param_arrays, buffer_arrays, ids, key):
+            with _StateSwap(params, param_arrays), \
+                    _StateSwap(buffers, buffer_arrays), no_grad():
+                cdt = next((a.dtype for a in param_arrays
+                            if jnp.issubdtype(a.dtype, jnp.floating)),
+                           jnp.float32)
+                caches = [(jnp.zeros((b, total, kv_heads, head_dim), cdt),
+                           jnp.zeros((b, total, kv_heads, head_dim), cdt))
+                          for _ in range(n_layers)]
+                logits, caches = step_model(ids, caches, 0)  # prefill
+                key, sub = jax.random.split(key)
+                tok, logp = sample_tok(logits[:, -1, :], sub)
+                done = tok == eos
+                tok = jnp.where(done & (eos >= 0), eos, tok)
+
+                def body(carry, _):
+                    prev, caches, offset, key, done = carry
+                    logits, caches = step_model(prev[:, None], caches, offset)
+                    key, sub = jax.random.split(key)
+                    nxt, logp = sample_tok(logits[:, -1, :], sub)
+                    nxt = jnp.where(done, jnp.asarray(pad, jnp.int32), nxt)
+                    logp = jnp.where(done, 0.0, logp)
+                    done = done | (nxt == eos)
+                    return (nxt, caches, offset + 1, key, done), (nxt, logp)
+
+                carry0 = (tok, caches, jnp.asarray(prompt, jnp.int32), key,
+                          done)
+                if max_new > 1:
+                    _, (rest, rest_logp) = jax.lax.scan(
+                        body, carry0, None, length=max_new - 1)
+                    out = jnp.concatenate([tok[:, None], rest.T], axis=1)
+                    scores = jnp.concatenate([logp[:, None], rest_logp.T],
+                                             axis=1)
+                else:
+                    out, scores = tok[:, None], logp[:, None]
+            return out, scores
+
+        return jax.jit(fn)

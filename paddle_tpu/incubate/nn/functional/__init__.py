@@ -57,15 +57,36 @@ def fused_layer_norm(x, norm_weight, norm_bias, epsilon: float = 1e-5,
                      begin_norm_axis: int = -1, bias=None, residual=None,
                      quant_scale: float = -1, quant_round_type: int = 0,
                      quant_max_bound: float = 0, quant_min_bound: float = 0):
-    """LayerNorm(x [+ bias] [+ residual]); tuple convention as fused_rms_norm."""
+    """LayerNorm(x [+ bias] [+ residual]); tuple convention as fused_rms_norm.
+    The residual path dispatches to the fused Pallas add+LayerNorm kernel
+    on TPU (``use_fused_layernorm`` — one HBM pass fwd and bwd, the
+    fused_layernorm_kernel.cu analogue)."""
     if quant_scale > 0:
         raise NotImplementedError("quantized fused_layer_norm output is not supported on TPU")
+    from ....ops import pallas_mode
+    from ....tensor.tensor import apply_op
+
     x = ensure_tensor(x)
     pre = x
     if bias is not None:
         pre = pre + ensure_tensor(bias)
     if residual is not None:
-        pre = pre + ensure_tensor(residual)
+        res_t = ensure_tensor(residual)
+        mode = pallas_mode("use_fused_layernorm")
+        h = pre.shape[-1]
+        rows = pre.size // h
+        if mode is not None and mode[0] == "local" and norm_bias is not None \
+                and res_t.shape == pre.shape \
+                and rows % 8 == 0 and h % 128 == 0:  # Mosaic tile alignment
+            from ....ops.pallas.fused_ln_swiglu import fused_add_layer_norm
+
+            return apply_op(
+                "fused_add_layer_norm",
+                lambda xv, rv, wv, bv: fused_add_layer_norm(
+                    xv, rv, wv, bv, epsilon, mode[2]),
+                (pre, res_t, ensure_tensor(norm_weight),
+                 ensure_tensor(norm_bias)), multi_out=True)
+        pre = pre + res_t
     shape = [pre.shape[-1]]
     out = F.layer_norm(pre, shape, weight=norm_weight, bias=norm_bias, epsilon=epsilon)
     return (out, pre) if residual is not None else out

@@ -112,7 +112,38 @@ class _Handle:
 
 
 class Predictor:
-    """Handle-based predictor over a ``jit.load``-ed StableHLO program."""
+    """Handle-based predictor over a ``jit.load``-ed StableHLO program, or
+    (``Predictor.from_model``) over a live causal-LM Layer — the decode
+    serving path: ``predictor.generate(input_ids, max_new_tokens=...)``
+    runs the model's jit-compiled KV-cache decode loop (the reference
+    serves this via fused_multi_transformer inside its engine,
+    `incubate/nn/functional/fused_transformer.py:976`)."""
+
+    @classmethod
+    def from_model(cls, model) -> "Predictor":
+        """Serve a live Layer (weights already loaded). Unlike the
+        StableHLO artifact path — a single fixed-signature program — the
+        model-backed predictor can run the parametric generation loop."""
+        self = cls.__new__(cls)
+        self._config = None
+        self._layer = model
+        self._input_names = ["input_0"]
+        self._inputs = {n: _Handle(n) for n in self._input_names}
+        self._outputs = {}
+        return self
+
+    def generate(self, input_ids, **kwargs):
+        """KV-cache decoding (GenerationMixin.generate pass-through):
+        returns (ids, scores) numpy arrays."""
+        gen = getattr(self._layer, "generate", None)
+        if gen is None:
+            raise RuntimeError(
+                "this Predictor serves a StableHLO artifact (a single "
+                "fixed-signature program) — autoregressive decoding needs "
+                "the parametric model; build it with "
+                "Predictor.from_model(model) instead")
+        ids, scores = gen(input_ids, **kwargs)
+        return np.asarray(ids.numpy()), np.asarray(scores.numpy())
 
     def __init__(self, config: Config):
         from ..jit import load as jit_load

@@ -11,6 +11,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from .. import nn
+from ..generation import GenerationMixin
 from ..nn import functional as F
 from ..tensor.manipulation import reshape
 from ..tensor.tensor import Tensor
@@ -77,16 +78,34 @@ class GPTBlock(nn.Layer):
         self.dropout = nn.Dropout(config.dropout)
         self.config = config
 
-    def forward(self, x):
+    def forward(self, x, position_offset: int = 0, kv_cache=None):
         cfg = self.config
         b, s = x.shape[0], x.shape[1]
         qkv = self.qkv_proj(self.ln_1(x))
         qkv = reshape(qkv, [b, s, 3, cfg.num_attention_heads, cfg.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if kv_cache is not None:
+            from ..generation import cached_attention
+
+            out_v, ck, cv = cached_attention(
+                q._value, k._value, v._value, kv_cache[0], kv_cache[1],
+                position_offset)
+            x = x + self.dropout(self.out_proj(Tensor(out_v.reshape(
+                b, s, cfg.num_attention_heads * cfg.head_dim))))
+            x = x + self.dropout(self.fc_out(F.gelu(self.fc_in(self.ln_2(x)))))
+            return x, (ck, cv)
         attn = F.scaled_dot_product_attention(q, k, v, is_causal=True,
                                               dropout_p=cfg.dropout, training=self.training)
-        x = x + self.dropout(self.out_proj(
-            reshape(attn, [b, s, cfg.num_attention_heads * cfg.head_dim])))
+        a = self.out_proj(reshape(attn, [b, s, cfg.num_attention_heads * cfg.head_dim]))
+        if cfg.dropout == 0.0:
+            # fused residual-add + LayerNorm (Pallas on TPU, jnp fallback):
+            # ln_2(x + a) and the sum come back from ONE kernel sweep
+            from ..incubate.nn.functional import fused_layer_norm
+
+            y, h = fused_layer_norm(a, self.ln_2.weight, self.ln_2.bias,
+                                    epsilon=cfg.layer_norm_eps, residual=x)
+            return h + self.fc_out(F.gelu(self.fc_in(y)))
+        x = x + self.dropout(a)
         x = x + self.dropout(self.fc_out(F.gelu(self.fc_in(self.ln_2(x)))))
         return x
 
@@ -103,16 +122,24 @@ class GPTModel(nn.Layer):
         self.h = nn.LayerList([GPTBlock(config) for _ in range(config.num_hidden_layers)])
         self.ln_f = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, position_offset: int = 0, kv_cache=None):
         import jax.numpy as jnp
 
         s = input_ids.shape[1]
-        if s > self.config.max_position_embeddings:
+        if isinstance(position_offset, int) and \
+                s + position_offset > self.config.max_position_embeddings:
             raise ValueError(
-                f"sequence length {s} exceeds max_position_embeddings "
+                f"sequence length {s} (+offset {position_offset}) exceeds "
+                f"max_position_embeddings "
                 f"{self.config.max_position_embeddings}")
-        pos = Tensor(jnp.arange(s))
+        pos = Tensor(jnp.arange(s) + position_offset)
         x = self.drop(self.wte(input_ids) + self.wpe(pos))
+        if kv_cache is not None:
+            new_caches = []
+            for block, lc in zip(self.h, kv_cache):
+                x, nc = block(x, position_offset, kv_cache=lc)
+                new_caches.append(nc)
+            return self.ln_f(x), new_caches
         if self.config.recompute:
             from ..distributed.fleet_utils import recompute
 
@@ -124,7 +151,7 @@ class GPTModel(nn.Layer):
         return self.ln_f(x)
 
 
-class GPTForCausalLM(nn.Layer):
+class GPTForCausalLM(nn.Layer, GenerationMixin):
     """Weight-tied LM head (GPT convention)."""
 
     def __init__(self, config: GPTConfig):
@@ -132,7 +159,12 @@ class GPTForCausalLM(nn.Layer):
         self.config = config
         self.gpt = GPTModel(config)
 
-    def forward(self, input_ids, labels=None):
+    def forward(self, input_ids, labels=None, kv_cache=None,
+                position_offset: int = 0):
+        if kv_cache is not None:  # decode path: (logits, new_cache)
+            hidden, new_cache = self.gpt(input_ids, position_offset,
+                                         kv_cache=kv_cache)
+            return F.linear(hidden, self.gpt.wte.weight.T), new_cache
         hidden = self.gpt(input_ids)
         logits = F.linear(hidden, self.gpt.wte.weight.T)
         if labels is not None:

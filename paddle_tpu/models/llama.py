@@ -15,10 +15,12 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .. import nn
+from ..generation import GenerationMixin
 from ..nn import functional as F
 from ..tensor.manipulation import reshape
 from ..tensor.tensor import Tensor, apply_op
@@ -139,7 +141,8 @@ def apply_rotary_pos_emb(q: Tensor, k: Tensor, cos, sin, position_offset: int = 
 
     s = q.shape[1]
     mode = pallas_mode("use_fused_rope")
-    if mode is not None and q.shape[-1] % 2 == 0 and s % 8 == 0:
+    if mode is not None and q.shape[-1] % 2 == 0 and s % 8 == 0 \
+            and isinstance(position_offset, int):  # decode offsets are traced
         kind, mesh, interp = mode
         from ..ops.pallas import fused_rope
         from ..ops.sharded import mesh_rope, mesh_rope_supported
@@ -159,8 +162,10 @@ def apply_rotary_pos_emb(q: Tensor, k: Tensor, cos, sin, position_offset: int = 
                                                       interpret=interp),
                             (q, k), multi_out=True)
 
-    cos_s = cos[position_offset:position_offset + s][None, :, None, :]
-    sin_s = sin[position_offset:position_offset + s][None, :, None, :]
+    # dynamic_slice accepts both static ints and traced scalars (the
+    # jit-compiled decode step carries position_offset as a traced int32)
+    cos_s = jax.lax.dynamic_slice_in_dim(cos, position_offset, s, 0)[None, :, None, :]
+    sin_s = jax.lax.dynamic_slice_in_dim(sin, position_offset, s, 0)[None, :, None, :]
 
     def rot(v):
         half = v.shape[-1] // 2
@@ -187,13 +192,30 @@ class LlamaAttention(nn.Layer):
         self.v_proj = nn.Linear(config.hidden_size, kv * d, weight_attr=init, bias_attr=False)
         self.o_proj = nn.Linear(h * d, config.hidden_size, weight_attr=init, bias_attr=False)
 
-    def forward(self, x, cos, sin, attn_mask=None, position_offset: int = 0):
+    def forward(self, x, cos, sin, attn_mask=None, position_offset: int = 0,
+                kv_cache=None):
         b, s = x.shape[0], x.shape[1]
         cfg = self.config
         q = reshape(self.q_proj(x), [b, s, cfg.num_attention_heads, cfg.head_dim])
         k = reshape(self.k_proj(x), [b, s, cfg.num_key_value_heads, cfg.head_dim])
         v = reshape(self.v_proj(x), [b, s, cfg.num_key_value_heads, cfg.head_dim])
         q, k = apply_rotary_pos_emb(q, k, cos, sin, position_offset)
+        if kv_cache is not None:
+            # decode path (generation/__init__.py): write k/v into the
+            # static cache at position_offset, attend over the prefix; no
+            # grads flow here, so raw-value math is fine
+            if attn_mask is not None:
+                raise NotImplementedError(
+                    "attn_mask with kv_cache (left-padded batched prompts) "
+                    "is not implemented — pad-free prompts only")
+            from ..generation import cached_attention
+
+            out_v, ck, cv = cached_attention(
+                q._value, k._value, v._value, kv_cache[0], kv_cache[1],
+                position_offset)
+            out = self.o_proj(Tensor(out_v.reshape(
+                b, s, cfg.num_attention_heads * cfg.head_dim)))
+            return out, (ck, cv)
         out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask, is_causal=True)
         return self.o_proj(reshape(out, [b, s, cfg.num_attention_heads * cfg.head_dim]))
 
@@ -230,7 +252,15 @@ class LlamaDecoderLayer(nn.Layer):
         self.input_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
         self.post_attention_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
 
-    def forward(self, x, cos, sin, attn_mask=None, position_offset: int = 0):
+    def forward(self, x, cos, sin, attn_mask=None, position_offset: int = 0,
+                kv_cache=None):
+        if kv_cache is not None:
+            attn, new_cache = self.self_attn(self.input_layernorm(x), cos, sin,
+                                             attn_mask, position_offset,
+                                             kv_cache)
+            x = x + attn
+            x = x + self.mlp(self.post_attention_layernorm(x))
+            return x, new_cache
         x = x + self.self_attn(self.input_layernorm(x), cos, sin, attn_mask, position_offset)
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
@@ -254,17 +284,28 @@ class LlamaModel(nn.Layer):
         self.register_buffer("rope_cos", Tensor(cos), persistable=False)
         self.register_buffer("rope_sin", Tensor(sin), persistable=False)
 
-    def forward(self, input_ids, attn_mask=None, position_offset: int = 0):
+    def forward(self, input_ids, attn_mask=None, position_offset: int = 0,
+                kv_cache=None):
         """``attn_mask``: either an additive float mask (0 to keep, large
         negative to drop) or a bool/int keep-mask (True/1 = attend), which is
-        converted to additive form; causal masking is always applied."""
-        if input_ids.shape[1] + position_offset > self.config.max_position_embeddings:
+        converted to additive form; causal masking is always applied.
+        ``kv_cache``: list of per-layer (k, v) static-shape cache arrays —
+        the decode path; returns (hidden, new_cache)."""
+        if isinstance(position_offset, int) and \
+                input_ids.shape[1] + position_offset > self.config.max_position_embeddings:
             raise ValueError(
                 f"sequence length {input_ids.shape[1]} (+offset {position_offset}) exceeds "
                 f"max_position_embeddings {self.config.max_position_embeddings}")
         attn_mask = _normalize_mask(attn_mask)
         x = self.embed_tokens(input_ids)
         cos, sin = self.rope_cos._value, self.rope_sin._value
+        if kv_cache is not None:
+            new_caches = []
+            for layer, lc in zip(self.layers, kv_cache):
+                x, nc = layer(x, cos, sin, attn_mask, position_offset,
+                              kv_cache=lc)
+                new_caches.append(nc)
+            return self.norm(x), new_caches
         if self.config.recompute:
             from ..distributed.fleet_utils import recompute
 
@@ -283,7 +324,7 @@ class LlamaModel(nn.Layer):
         return self.norm(x)
 
 
-class LlamaForCausalLM(nn.Layer):
+class LlamaForCausalLM(nn.Layer, GenerationMixin):
     def __init__(self, config: LlamaConfig):
         super().__init__()
         self.config = config
@@ -296,7 +337,16 @@ class LlamaForCausalLM(nn.Layer):
                                          0.0, config.initializer_range),
                                      bias_attr=False)
 
-    def forward(self, input_ids, labels=None, attn_mask=None):
+    def forward(self, input_ids, labels=None, attn_mask=None, kv_cache=None,
+                position_offset: int = 0):
+        if kv_cache is not None:  # decode path: (logits, new_cache)
+            hidden, new_cache = self.llama(input_ids, attn_mask,
+                                           position_offset, kv_cache=kv_cache)
+            if self.lm_head is not None:
+                logits = self.lm_head(hidden)
+            else:
+                logits = F.linear(hidden, self.llama.embed_tokens.weight.T)
+            return logits, new_cache
         hidden = self.llama(input_ids, attn_mask)
         if labels is not None and self.config.fused_ce_chunk > 0:
             # chunked fused linear+CE: the full [tokens, vocab] logits are

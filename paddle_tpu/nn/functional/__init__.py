@@ -132,10 +132,23 @@ def maxout(x, groups: int, axis: int = 1, name=None) -> Tensor:
 
 
 def swiglu(x, y=None, name=None) -> Tensor:
-    """SwiGLU (reference: `python/paddle/incubate/nn/functional/swiglu.py`)."""
+    """SwiGLU (reference: `python/paddle/incubate/nn/functional/swiglu.py`).
+    The two-argument form dispatches to the fused Pallas kernel on TPU
+    (``use_fused_swiglu``; custom fwd+bwd, one HBM pass per direction)."""
+    from ...ops import pallas_mode
+
     x = ensure_tensor(x)
     if y is not None:
         y = ensure_tensor(y)
+        mode = pallas_mode("use_fused_swiglu")
+        h = x.shape[-1] if x.ndim else 0
+        if mode is not None and mode[0] == "local" and x.shape == y.shape \
+                and h % 128 == 0 and (x.size // max(h, 1)) % 8 == 0:
+            from ...ops.pallas.fused_ln_swiglu import fused_swiglu
+
+            return apply_op("fused_swiglu",
+                            lambda a, b: fused_swiglu(a, b, interpret=mode[2]),
+                            (x, y))
         return apply_op("swiglu", lambda a, b: jax.nn.silu(a) * b, (x, y))
     return apply_op("swiglu", lambda v: jax.nn.silu(v[..., : v.shape[-1] // 2]) *
                     v[..., v.shape[-1] // 2:], (x,))

@@ -56,11 +56,12 @@ def _rows(x):
     return x.reshape(n, h), n, h
 
 
-def _block_rows(n: int, h: int) -> int:
+def _block_rows(n: int, h: int, bytes_per_elem: int = 28) -> int:
     """Largest divisor of n that is sublane-aligned (mult of 8) and keeps the
-    kernel's ~28 bytes/element working set inside VMEM, or n itself for small
-    inputs (full-array blocks are always legal)."""
-    cap = min(_BLOCK_ROWS, max(8, (448 * 1024) // h))
+    kernel's working set (``bytes_per_elem`` per element, double-buffered —
+    default 28 fits the norm kernels) inside the ~16M scoped VMEM, or n
+    itself for small inputs (full-array blocks are always legal)."""
+    cap = min(_BLOCK_ROWS, max(8, (448 * 1024) * 28 // bytes_per_elem // h))
     if n <= cap:
         return n
     b = cap - cap % 8

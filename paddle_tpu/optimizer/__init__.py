@@ -264,6 +264,24 @@ class Adam(Optimizer):
         if not self._decoupled():
             g = self._coupled_decay(p, g, param_meta)
         t = state.get("@t", 0) + 1
+        from ..ops import pallas_mode
+
+        mode = pallas_mode("use_fused_adamw")
+        if mode is not None and mode[0] == "local" and not self._amsgrad:
+            from ..ops.pallas.fused_ln_swiglu import (fused_adamw,
+                                                      fused_adamw_supported)
+        else:
+            fused_adamw_supported = None
+        if fused_adamw_supported is not None and fused_adamw_supported(p.size):
+            # one-sweep Pallas update (reference adamw_kernel.cu); math
+            # identical to the jnp chain below
+
+            decay = self._decoupled() and self._should_decay(param_meta)
+            new_p, m, v = fused_adamw(
+                p, g, state["moment1"], state["moment2"], lr, t,
+                self._beta1, self._beta2, self._epsilon,
+                float(self._weight_decay or 0.0), decay, interpret=mode[2])
+            return new_p, {"moment1": m, "moment2": v, "@t": t}
         m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
         v = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g)
         mhat = m / (1 - self._beta1 ** t)

@@ -121,6 +121,159 @@ class TestFusedRMSNorm:
                                        rtol=1e-4, atol=1e-5)
 
 
+class TestFusedAddLayerNorm:
+    """SURVEY §7.8 tail (round-3 verdict #7): residual-add + LayerNorm in
+    one kernel, fwd and bwd, including the cotangent flowing into the
+    returned residual sum."""
+
+    def _ref(self, x, r, w, b, eps=1e-5):
+        s = (x + r).astype(jnp.float32)
+        mu = jnp.mean(s, axis=-1, keepdims=True)
+        var = jnp.var(s, axis=-1, keepdims=True)
+        out = (s - mu) * jax.lax.rsqrt(var + eps) * w + b
+        return out.astype(x.dtype), s.astype(x.dtype)
+
+    def test_forward(self):
+        from paddle_tpu.ops.pallas.fused_ln_swiglu import fused_add_layer_norm
+
+        x = _rand(0, (4, 24, 256))
+        r = _rand(1, (4, 24, 256))
+        w = 1.0 + 0.1 * _rand(2, (256,))
+        b = 0.1 * _rand(3, (256,))
+        out, s = fused_add_layer_norm(x, r, w, b, 1e-5, True)
+        ro, rs = self._ref(x, r, w, b)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ro),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(s), np.asarray(rs),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_grads_both_outputs(self):
+        from paddle_tpu.ops.pallas.fused_ln_swiglu import fused_add_layer_norm
+
+        x = _rand(4, (6, 128))
+        r = _rand(5, (6, 128))
+        w = 1.0 + 0.1 * _rand(6, (128,))
+        b = 0.1 * _rand(7, (128,))
+
+        def loss_k(x, r, w, b):  # uses BOTH outputs (normed and the sum)
+            out, s = fused_add_layer_norm(x, r, w, b, 1e-5, True)
+            return jnp.sum(jnp.sin(out)) + jnp.sum(jnp.cos(s) * 0.3)
+
+        def loss_r(x, r, w, b):
+            out, s = self._ref(x, r, w, b)
+            return jnp.sum(jnp.sin(out)) + jnp.sum(jnp.cos(s) * 0.3)
+
+        gk = jax.grad(loss_k, argnums=(0, 1, 2, 3))(x, r, w, b)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2, 3))(x, r, w, b)
+        for a, e in zip(gk, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_incubate_surface_dispatches(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.nn.functional import fused_layer_norm
+
+        paddle.set_flags({"pallas_interpret": True})
+        try:
+            x = paddle.to_tensor(np.asarray(_rand(8, (2, 8, 128))))
+            r = paddle.to_tensor(np.asarray(_rand(9, (2, 8, 128))))
+            w = paddle.ones([128])
+            b = paddle.zeros([128])
+            out, pre = fused_layer_norm(x, w, b, residual=r)
+            ro, rs = self._ref(x._value, r._value, w._value, b._value)
+            np.testing.assert_allclose(out.numpy(), np.asarray(ro),
+                                       rtol=1e-5, atol=1e-5)
+            np.testing.assert_allclose(pre.numpy(), np.asarray(rs),
+                                       rtol=1e-6, atol=1e-6)
+        finally:
+            paddle.set_flags({"pallas_interpret": False})
+
+
+class TestFusedSwiglu:
+    def _ref(self, g, u):
+        return jax.nn.silu(g.astype(jnp.float32)) * u.astype(jnp.float32)
+
+    def test_forward_and_grads(self):
+        from paddle_tpu.ops.pallas.fused_ln_swiglu import fused_swiglu
+
+        g = _rand(10, (4, 16, 256))
+        u = _rand(11, (4, 16, 256))
+        out = fused_swiglu(g, u, True)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(self._ref(g, u)),
+                                   rtol=1e-5, atol=1e-5)
+        gk = jax.grad(lambda a, b: jnp.sum(jnp.sin(fused_swiglu(a, b, True))),
+                      argnums=(0, 1))(g, u)
+        gr = jax.grad(lambda a, b: jnp.sum(jnp.sin(
+            jax.nn.silu(a) * b)), argnums=(0, 1))(g, u)
+        for a, e in zip(gk, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_f_swiglu_dispatch_matches_jnp(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+
+        g = np.asarray(_rand(12, (2, 8, 128)))
+        u = np.asarray(_rand(13, (2, 8, 128)))
+        plain = F.swiglu(paddle.to_tensor(g), paddle.to_tensor(u)).numpy()
+        paddle.set_flags({"pallas_interpret": True})
+        try:
+            fused = F.swiglu(paddle.to_tensor(g), paddle.to_tensor(u)).numpy()
+        finally:
+            paddle.set_flags({"pallas_interpret": False})
+        np.testing.assert_allclose(fused, plain, rtol=1e-5, atol=1e-5)
+
+
+class TestFusedAdamW:
+    def test_matches_update_rule(self):
+        from paddle_tpu.ops.pallas.fused_ln_swiglu import fused_adamw
+
+        p = _rand(14, (256, 128))
+        g = 0.1 * _rand(15, (256, 128))
+        m = 0.01 * _rand(16, (256, 128))
+        v = jnp.abs(0.01 * _rand(17, (256, 128)))
+        lr, t, b1, b2, eps, wd = 1e-3, 7, 0.9, 0.999, 1e-8, 0.01
+        new_p, new_m, new_v = fused_adamw(p, g, m, v, lr, t, b1, b2, eps,
+                                          wd, True, interpret=True)
+        rm = b1 * m + (1 - b1) * g
+        rv = b2 * v + (1 - b2) * jnp.square(g)
+        mhat = rm / (1 - b1 ** t)
+        vhat = rv / (1 - b2 ** t)
+        rp = p - lr * mhat / (jnp.sqrt(vhat) + eps) - lr * wd * p
+        np.testing.assert_allclose(np.asarray(new_p), np.asarray(rp),
+                                   rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(new_m), np.asarray(rm),
+                                   rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(new_v), np.asarray(rv),
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_optimizer_flag_path_matches_dense(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+
+        def run(flag):
+            paddle.seed(0)
+            m = nn.Linear(128, 128, bias_attr=False)
+            opt = paddle.optimizer.AdamW(1e-2, parameters=m.parameters(),
+                                         weight_decay=0.01)
+            paddle.set_flags({"use_fused_adamw": flag,
+                              "pallas_interpret": flag})
+            try:
+                for _ in range(3):
+                    loss = (m(paddle.ones([4, 128])) ** 2).sum()
+                    loss.backward()
+                    opt.step()
+                    opt.clear_grad()
+            finally:
+                paddle.set_flags({"use_fused_adamw": False,
+                                  "pallas_interpret": False})
+            return m.weight.numpy()
+
+        np.testing.assert_allclose(run(True), run(False), rtol=1e-5,
+                                   atol=1e-6)
+
+
 class TestFusedRope:
     def _tables(self, s, d):
         from paddle_tpu.models.llama import _rope_tables
