@@ -177,12 +177,23 @@ define_flag("use_fused_rms_norm", True,
 define_flag("use_fused_rope", True,
             "Dispatch rotary embedding to the fused Pallas kernel on TPU "
             "(reference: fused_rotary_position_embedding.py surface).")
-define_flag("use_fused_layernorm", True,
+define_flag("flash_block_q", 256,
+            "Pallas flash attention query-block rows (kernel tile knob; "
+            "swept by bench_llama_longctx at 8K sequence).")
+define_flag("flash_block_k", 256,
+            "Pallas flash attention key-block rows.")
+define_flag("use_fused_layernorm", False,
             "Dispatch residual-add+LayerNorm to the fused Pallas kernel on "
-            "TPU (reference: fused_layernorm_kernel.cu surface).")
-define_flag("use_fused_swiglu", True,
+            "TPU (reference: fused_layernorm_kernel.cu surface). Default "
+            "off: the kernel wins forward-only (+3% at GPT-1.3B shapes on "
+            "v5e) but its custom VJP blocks XLA's bwd fusions — measured "
+            "-3% on the full GPT train step (48405 vs 49859 tok/s).")
+define_flag("use_fused_swiglu", False,
             "Dispatch two-argument swiglu to the fused Pallas kernel on TPU "
-            "(reference: fused_bias_act gated path).")
+            "(reference: fused_bias_act gated path). Default off: +13% on "
+            "the isolated MLP forward, but -5% on the full llama-670M train "
+            "step on v5e (26129 vs 27488 tok/s) — XLA's epilogue fusion + "
+            "rematerialization freedom beat the kernel end-to-end.")
 define_flag("use_fused_adamw", False,
             "Route the AdamW update through the Pallas one-sweep kernel "
             "(reference: adamw_kernel.cu multi-tensor apply). Default off: "

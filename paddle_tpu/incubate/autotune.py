@@ -1,22 +1,37 @@
 """Auto-tuning config (reference `python/paddle/incubate/autotune.py:24`).
 
 The reference's kernel autotune exhaustively searches cuDNN algorithms and
-caches winners; on TPU that search IS the XLA/Mosaic compiler's job
-(autotuned while lowering). `set_config` therefore validates and RECORDS
-the knobs for API parity — every section is inert at runtime, which is the
-honest TPU translation (there is no cuDNN-style algorithm choice to make;
-`get_config` exposes what was set)."""
+caches winners (`paddle/phi/kernels/autotune/cache.h:1`); on TPU the
+algorithm search IS the XLA/Mosaic compiler's job, but the LAYOUT choice is
+ours — and it is the difference between convs that tile onto the MXU and
+ones that do not (measured on v5e: bf16 3x3/256ch conv is ~23x faster with
+NHWC activations than NCHW).  The sections therefore mean:
+
+- ``layout``: CONSUMED.  :func:`resolve_conv_data_format` is read by
+  conv-stack models built with ``data_format="auto"`` (vision ResNet): when
+  enabled, the platform-optimal activation layout is chosen (NHWC on TPU,
+  NCHW elsewhere); an explicit ``data_format`` key overrides the choice on
+  any platform.  Disabling it pins NCHW — changing this config changes the
+  compiled program (the boundary transpose and every conv's dimension
+  numbers move).
+- ``kernel``: recorded only — the Pallas-vs-XLA kernel choice is
+  controlled by the FLAGS (use_flash_attention, use_fused_*), and the
+  algorithm-within-kernel search is XLA/Mosaic's; there is no runtime
+  search to toggle here.
+- ``dataloader``: recorded only (the reference tunes worker counts; our
+  DataLoader sizes its pool from ``num_workers`` explicitly).
+"""
 
 from __future__ import annotations
 
 import json
 from typing import Optional
 
-__all__ = ["set_config", "get_config"]
+__all__ = ["set_config", "get_config", "resolve_conv_data_format"]
 
 _config = {
     "kernel": {"enable": False, "tuning_range": [1, 10]},
-    "layout": {"enable": False},
+    "layout": {"enable": True, "data_format": None},
     "dataloader": {"enable": False},
 }
 
@@ -48,3 +63,22 @@ def set_config(config: Optional[dict] = None) -> None:
 
 def get_config() -> dict:
     return {k: dict(v) for k, v in _config.items()}
+
+
+def resolve_conv_data_format() -> str:
+    """The activation layout conv-stack models should use when built with
+    ``data_format="auto"``: the explicit ``layout.data_format`` override if
+    set, else NHWC on TPU / NCHW elsewhere when layout tuning is enabled,
+    else NCHW."""
+    layout = _config["layout"]
+    if layout.get("data_format"):
+        df = str(layout["data_format"]).upper()
+        if df not in ("NCHW", "NHWC"):
+            raise ValueError(f"autotune layout.data_format must be "
+                             f"NCHW/NHWC, got {df!r}")
+        return df
+    if not layout.get("enable", False):
+        return "NCHW"
+    from ..ops import _on_tpu
+
+    return "NHWC" if _on_tpu() else "NCHW"

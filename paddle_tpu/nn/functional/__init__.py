@@ -265,6 +265,11 @@ def _conv_padding(padding, nd):
 
 
 def _convnd(x, weight, bias, stride, padding, dilation, groups, nd, data_format, name):
+    """``data_format`` additionally accepts the boundary form "IN:OUT"
+    (e.g. "NCHW:NHWC"): the conv CONSUMES one layout and EMITS the other in
+    a single XLA op. This is how a channels-last conv stack ingests its
+    NCHW input — materializing a C=3 NHWC array would lane-pad 3 → 128
+    (measured ~42x the bytes on TPU)."""
     from ...amp import maybe_autocast_tensors
 
     x, weight = ensure_tensor(x), ensure_tensor(weight)
@@ -273,12 +278,15 @@ def _convnd(x, weight, bias, stride, padding, dilation, groups, nd, data_format,
     dil = _tuple_n(dilation, nd)
     pad = _conv_padding(padding, nd)
     spatial = "DHW"[-nd:]
-    if data_format.startswith("NC"):
-        lhs_spec = "NC" + spatial
-    else:
-        lhs_spec = "N" + spatial + "C"
+    in_fmt, _, out_fmt = data_format.partition(":")
+    out_fmt = out_fmt or in_fmt
+
+    def spec(fmt):
+        return ("NC" + spatial) if fmt.startswith("NC") else ("N" + spatial + "C")
+
+    lhs_spec, out_spec = spec(in_fmt), spec(out_fmt)
     dn = jax.lax.conv_dimension_numbers(
-        tuple(x.shape), tuple(weight.shape), (lhs_spec, "OI" + spatial, lhs_spec))
+        tuple(x.shape), tuple(weight.shape), (lhs_spec, "OI" + spatial, out_spec))
 
     def fn(v, w, *b):
         out = jax.lax.conv_general_dilated(
@@ -286,7 +294,7 @@ def _convnd(x, weight, bias, stride, padding, dilation, groups, nd, data_format,
             feature_group_count=groups)
         if b:
             bias_shape = [1] * out.ndim
-            c_axis = 1 if lhs_spec.startswith("NC") else out.ndim - 1
+            c_axis = 1 if out_spec.startswith("NC") else out.ndim - 1
             bias_shape[c_axis] = b[0].size
             out = out + b[0].astype(v.dtype).reshape(bias_shape)
         return out
@@ -1033,14 +1041,21 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
                                                 interpret=interp)
 
                 return apply_op("flash_attn", mesh_fn, tensors)
-        elif flash_attention_supported(query.shape, key.shape,
-                                       has_mask=mask_val is not None,
-                                       dropout_p=p, causal=is_causal):
-            def flash_fn(q, k, v):
-                return flash_attention(q, k, v, causal=is_causal,
-                                       interpret=interp)
+        else:
+            from ...framework.flags import get_flags
 
-            return apply_op("flash_attn", flash_fn, tensors)
+            bq = int(get_flags("flash_block_q")["flash_block_q"])
+            bk = int(get_flags("flash_block_k")["flash_block_k"])
+            if flash_attention_supported(query.shape, key.shape,
+                                         has_mask=mask_val is not None,
+                                         dropout_p=p, causal=is_causal,
+                                         block_q=bq, block_k=bk):
+                def flash_fn(q, k, v):
+                    return flash_attention(q, k, v, causal=is_causal,
+                                           block_q=bq, block_k=bk,
+                                           interpret=interp)
+
+                return apply_op("flash_attn", flash_fn, tensors)
 
     def fn(q, k, v):
         return sdpa_reference(q, k, v, mask=mask_val, is_causal=is_causal,

@@ -16,6 +16,7 @@ class _MaxPool(Layer):
         super().__init__()
         self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
         self.return_mask, self.ceil_mode = return_mask, ceil_mode
+        self.data_format = kw.get("data_format")
         self.kw = kw
 
     def extra_repr(self):
@@ -28,6 +29,7 @@ class _AvgPool(Layer):
         super().__init__()
         self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
         self.exclusive, self.ceil_mode = exclusive, ceil_mode
+        self.data_format = kw.get("data_format")
         self.kw = kw
 
     def extra_repr(self):
@@ -37,67 +39,82 @@ class _AvgPool(Layer):
 class MaxPool1D(_MaxPool):
     def forward(self, x):
         return F.max_pool1d(x, self.kernel_size, self.stride, self.padding,
-                            return_mask=self.return_mask, ceil_mode=self.ceil_mode)
+                            return_mask=self.return_mask, ceil_mode=self.ceil_mode,
+                            data_format=self.data_format or "NCL")
 
 
 class MaxPool2D(_MaxPool):
     def forward(self, x):
         return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
-                            return_mask=self.return_mask, ceil_mode=self.ceil_mode)
+                            return_mask=self.return_mask, ceil_mode=self.ceil_mode,
+                            data_format=self.data_format or "NCHW")
 
 
 class MaxPool3D(_MaxPool):
     def forward(self, x):
         return F.max_pool3d(x, self.kernel_size, self.stride, self.padding,
-                            return_mask=self.return_mask, ceil_mode=self.ceil_mode)
+                            return_mask=self.return_mask, ceil_mode=self.ceil_mode,
+                            data_format=self.data_format or "NCDHW")
 
 
 class AvgPool1D(_AvgPool):
     def forward(self, x):
         return F.avg_pool1d(x, self.kernel_size, self.stride, self.padding,
-                            exclusive=self.exclusive, ceil_mode=self.ceil_mode)
+                            exclusive=self.exclusive, ceil_mode=self.ceil_mode,
+                            data_format=self.data_format or "NCL")
 
 
 class AvgPool2D(_AvgPool):
     def forward(self, x):
         return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
-                            exclusive=self.exclusive, ceil_mode=self.ceil_mode)
+                            exclusive=self.exclusive, ceil_mode=self.ceil_mode,
+                            data_format=self.data_format or "NCHW")
 
 
 class AvgPool3D(_AvgPool):
     def forward(self, x):
         return F.avg_pool3d(x, self.kernel_size, self.stride, self.padding,
-                            exclusive=self.exclusive, ceil_mode=self.ceil_mode)
+                            exclusive=self.exclusive, ceil_mode=self.ceil_mode,
+                            data_format=self.data_format or "NCDHW")
 
 
 class _AdaptivePool(Layer):
     def __init__(self, output_size, **kw):
         super().__init__()
         self.output_size = output_size
+        self.data_format = kw.get("data_format")
 
 
 class AdaptiveAvgPool1D(_AdaptivePool):
     def forward(self, x):
+        if self.data_format not in (None, "NCL"):
+            raise NotImplementedError("adaptive_avg_pool1d supports NCL only")
         return F.adaptive_avg_pool1d(x, self.output_size)
 
 
 class AdaptiveAvgPool2D(_AdaptivePool):
     def forward(self, x):
-        return F.adaptive_avg_pool2d(x, self.output_size)
+        return F.adaptive_avg_pool2d(x, self.output_size,
+                                     data_format=self.data_format or "NCHW")
 
 
 class AdaptiveAvgPool3D(_AdaptivePool):
     def forward(self, x):
-        return F.adaptive_avg_pool3d(x, self.output_size)
+        return F.adaptive_avg_pool3d(x, self.output_size,
+                                     data_format=self.data_format or "NCDHW")
 
 
 class AdaptiveMaxPool1D(_AdaptivePool):
     def forward(self, x):
+        if self.data_format not in (None, "NCL"):
+            raise NotImplementedError("adaptive_max_pool1d supports NCL only")
         return F.adaptive_max_pool1d(x, self.output_size)
 
 
 class AdaptiveMaxPool2D(_AdaptivePool):
     def forward(self, x):
+        if self.data_format not in (None, "NCHW"):
+            raise NotImplementedError("adaptive_max_pool2d supports NCHW only")
         return F.adaptive_max_pool2d(x, self.output_size)
 
 

@@ -16,6 +16,13 @@ Components:
   ``convert(model)`` freezes scales (reference `ptq.py`).
 - quanters: :class:`FakeQuanterWithAbsMaxObserver` (reference
   `quanters/abs_max.py`); observers: :class:`AbsmaxObserver`.
+
+Execution: ``convert(model)`` freezes scales into simulated quant-dequant
+(fp math with clamps — matches the reference's exported QDQ graphs);
+``convert(model, to_int8=True)`` additionally swaps observed Linear layers
+for :class:`Int8Linear`, whose matmul executes in REAL int8 on the MXU
+(``lax.dot_general`` int8xint8→int32, per-channel weight scales) — the TPU
+analogue of the reference PTQ feeding an int8 inference pipeline.
 """
 
 from .config import QuantConfig

@@ -46,13 +46,41 @@ class PTQ:
     def quantize(self, model: Layer, inplace: bool = False) -> Layer:
         return _wrap_model(model, self._config, inplace)
 
-    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+    def convert(self, model: Layer, inplace: bool = False,
+                to_int8: bool = False) -> Layer:
+        """Freeze observed scales. ``to_int8=True`` additionally swaps each
+        observed Linear for :class:`Int8Linear` (REAL int8 matmul on the
+        MXU) instead of simulated quant-dequant; non-Linear observed layers
+        (convs) keep the simulation path."""
         if not inplace:
             model = copy.deepcopy(model)
 
         def visit(layer: Layer):
             for name, sub in list(layer._sub_layers.items()):
                 if isinstance(sub, QuantedLayer):
+                    if to_int8:
+                        from ..nn.layer.common import Linear
+                        from .int8 import Int8Linear
+
+                        wrapped = sub.wrapped
+                        aq = sub._sub_layers.get("activation_quanter")
+                        if isinstance(wrapped, Linear) and aq is not None \
+                                and hasattr(aq, "scales"):
+                            a_scale = float(jnp.asarray(
+                                aq.scales()._value).reshape(-1)[0])
+                            if a_scale <= 0.0:
+                                raise RuntimeError(
+                                    f"PTQ.convert: '{name}' saw no "
+                                    "calibration data — run forwards on a "
+                                    "calibration set before convert()")
+                            q8 = Int8Linear(
+                                wrapped, a_scale,
+                                getattr(aq, "bit_length", 8))
+                            layer._sub_layers[name] = q8
+                            setattr_name = name
+                            if getattr(layer, setattr_name, None) is sub:
+                                object.__setattr__(layer, setattr_name, q8)
+                            continue
                     for qname in ("activation_quanter", "weight_quanter"):
                         q = sub._sub_layers.get(qname)
                         if q is not None and hasattr(q, "scales"):

@@ -1,0 +1,121 @@
+"""Conv layout work (round-3 verdict #2/#9): NHWC internal ResNet, the
+NCHW:NHWC boundary conv, and the autotune layout config actually being
+consumed (a config change must alter the compiled program)."""
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.incubate import autotune
+from paddle_tpu.vision.models import resnet18
+
+
+@pytest.fixture(autouse=True)
+def _reset_autotune():
+    yield
+    autotune.set_config({"layout": {"enable": True, "data_format": None}})
+
+
+def _models(seed=0):
+    paddle.seed(seed)
+    m_nchw = resnet18(data_format="NCHW")
+    m_nchw.eval()
+    paddle.seed(seed)
+    m_nhwc = resnet18(data_format="NHWC")
+    m_nhwc.eval()
+    return m_nchw, m_nhwc
+
+
+class TestNHWCResNet:
+    def test_outputs_identical_and_api_stays_nchw(self):
+        m_nchw, m_nhwc = _models()
+        x = np.random.default_rng(0).standard_normal((2, 3, 64, 64)).astype("float32")
+        o1 = m_nchw(paddle.to_tensor(x)).numpy()
+        o2 = m_nhwc(paddle.to_tensor(x)).numpy()  # same NCHW input
+        np.testing.assert_allclose(o1, o2, rtol=1e-4, atol=1e-4)
+
+    def test_state_dict_layout_independent(self):
+        m_nchw, m_nhwc = _models(1)
+        sd1 = {k: tuple(v.shape) for k, v in m_nchw.state_dict().items()}
+        sd2 = {k: tuple(v.shape) for k, v in m_nhwc.state_dict().items()}
+        assert sd1 == sd2  # weights stay OIHW either way
+
+    def test_gradients_match(self):
+        m_nchw, m_nhwc = _models(2)
+        x = np.random.default_rng(1).standard_normal((2, 3, 32, 32)).astype("float32")
+        y = np.array([3, 7])
+        for m in (m_nchw, m_nhwc):
+            m.train()
+            loss = F.cross_entropy(m(paddle.to_tensor(x)),
+                                   paddle.to_tensor(y)).mean()
+            loss.backward()
+        g1 = dict(m_nchw.named_parameters())["conv1.weight"].grad.numpy()
+        g2 = dict(m_nhwc.named_parameters())["conv1.weight"].grad.numpy()
+        # grads on an untrained BN net are O(1e3) with ~0.1% cross-layout
+        # numerical drift (different reduce orders): compare scale-relative
+        assert np.abs(g1 - g2).max() <= 5e-3 * np.abs(g1).max()
+
+    def test_bad_data_format_rejected(self):
+        with pytest.raises(ValueError, match="NCHW/NHWC/auto"):
+            resnet18(data_format="NCWH")
+
+
+class TestBoundaryConv:
+    def test_mixed_dimension_numbers_match_transpose_path(self):
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((2, 3, 16, 16)).astype("float32")
+        w = rng.standard_normal((8, 3, 3, 3)).astype("float32")
+        out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), padding=1,
+                       data_format="NCHW:NHWC")
+        ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), padding=1,
+                       data_format="NCHW")
+        np.testing.assert_allclose(out.numpy(),
+                                   np.transpose(ref.numpy(), (0, 2, 3, 1)),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestAutotuneIsConsumed:
+    """Round-3 verdict #9 done-criterion: changing the autotune config
+    changes the COMPILED PROGRAM, not just a stored dict."""
+
+    def test_layout_override_changes_resolution(self):
+        autotune.set_config({"layout": {"data_format": "NHWC"}})
+        assert autotune.resolve_conv_data_format() == "NHWC"
+        assert resnet18().data_format == "NHWC"
+        autotune.set_config({"layout": {"data_format": None, "enable": False}})
+        assert autotune.resolve_conv_data_format() == "NCHW"
+        assert resnet18().data_format == "NCHW"
+
+    def test_config_change_alters_compiled_program(self):
+        autotune.set_config({"layout": {"data_format": "NHWC"}})
+        paddle.seed(0)
+        m_a = resnet18()
+        autotune.set_config({"layout": {"data_format": "NCHW"}})
+        paddle.seed(0)
+        m_b = resnet18()
+        x = np.zeros((1, 3, 32, 32), "float32")
+
+        def jaxpr_of(m):
+            import jax.numpy as jnp
+
+            return str(jax.make_jaxpr(
+                lambda v: m(paddle.Tensor(v)).value)(jnp.asarray(x)))
+
+        ja, jb = jaxpr_of(m_a), jaxpr_of(m_b)
+        assert ja != jb
+        # the NHWC program's convs carry channels-last dimension numbers:
+        # jaxpr spells them ConvDimensionNumbers(lhs_spec=(0, 3, 1, 2) ...)
+        # (feature at index 3); the NCHW program must carry none
+        assert "lhs_spec=(0, 3, 1, 2)" in ja
+        assert "lhs_spec=(0, 3, 1, 2)" not in jb
+
+    def test_invalid_layout_value_rejected(self):
+        autotune.set_config({"layout": {"data_format": "NDHW"}})
+        with pytest.raises(ValueError, match="NCHW/NHWC"):
+            autotune.resolve_conv_data_format()
+
+    def test_unknown_keys_still_rejected(self):
+        with pytest.raises(ValueError, match="unknown key"):
+            autotune.set_config({"layout": {"formats": "x"}})

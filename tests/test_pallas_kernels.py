@@ -173,7 +173,8 @@ class TestFusedAddLayerNorm:
         import paddle_tpu as paddle
         from paddle_tpu.incubate.nn.functional import fused_layer_norm
 
-        paddle.set_flags({"pallas_interpret": True})
+        paddle.set_flags({"pallas_interpret": True,
+                          "use_fused_layernorm": True})
         try:
             x = paddle.to_tensor(np.asarray(_rand(8, (2, 8, 128))))
             r = paddle.to_tensor(np.asarray(_rand(9, (2, 8, 128))))
@@ -186,7 +187,8 @@ class TestFusedAddLayerNorm:
             np.testing.assert_allclose(pre.numpy(), np.asarray(rs),
                                        rtol=1e-6, atol=1e-6)
         finally:
-            paddle.set_flags({"pallas_interpret": False})
+            paddle.set_flags({"pallas_interpret": False,
+                              "use_fused_layernorm": False})
 
 
 class TestFusedSwiglu:
@@ -217,11 +219,12 @@ class TestFusedSwiglu:
         g = np.asarray(_rand(12, (2, 8, 128)))
         u = np.asarray(_rand(13, (2, 8, 128)))
         plain = F.swiglu(paddle.to_tensor(g), paddle.to_tensor(u)).numpy()
-        paddle.set_flags({"pallas_interpret": True})
+        paddle.set_flags({"pallas_interpret": True, "use_fused_swiglu": True})
         try:
             fused = F.swiglu(paddle.to_tensor(g), paddle.to_tensor(u)).numpy()
         finally:
-            paddle.set_flags({"pallas_interpret": False})
+            paddle.set_flags({"pallas_interpret": False,
+                              "use_fused_swiglu": False})
         np.testing.assert_allclose(fused, plain, rtol=1e-5, atol=1e-5)
 
 
