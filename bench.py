@@ -438,14 +438,18 @@ def bench_llama_longctx(on_accel: bool, peak: float):
                           max_position_embeddings=seq)
         sweep = [(256, 256)]
 
-    best = None
+    prior = paddle.get_flags(["flash_block_q", "flash_block_k"])
+    best, failed = None, []
     for bq, bk in sweep:
         paddle.set_flags({"flash_block_q": bq, "flash_block_k": bk})
         try:
             tps, first_loss, final_loss, n_params = _llama_measure(
                 cfg, batch, seq, steps, warmup)
+        except Exception as e:  # one bad config must not kill the point
+            failed.append({"blocks": [bq, bk], "error": repr(e)[:200]})
+            continue
         finally:
-            paddle.set_flags({"flash_block_q": 256, "flash_block_k": 256})
+            paddle.set_flags(prior)
             # each sweep config builds a fresh 670M model + AdamW state
             # (~12GB); Layer graphs hold reference cycles, so without an
             # explicit collect the next config ResourceExhausts on 16GB
@@ -457,6 +461,8 @@ def bench_llama_longctx(on_accel: bool, peak: float):
             _jax.clear_caches()  # drop the previous config's executables
         if best is None or tps > best[0]:
             best = (tps, first_loss, final_loss, n_params, (bq, bk))
+    if best is None:
+        raise RuntimeError(f"every flash-block sweep config failed: {failed}")
     tokens_per_sec, first_loss, final_loss, n_params, blocks = best
 
     attn_per_tok = 6 * cfg.num_hidden_layers * seq * cfg.hidden_size
@@ -471,6 +477,7 @@ def bench_llama_longctx(on_accel: bool, peak: float):
         "vs_baseline": round(mfu / 0.50, 4),
         "detail": {"seq": seq, "batch": batch,
                    "flash_blocks": list(blocks),
+                   **({"failed_configs": failed} if failed else {}),
                    "first_loss": round(first_loss, 4),
                    "final_loss": round(final_loss, 4),
                    "mfu": round(mfu, 4),
@@ -494,7 +501,7 @@ def bench_ernie_ft(on_accel: bool, peak: float):
     from paddle_tpu.models import ErnieForSequenceClassification, ernie3_base, ernie_tiny
 
     if on_accel:
-        cfg, batch, seq, steps, warmup = ernie3_base(), 128, 128, 10, 3
+        cfg, batch, seq, steps, warmup = ernie3_base(), 256, 128, 10, 3
     else:
         cfg, batch, seq, steps, warmup = ernie_tiny(), 4, 32, 2, 1
 

@@ -177,11 +177,14 @@ define_flag("use_fused_rms_norm", True,
 define_flag("use_fused_rope", True,
             "Dispatch rotary embedding to the fused Pallas kernel on TPU "
             "(reference: fused_rotary_position_embedding.py surface).")
-define_flag("flash_block_q", 256,
-            "Pallas flash attention query-block rows (kernel tile knob; "
-            "swept by bench_llama_longctx at 8K sequence).")
-define_flag("flash_block_k", 256,
-            "Pallas flash attention key-block rows.")
+define_flag("flash_block_q", 512,
+            "Pallas flash attention query-block rows; the dispatcher uses "
+            "the largest power-of-two fraction that divides the sequence. "
+            "512 measured +15% over 256 on the llama-670M seq-2048 train "
+            "step on v5e (31958 vs 27717 tok/s); bench_llama_longctx "
+            "sweeps higher values at 8K.")
+define_flag("flash_block_k", 512,
+            "Pallas flash attention key-block rows (see flash_block_q).")
 define_flag("use_fused_layernorm", False,
             "Dispatch residual-add+LayerNorm to the fused Pallas kernel on "
             "TPU (reference: fused_layernorm_kernel.cu surface). Default "

@@ -92,14 +92,19 @@ class GenerationMixin:
                  do_sample: bool = False, top_k: int = 0, top_p: float = 1.0,
                  temperature: float = 1.0,
                  eos_token_id: Optional[int] = None,
-                 pad_token_id: Optional[int] = None, seed: int = 0):
+                 pad_token_id: Optional[int] = None, seed: int = 0,
+                 min_new_tokens: int = 0, repetition_penalty: float = 1.0):
         """Greedy (``do_sample=False``) or sampled decoding with a static
         KV cache, fully jit-compiled (prefill + scan over decode steps).
 
         ``input_ids``: int Tensor/array [batch, prompt_len] (no padding —
         batched ragged prompts need left-padding + attention_mask, which
         this v1 does not implement).  Rows that emit ``eos_token_id`` are
-        latched and emit ``pad_token_id`` (default: eos) afterwards."""
+        latched and emit ``pad_token_id`` (default: eos) afterwards.
+        ``min_new_tokens`` suppresses eos until that many tokens emitted;
+        ``repetition_penalty`` > 1 down-weights tokens already generated
+        or in the prompt (CTRL-style: positive logits divided, negative
+        multiplied — PaddleNLP generation parity)."""
         ids = input_ids._value if isinstance(input_ids, Tensor) \
             else jnp.asarray(input_ids)
         if ids.ndim != 2:
@@ -116,8 +121,13 @@ class GenerationMixin:
                 f"exceeds max_position_embeddings {max_pos}")
         eos = -1 if eos_token_id is None else int(eos_token_id)
         pad = eos if pad_token_id is None else int(pad_token_id)
+        if not 0 <= int(min_new_tokens) <= max_new:
+            raise ValueError("min_new_tokens must be in [0, max_new_tokens]")
+        if repetition_penalty <= 0:
+            raise ValueError("repetition_penalty must be > 0")
         sig = (b, prompt, max_new, bool(do_sample), int(top_k),
-               float(top_p), float(temperature), eos, pad)
+               float(top_p), float(temperature), eos, pad,
+               int(min_new_tokens), float(repetition_penalty))
         cache: Dict = self.__dict__.setdefault("_generate_cache", {})
         if sig not in cache:
             cache[sig] = self._build_generate(*sig)
@@ -130,7 +140,7 @@ class GenerationMixin:
 
     # -- compiled program --------------------------------------------------
     def _build_generate(self, b, prompt, max_new, do_sample, top_k, top_p,
-                        temperature, eos, pad):
+                        temperature, eos, pad, min_new=0, rep_penalty=1.0):
         from ..jit import _StateSwap
 
         params = [p for _, p in self.named_parameters()]
@@ -139,8 +149,19 @@ class GenerationMixin:
         total = prompt + max_new
         model = self
 
-        def sample_tok(logits, key):
+        def sample_tok(logits, key, seen=None, step=0):
             logits = logits.astype(jnp.float32)
+            if rep_penalty != 1.0 and seen is not None:
+                # CTRL repetition penalty over prompt + generated tokens
+                penal = jnp.where(logits > 0, logits / rep_penalty,
+                                  logits * rep_penalty)
+                logits = jnp.where(seen, penal, logits)
+            if eos >= 0 and min_new > 0:
+                # suppress eos until min_new tokens have been emitted
+                suppress = jnp.asarray(step, jnp.int32) < min_new
+                eos_col = jnp.arange(logits.shape[-1]) == eos
+                logits = jnp.where(suppress & eos_col[None, :],
+                                   jnp.finfo(jnp.float32).min, logits)
             logprobs_full = jax.nn.log_softmax(logits, axis=-1)
             if not do_sample:
                 tok = jnp.argmax(logits, axis=-1)
@@ -181,23 +202,35 @@ class GenerationMixin:
                            jnp.zeros((b, total, kv_heads, head_dim), cdt))
                           for _ in range(n_layers)]
                 logits, caches = step_model(ids, caches, 0)  # prefill
+                vocab = logits.shape[-1]
+                rows = jnp.arange(b)
+                if rep_penalty != 1.0:
+                    seen = jnp.zeros((b, vocab), bool)
+                    seen = seen.at[rows[:, None], ids].set(True)
+                else:
+                    seen = None
                 key, sub = jax.random.split(key)
-                tok, logp = sample_tok(logits[:, -1, :], sub)
+                tok, logp = sample_tok(logits[:, -1, :], sub, seen, 0)
                 done = tok == eos
                 tok = jnp.where(done & (eos >= 0), eos, tok)
+                if seen is not None:
+                    seen = seen.at[rows, tok].set(True)
 
                 def body(carry, _):
-                    prev, caches, offset, key, done = carry
+                    prev, caches, offset, key, done, seen, t = carry
                     logits, caches = step_model(prev[:, None], caches, offset)
                     key, sub = jax.random.split(key)
-                    nxt, logp = sample_tok(logits[:, -1, :], sub)
+                    nxt, logp = sample_tok(logits[:, -1, :], sub, seen, t)
                     nxt = jnp.where(done, jnp.asarray(pad, jnp.int32), nxt)
                     logp = jnp.where(done, 0.0, logp)
                     done = done | (nxt == eos)
-                    return (nxt, caches, offset + 1, key, done), (nxt, logp)
+                    if seen is not None:
+                        seen = seen.at[rows, nxt].set(True)
+                    return (nxt, caches, offset + 1, key, done, seen,
+                            t + 1), (nxt, logp)
 
                 carry0 = (tok, caches, jnp.asarray(prompt, jnp.int32), key,
-                          done)
+                          done, seen, jnp.asarray(1, jnp.int32))
                 if max_new > 1:
                     _, (rest, rest_logp) = jax.lax.scan(
                         body, carry0, None, length=max_new - 1)

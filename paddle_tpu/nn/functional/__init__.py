@@ -1044,12 +1044,20 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
         else:
             from ...framework.flags import get_flags
 
-            bq = int(get_flags("flash_block_q")["flash_block_q"])
-            bk = int(get_flags("flash_block_k")["flash_block_k"])
-            if flash_attention_supported(query.shape, key.shape,
-                                         has_mask=mask_val is not None,
-                                         dropout_p=p, causal=is_causal,
-                                         block_q=bq, block_k=bk):
+            from ...ops.sharded import _auto_block
+
+            # largest sublane-aligned block <= the flag that divides the
+            # seq dim, so short sequences stay on the flash path instead
+            # of silently falling back to XLA (None → not tileable)
+            bq = _auto_block(query.shape[1],
+                             int(get_flags("flash_block_q")["flash_block_q"]))
+            bk = _auto_block(key.shape[1],
+                             int(get_flags("flash_block_k")["flash_block_k"]))
+            if bq is not None and bk is not None and \
+                    flash_attention_supported(query.shape, key.shape,
+                                              has_mask=mask_val is not None,
+                                              dropout_p=p, causal=is_causal,
+                                              block_q=bq, block_k=bk):
                 def flash_fn(q, k, v):
                     return flash_attention(q, k, v, causal=is_causal,
                                            block_q=bq, block_k=bk,

@@ -82,6 +82,16 @@ def _auto_block(s: int, cap: int = 256) -> Optional[int]:
     return None
 
 
+def _flag_blocks(sq: int, sk: int):
+    """(block_q, block_k) from the flash_block_q/k flags, fitted to the
+    local seq dims — the tuned tile size reaches the mesh/sharded flash
+    path too, not just the single-chip dispatcher."""
+    from ..framework.flags import get_flags
+
+    return (_auto_block(sq, int(get_flags("flash_block_q")["flash_block_q"])),
+            _auto_block(sk, int(get_flags("flash_block_k")["flash_block_k"])))
+
+
 # ---------------------------------------------------------------------------
 # flash attention
 # ---------------------------------------------------------------------------
@@ -117,7 +127,7 @@ def mesh_flash_supported(mesh: Mesh, q_shape, k_shape, *, has_mask: bool,
     lq, lk, sep = local
     if sep > 1 and lq[1] != lk[1]:
         return False  # ring needs equal chunking of q and kv
-    bq, bk = _auto_block(lq[1]), _auto_block(lk[1])
+    bq, bk = _flag_blocks(lq[1], lk[1])
     if bq is None or bk is None:
         return False
     return flash_attention_supported(lq, lk, has_mask=has_mask,
@@ -135,7 +145,7 @@ def mesh_flash_attention(q, k, v, mesh: Mesh, *, causal: bool = False,
 
     spec = _attn_spec(mesh, sep_axis)
     lq, lk, sep = _attn_local_shapes(mesh, q.shape, k.shape, sep_axis)
-    bq, bk = _auto_block(lq[1]), _auto_block(lk[1])
+    bq, bk = _flag_blocks(lq[1], lk[1])
     varying = _flatten(spec)
 
     if sep > 1:
@@ -187,7 +197,7 @@ def mesh_ulysses_flash_supported(mesh: Mesh, q_shape, k_shape, *,
     if local is None:
         return False
     lq, lk = local
-    bq, bk = _auto_block(lq[1]), _auto_block(lk[1])
+    bq, bk = _flag_blocks(lq[1], lk[1])
     if bq is None or bk is None:
         return False
     return flash_attention_supported(lq, lk, has_mask=has_mask,
@@ -212,7 +222,7 @@ def mesh_ulysses_flash(q, k, v, mesh: Mesh, *, causal: bool = False,
             f"k{tuple(k.shape)} on mesh {dict(mesh.shape)} — check "
             f"mesh_ulysses_flash_supported first")
     lq, lk = local
-    bq, bk = _auto_block(lq[1]), _auto_block(lk[1])
+    bq, bk = _flag_blocks(lq[1], lk[1])
     if bq is None or bk is None:
         raise ValueError(f"sequence lengths {lq[1]}/{lk[1]} are not "
                          f"8-aligned for the flash kernel tiling")

@@ -60,7 +60,9 @@ def test_two_worker_rpc(tmp_path):
         [sys.executable, str(script), f"worker{i}", f"127.0.0.1:{port}",
          str(i)], env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
         for i in range(2)]
-    outs = [p.communicate(timeout=120)[0].decode() for p in procs]
+    # generous: two fresh jax imports on a loaded single-core CI box take
+    # minutes by themselves (observed flaking at 120s under a full-suite run)
+    outs = [p.communicate(timeout=420)[0].decode() for p in procs]
     assert all(p.returncode == 0 for p in procs), outs
     for i, out in enumerate(outs):
         assert f"RPC_OK worker{i}" in out, out
