@@ -38,13 +38,15 @@ from ..tensor.tensor import Tensor
 __all__ = ["GenerationMixin", "cached_attention"]
 
 
-def cached_attention(q, k_new, v_new, cache_k, cache_v, pos):
+def cached_attention(q, k_new, v_new, cache_k, cache_v, pos, pad_lens=None):
     """Write ``k_new``/``v_new`` into the static cache at ``pos`` and attend
     ``q`` over the cache prefix (absolute-position causal mask).
 
     q: [b, s, h, d]; k_new/v_new: [b, s, kv, d]; cache_k/v: [b, C, kv, d];
     ``pos``: traced or static int scalar — absolute position of q's first
-    token.  Returns (out [b, s, h, d], new_cache_k, new_cache_v).
+    token.  ``pad_lens`` [b] (optional): per-row count of LEFT padding —
+    those cache slots are masked out of attention forever.
+    Returns (out [b, s, h, d], new_cache_k, new_cache_v).
 
     Match: masked_multihead_attention_kernel.cu:1 (the decode s=1 case) —
     one fused cache-update + attention, no [C, C] matrix, no dynamic shape.
@@ -65,10 +67,29 @@ def cached_attention(q, k_new, v_new, cache_k, cache_v, pos):
                         k.astype(jnp.float32)) / jnp.sqrt(float(d))
     col = jnp.arange(C)[None, None, None, :]
     row = pos + jnp.arange(s)[None, None, :, None]
-    scores = jnp.where(col <= row, scores, jnp.finfo(jnp.float32).min)
+    allowed = col <= row
+    if pad_lens is not None:
+        allowed = allowed & (col >= pad_lens[:, None, None, None])
+    scores = jnp.where(allowed, scores, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhsc,bchd->bshd", probs, v.astype(jnp.float32))
     return out.astype(q.dtype), cache_k, cache_v
+
+
+def rope_with_row_offsets(q, k, cos, sin, pos, pad_lens):
+    """Rotary embedding with PER-ROW positions for left-padded decode:
+    row i's token at cache slot ``pos + j`` sits at logical position
+    ``pos + j - pad_lens[i]`` (clipped at 0 for the pad slots themselves,
+    whose k is masked out of attention anyway).  q/k: [b, s, h, d]; cos/sin:
+    [max_pos, d] tables."""
+    from ..models.llama import rotate_half_apply
+
+    s = q.shape[1]
+    pos_ids = pos + jnp.arange(s)[None, :] - pad_lens[:, None]  # [b, s]
+    pos_ids = jnp.clip(pos_ids, 0, cos.shape[0] - 1)
+    cos_s = jnp.take(cos, pos_ids, axis=0)[:, :, None, :]
+    sin_s = jnp.take(sin, pos_ids, axis=0)[:, :, None, :]
+    return rotate_half_apply(q, k, cos_s, sin_s)
 
 
 class GenerationMixin:
@@ -93,22 +114,46 @@ class GenerationMixin:
                  temperature: float = 1.0,
                  eos_token_id: Optional[int] = None,
                  pad_token_id: Optional[int] = None, seed: int = 0,
-                 min_new_tokens: int = 0, repetition_penalty: float = 1.0):
+                 min_new_tokens: int = 0, repetition_penalty: float = 1.0,
+                 attention_mask=None):
         """Greedy (``do_sample=False``) or sampled decoding with a static
         KV cache, fully jit-compiled (prefill + scan over decode steps).
 
-        ``input_ids``: int Tensor/array [batch, prompt_len] (no padding —
-        batched ragged prompts need left-padding + attention_mask, which
-        this v1 does not implement).  Rows that emit ``eos_token_id`` are
+        ``input_ids``: int Tensor/array [batch, prompt_len].  Batched
+        ragged prompts use LEFT padding + ``attention_mask`` ([batch,
+        prompt_len], 1 = real token): pad slots are excluded from
+        attention forever and positions are shifted per row, so every
+        row decodes as if unpadded.  Rows that emit ``eos_token_id`` are
         latched and emit ``pad_token_id`` (default: eos) afterwards.
         ``min_new_tokens`` suppresses eos until that many tokens emitted;
         ``repetition_penalty`` > 1 down-weights tokens already generated
         or in the prompt (CTRL-style: positive logits divided, negative
         multiplied — PaddleNLP generation parity)."""
+        import numpy as np
+
         ids = input_ids._value if isinstance(input_ids, Tensor) \
             else jnp.asarray(input_ids)
         if ids.ndim != 2:
             raise ValueError(f"input_ids must be [batch, seq], got {ids.shape}")
+        pad_lens = None
+        if attention_mask is not None:
+            m = np.asarray(attention_mask.numpy()
+                           if isinstance(attention_mask, Tensor)
+                           else attention_mask).astype(np.int32)
+            if m.shape != tuple(ids.shape):
+                raise ValueError(
+                    f"attention_mask shape {m.shape} != input_ids "
+                    f"{tuple(ids.shape)}")
+            if not np.isin(m, (0, 1)).all():
+                raise ValueError(
+                    "attention_mask must be binary 0/1 keep-mask (additive "
+                    "float masks are not accepted here)")
+            if not (np.diff(m, axis=1) >= 0).all():
+                raise ValueError(
+                    "attention_mask must be LEFT-padded (0s then 1s per row)")
+            if (m.sum(axis=1) == 0).any():
+                raise ValueError("attention_mask has an all-pad row")
+            pad_lens = jnp.asarray(m.shape[1] - m.sum(axis=1), jnp.int32)
         b, prompt = int(ids.shape[0]), int(ids.shape[1])
         max_new = int(max_new_tokens)
         if max_new < 1:
@@ -127,20 +172,24 @@ class GenerationMixin:
             raise ValueError("repetition_penalty must be > 0")
         sig = (b, prompt, max_new, bool(do_sample), int(top_k),
                float(top_p), float(temperature), eos, pad,
-               int(min_new_tokens), float(repetition_penalty))
+               int(min_new_tokens), float(repetition_penalty),
+               pad_lens is not None)
         cache: Dict = self.__dict__.setdefault("_generate_cache", {})
         if sig not in cache:
             cache[sig] = self._build_generate(*sig)
         params = [p for _, p in self.named_parameters()]
         buffers = [bf for _, bf in self.named_buffers()]
+        if pad_lens is None:
+            pad_lens = jnp.zeros((b,), jnp.int32)  # shape-stable jit arg
         out_ids, scores = cache[sig](
             [p._value for p in params], [bf._value for bf in buffers],
-            ids.astype(jnp.int32), jax.random.PRNGKey(seed))
+            ids.astype(jnp.int32), pad_lens, jax.random.PRNGKey(seed))
         return Tensor(out_ids), Tensor(scores)
 
     # -- compiled program --------------------------------------------------
     def _build_generate(self, b, prompt, max_new, do_sample, top_k, top_p,
-                        temperature, eos, pad, min_new=0, rep_penalty=1.0):
+                        temperature, eos, pad, min_new=0, rep_penalty=1.0,
+                        padded=False):
         from ..jit import _StateSwap
 
         params = [p for _, p in self.named_parameters()]
@@ -187,12 +236,13 @@ class GenerationMixin:
                                        axis=-1)[:, 0]
             return tok.astype(jnp.int32), logp
 
-        def step_model(ids_slice, caches, offset):
+        def step_model(ids_slice, caches, offset, pad_lens):
             logits, caches = model(Tensor(ids_slice), kv_cache=caches,
-                                   position_offset=offset)
+                                   position_offset=offset,
+                                   pad_lens=pad_lens if padded else None)
             return logits._value, caches
 
-        def fn(param_arrays, buffer_arrays, ids, key):
+        def fn(param_arrays, buffer_arrays, ids, pad_lens, key):
             with _StateSwap(params, param_arrays), \
                     _StateSwap(buffers, buffer_arrays), no_grad():
                 cdt = next((a.dtype for a in param_arrays
@@ -201,12 +251,16 @@ class GenerationMixin:
                 caches = [(jnp.zeros((b, total, kv_heads, head_dim), cdt),
                            jnp.zeros((b, total, kv_heads, head_dim), cdt))
                           for _ in range(n_layers)]
-                logits, caches = step_model(ids, caches, 0)  # prefill
+                logits, caches = step_model(ids, caches, 0, pad_lens)  # prefill
                 vocab = logits.shape[-1]
                 rows = jnp.arange(b)
                 if rep_penalty != 1.0:
                     seen = jnp.zeros((b, vocab), bool)
-                    seen = seen.at[rows[:, None], ids].set(True)
+                    # pad filler ids must NOT count as seen, or a padded
+                    # row penalizes the filler token and diverges from its
+                    # unpadded decode
+                    real = jnp.arange(prompt)[None, :] >= pad_lens[:, None]
+                    seen = seen.at[rows[:, None], ids].max(real)
                 else:
                     seen = None
                 key, sub = jax.random.split(key)
@@ -218,7 +272,8 @@ class GenerationMixin:
 
                 def body(carry, _):
                     prev, caches, offset, key, done, seen, t = carry
-                    logits, caches = step_model(prev[:, None], caches, offset)
+                    logits, caches = step_model(prev[:, None], caches, offset,
+                                                pad_lens)
                     key, sub = jax.random.split(key)
                     nxt, logp = sample_tok(logits[:, -1, :], sub, seen, t)
                     nxt = jnp.where(done, jnp.asarray(pad, jnp.int32), nxt)

@@ -78,7 +78,8 @@ class GPTBlock(nn.Layer):
         self.dropout = nn.Dropout(config.dropout)
         self.config = config
 
-    def forward(self, x, position_offset: int = 0, kv_cache=None):
+    def forward(self, x, position_offset: int = 0, kv_cache=None,
+                pad_lens=None):
         cfg = self.config
         b, s = x.shape[0], x.shape[1]
         qkv = self.qkv_proj(self.ln_1(x))
@@ -89,7 +90,7 @@ class GPTBlock(nn.Layer):
 
             out_v, ck, cv = cached_attention(
                 q._value, k._value, v._value, kv_cache[0], kv_cache[1],
-                position_offset)
+                position_offset, pad_lens)
             x = x + self.dropout(self.out_proj(Tensor(out_v.reshape(
                 b, s, cfg.num_attention_heads * cfg.head_dim))))
             x = x + self.dropout(self.fc_out(F.gelu(self.fc_in(self.ln_2(x)))))
@@ -122,7 +123,8 @@ class GPTModel(nn.Layer):
         self.h = nn.LayerList([GPTBlock(config) for _ in range(config.num_hidden_layers)])
         self.ln_f = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
 
-    def forward(self, input_ids, position_offset: int = 0, kv_cache=None):
+    def forward(self, input_ids, position_offset: int = 0, kv_cache=None,
+                pad_lens=None):
         import jax.numpy as jnp
 
         s = input_ids.shape[1]
@@ -132,12 +134,20 @@ class GPTModel(nn.Layer):
                 f"sequence length {s} (+offset {position_offset}) exceeds "
                 f"max_position_embeddings "
                 f"{self.config.max_position_embeddings}")
-        pos = Tensor(jnp.arange(s) + position_offset)
+        if pad_lens is not None:
+            # left-padded rows: logical positions shift back by the pad
+            # count (the pad slots' clipped position 0 never attends)
+            pos = Tensor(jnp.clip(
+                jnp.arange(s)[None, :] + position_offset - pad_lens[:, None],
+                0, self.config.max_position_embeddings - 1))
+        else:
+            pos = Tensor(jnp.arange(s) + position_offset)
         x = self.drop(self.wte(input_ids) + self.wpe(pos))
         if kv_cache is not None:
             new_caches = []
             for block, lc in zip(self.h, kv_cache):
-                x, nc = block(x, position_offset, kv_cache=lc)
+                x, nc = block(x, position_offset, kv_cache=lc,
+                              pad_lens=pad_lens)
                 new_caches.append(nc)
             return self.ln_f(x), new_caches
         if self.config.recompute:
@@ -160,10 +170,10 @@ class GPTForCausalLM(nn.Layer, GenerationMixin):
         self.gpt = GPTModel(config)
 
     def forward(self, input_ids, labels=None, kv_cache=None,
-                position_offset: int = 0):
+                position_offset: int = 0, pad_lens=None):
         if kv_cache is not None:  # decode path: (logits, new_cache)
             hidden, new_cache = self.gpt(input_ids, position_offset,
-                                         kv_cache=kv_cache)
+                                         kv_cache=kv_cache, pad_lens=pad_lens)
             return F.linear(hidden, self.gpt.wte.weight.T), new_cache
         hidden = self.gpt(input_ids)
         logits = F.linear(hidden, self.gpt.wte.weight.T)

@@ -167,18 +167,26 @@ def apply_rotary_pos_emb(q: Tensor, k: Tensor, cos, sin, position_offset: int = 
     cos_s = jax.lax.dynamic_slice_in_dim(cos, position_offset, s, 0)[None, :, None, :]
     sin_s = jax.lax.dynamic_slice_in_dim(sin, position_offset, s, 0)[None, :, None, :]
 
+    def fn(qv, kv):
+        return rotate_half_apply(qv, kv, cos_s, sin_s)
+
+    return apply_op("rope", fn, (q, k), multi_out=True)
+
+
+def rotate_half_apply(qv, kv, cos_s, sin_s):
+    """The rotate-half rope application in fp32 (shared by the training
+    path above and the per-row decode path in generation/): q/k [b,s,h,d],
+    cos_s/sin_s broadcastable to them."""
+
     def rot(v):
         half = v.shape[-1] // 2
         return jnp.concatenate([-v[..., half:], v[..., :half]], axis=-1)
 
-    def fn(qv, kv):
-        c = cos_s.astype(jnp.float32)
-        si = sin_s.astype(jnp.float32)
-        qf, kf = qv.astype(jnp.float32), kv.astype(jnp.float32)
-        return ((qf * c + rot(qf) * si).astype(qv.dtype),
-                (kf * c + rot(kf) * si).astype(kv.dtype))
-
-    return apply_op("rope", fn, (q, k), multi_out=True)
+    c = cos_s.astype(jnp.float32)
+    si = sin_s.astype(jnp.float32)
+    qf, kf = qv.astype(jnp.float32), kv.astype(jnp.float32)
+    return ((qf * c + rot(qf) * si).astype(qv.dtype),
+            (kf * c + rot(kf) * si).astype(kv.dtype))
 
 
 class LlamaAttention(nn.Layer):
@@ -193,29 +201,37 @@ class LlamaAttention(nn.Layer):
         self.o_proj = nn.Linear(h * d, config.hidden_size, weight_attr=init, bias_attr=False)
 
     def forward(self, x, cos, sin, attn_mask=None, position_offset: int = 0,
-                kv_cache=None):
+                kv_cache=None, pad_lens=None):
         b, s = x.shape[0], x.shape[1]
         cfg = self.config
         q = reshape(self.q_proj(x), [b, s, cfg.num_attention_heads, cfg.head_dim])
         k = reshape(self.k_proj(x), [b, s, cfg.num_key_value_heads, cfg.head_dim])
         v = reshape(self.v_proj(x), [b, s, cfg.num_key_value_heads, cfg.head_dim])
-        q, k = apply_rotary_pos_emb(q, k, cos, sin, position_offset)
         if kv_cache is not None:
             # decode path (generation/__init__.py): write k/v into the
             # static cache at position_offset, attend over the prefix; no
-            # grads flow here, so raw-value math is fine
+            # grads flow here, so raw-value math is fine. pad_lens carries
+            # per-row LEFT padding (rope positions shift, pad slots masked)
             if attn_mask is not None:
                 raise NotImplementedError(
-                    "attn_mask with kv_cache (left-padded batched prompts) "
-                    "is not implemented — pad-free prompts only")
-            from ..generation import cached_attention
+                    "attn_mask with kv_cache is not supported — ragged "
+                    "batched prompts go through generate(attention_mask=...) "
+                    "/ the pad_lens argument")
+            from ..generation import cached_attention, rope_with_row_offsets
 
+            if pad_lens is not None:
+                qv, kv_ = rope_with_row_offsets(q._value, k._value, cos, sin,
+                                                position_offset, pad_lens)
+            else:
+                q, k = apply_rotary_pos_emb(q, k, cos, sin, position_offset)
+                qv, kv_ = q._value, k._value
             out_v, ck, cv = cached_attention(
-                q._value, k._value, v._value, kv_cache[0], kv_cache[1],
-                position_offset)
+                qv, kv_, v._value, kv_cache[0], kv_cache[1],
+                position_offset, pad_lens)
             out = self.o_proj(Tensor(out_v.reshape(
                 b, s, cfg.num_attention_heads * cfg.head_dim)))
             return out, (ck, cv)
+        q, k = apply_rotary_pos_emb(q, k, cos, sin, position_offset)
         out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask, is_causal=True)
         return self.o_proj(reshape(out, [b, s, cfg.num_attention_heads * cfg.head_dim]))
 
@@ -253,11 +269,11 @@ class LlamaDecoderLayer(nn.Layer):
         self.post_attention_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
 
     def forward(self, x, cos, sin, attn_mask=None, position_offset: int = 0,
-                kv_cache=None):
+                kv_cache=None, pad_lens=None):
         if kv_cache is not None:
             attn, new_cache = self.self_attn(self.input_layernorm(x), cos, sin,
                                              attn_mask, position_offset,
-                                             kv_cache)
+                                             kv_cache, pad_lens)
             x = x + attn
             x = x + self.mlp(self.post_attention_layernorm(x))
             return x, new_cache
@@ -285,12 +301,14 @@ class LlamaModel(nn.Layer):
         self.register_buffer("rope_sin", Tensor(sin), persistable=False)
 
     def forward(self, input_ids, attn_mask=None, position_offset: int = 0,
-                kv_cache=None):
+                kv_cache=None, pad_lens=None):
         """``attn_mask``: either an additive float mask (0 to keep, large
         negative to drop) or a bool/int keep-mask (True/1 = attend), which is
         converted to additive form; causal masking is always applied.
         ``kv_cache``: list of per-layer (k, v) static-shape cache arrays —
-        the decode path; returns (hidden, new_cache)."""
+        the decode path; returns (hidden, new_cache).  ``pad_lens`` [b]:
+        per-row LEFT-padding count for batched ragged prompts (decode
+        path only)."""
         if isinstance(position_offset, int) and \
                 input_ids.shape[1] + position_offset > self.config.max_position_embeddings:
             raise ValueError(
@@ -303,7 +321,7 @@ class LlamaModel(nn.Layer):
             new_caches = []
             for layer, lc in zip(self.layers, kv_cache):
                 x, nc = layer(x, cos, sin, attn_mask, position_offset,
-                              kv_cache=lc)
+                              kv_cache=lc, pad_lens=pad_lens)
                 new_caches.append(nc)
             return self.norm(x), new_caches
         if self.config.recompute:
@@ -338,10 +356,11 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
                                      bias_attr=False)
 
     def forward(self, input_ids, labels=None, attn_mask=None, kv_cache=None,
-                position_offset: int = 0):
+                position_offset: int = 0, pad_lens=None):
         if kv_cache is not None:  # decode path: (logits, new_cache)
             hidden, new_cache = self.llama(input_ids, attn_mask,
-                                           position_offset, kv_cache=kv_cache)
+                                           position_offset, kv_cache=kv_cache,
+                                           pad_lens=pad_lens)
             if self.lm_head is not None:
                 logits = self.lm_head(hidden)
             else:

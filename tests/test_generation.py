@@ -151,6 +151,63 @@ class TestGenerationKnobs:
             llama.generate(ids, max_new_tokens=2, repetition_penalty=0.0)
 
 
+class TestLeftPaddedBatch:
+    """Batched ragged prompts: a left-padded row must decode EXACTLY like
+    the same prompt unpadded (pad slots masked out of attention, positions
+    shifted per row)."""
+
+    def _check(self, model, vocab=256):
+        rng = np.random.default_rng(11)
+        p_full = rng.integers(1, vocab, (1, 8)).astype("int32")
+        p_short = rng.integers(1, vocab, (1, 5)).astype("int32")
+        r_full, _ = model.generate(paddle.to_tensor(p_full), max_new_tokens=5)
+        r_short, _ = model.generate(paddle.to_tensor(p_short), max_new_tokens=5)
+
+        padded = np.zeros((2, 8), "int32")
+        padded[0] = p_full[0]
+        padded[1, 3:] = p_short[0]
+        mask = np.ones((2, 8), "int32")
+        mask[1, :3] = 0
+        out, scores = model.generate(paddle.to_tensor(padded),
+                                     max_new_tokens=5, attention_mask=mask)
+        np.testing.assert_array_equal(out.numpy()[0], r_full.numpy()[0])
+        np.testing.assert_array_equal(out.numpy()[1], r_short.numpy()[0])
+        assert scores.numpy().shape == (2, 5)
+
+    def test_llama_padded_rows_match_unpadded(self, llama):
+        self._check(llama)
+
+    def test_gpt_padded_rows_match_unpadded(self, gpt):
+        self._check(gpt)
+
+    def test_padded_parity_holds_under_repetition_penalty(self, llama):
+        """Pad filler ids must not count as 'seen' — a padded row with
+        repetition_penalty active still matches its unpadded decode."""
+        rng = np.random.default_rng(12)
+        p_short = rng.integers(1, 256, (1, 5)).astype("int32")
+        ref, _ = llama.generate(paddle.to_tensor(p_short), max_new_tokens=5,
+                                repetition_penalty=1.5)
+        padded = np.zeros((1, 8), "int32")  # filler id 0 is a REAL token id
+        padded[0, 3:] = p_short[0]
+        mask = np.ones((1, 8), "int32")
+        mask[0, :3] = 0
+        out, _ = llama.generate(paddle.to_tensor(padded), max_new_tokens=5,
+                                attention_mask=mask, repetition_penalty=1.5)
+        np.testing.assert_array_equal(out.numpy()[0], ref.numpy()[0])
+
+    def test_mask_validation(self, llama):
+        ids = paddle.to_tensor(np.ones((2, 4), "int32"))
+        with pytest.raises(ValueError, match="LEFT-padded"):
+            llama.generate(ids, max_new_tokens=2,
+                           attention_mask=np.array([[1, 1, 0, 0], [1, 1, 1, 1]]))
+        with pytest.raises(ValueError, match="shape"):
+            llama.generate(ids, max_new_tokens=2,
+                           attention_mask=np.ones((2, 3), "int32"))
+        with pytest.raises(ValueError, match="all-pad"):
+            llama.generate(ids, max_new_tokens=2,
+                           attention_mask=np.array([[0, 0, 0, 0], [1, 1, 1, 1]]))
+
+
 class TestErrorsAndPredictor:
     def test_length_overflow_raises(self, llama):
         ids = np.zeros((1, 120), "int32")  # max_position_embeddings=128
