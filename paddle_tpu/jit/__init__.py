@@ -76,8 +76,11 @@ class StaticFunction:
 
     def _discover_layers(self):
         """Layers owning the state this function touches: the bound layer,
-        plus any Layer in the function's closure/defaults (covers the common
-        ``to_static(lambda x: model(x))`` pattern)."""
+        any Layer in the function's closure/defaults, and any Layer the
+        function references as a GLOBAL (``to_static(lambda x: model(x))``
+        at module level / in a REPL has ``model`` in __globals__, not the
+        closure — missing it left mutated buffers un-swapped and leaked
+        tracers out of the trace)."""
         layers = []
         if self._layer is not None:
             layers.append(self._layer)
@@ -92,6 +95,20 @@ class StaticFunction:
         for v in (getattr(self._fn, "__defaults__", None) or ()):
             if isinstance(v, Layer):
                 layers.append(v)
+        code = getattr(self._fn, "__code__", None)
+        fglobals = getattr(self._fn, "__globals__", None)
+        if code is not None and fglobals is not None:
+            import dis
+
+            # walk LOAD_GLOBAL instructions specifically: co_names also
+            # lists ATTRIBUTE names, which would falsely capture an
+            # unrelated global Layer that happens to share a name with
+            # e.g. an `obj.model` access
+            for ins in dis.get_instructions(code):
+                if ins.opname == "LOAD_GLOBAL":
+                    v = fglobals.get(ins.argval)
+                    if isinstance(v, Layer):
+                        layers.append(v)
         return layers
 
     def _state(self):

@@ -1,0 +1,58 @@
+"""AlexNet (reference `python/paddle/vision/models/alexnet.py:44` — same
+stage layout/classifier; implementation over paddle_tpu.nn with the
+channels-last internals the TPU conv path wants, resolved like ResNet)."""
+
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["AlexNet", "alexnet"]
+
+
+class AlexNet(nn.Layer):
+    def __init__(self, num_classes: int = 1000, data_format: str = "auto"):
+        super().__init__()
+        from ...incubate.autotune import resolve_conv_data_format
+
+        if data_format == "auto":
+            data_format = resolve_conv_data_format()
+        self.data_format = df = data_format
+        stem_df = "NCHW:NHWC" if df == "NHWC" else df
+        self.num_classes = num_classes
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 64, 11, stride=4, padding=2, data_format=stem_df),
+            nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, data_format=df),
+            nn.Conv2D(64, 192, 5, padding=2, data_format=df),
+            nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, data_format=df),
+            nn.Conv2D(192, 384, 3, padding=1, data_format=df),
+            nn.ReLU(),
+            nn.Conv2D(384, 256, 3, padding=1, data_format=df),
+            nn.ReLU(),
+            nn.Conv2D(256, 256, 3, padding=1, data_format=df),
+            nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, data_format=df))
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(0.5), nn.Linear(256 * 6 * 6, 4096), nn.ReLU(),
+                nn.Dropout(0.5), nn.Linear(4096, 4096), nn.ReLU(),
+                nn.Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.data_format == "NHWC":
+            from ...tensor.manipulation import transpose
+
+            x = transpose(x, [0, 3, 1, 2])  # public NCHW contract
+        if self.num_classes > 0:
+            from ...tensor.manipulation import flatten
+
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+def alexnet(pretrained: bool = False, **kwargs) -> AlexNet:
+    if pretrained:
+        raise NotImplementedError("no pretrained weight hub (zero egress)")
+    return AlexNet(**kwargs)

@@ -1,0 +1,166 @@
+"""MobileNet V1/V2 (reference `python/paddle/vision/models/mobilenetv1.py:53`
+and `mobilenetv2.py:63` — same depthwise-separable / inverted-residual
+topology, width ``scale``; channels-last internals resolved like ResNet —
+depthwise convs especially want the feature-minor layout on TPU)."""
+
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["MobileNetV1", "MobileNetV2", "mobilenet_v1", "mobilenet_v2"]
+
+
+def _mk(v: float) -> int:
+    """Round channels the mobilenet way (to multiples of 8, never down by
+    more than 10%)."""
+    new = max(8, int(v + 4) // 8 * 8)
+    if new < 0.9 * v:
+        new += 8
+    return new
+
+
+class _ConvBNRelu(nn.Layer):
+    def __init__(self, in_c, out_c, k, stride=1, groups=1, df="NCHW",
+                 stem=False, relu6=True):
+        super().__init__()
+        conv_df = ("NCHW:NHWC" if df == "NHWC" else df) if stem else df
+        self.conv = nn.Conv2D(in_c, out_c, k, stride=stride, padding=k // 2,
+                              groups=groups, bias_attr=False,
+                              data_format=conv_df)
+        self.bn = nn.BatchNorm2D(out_c, data_format=df)
+        self.act = nn.ReLU6() if relu6 else nn.ReLU()
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000,
+                 with_pool: bool = True, data_format: str = "auto"):
+        super().__init__()
+        from ...incubate.autotune import resolve_conv_data_format
+
+        if data_format == "auto":
+            data_format = resolve_conv_data_format()
+        self.data_format = df = data_format
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(v):
+            # V1 rounds with plain int() (reference mobilenetv1.py), unlike
+            # V2's make-divisible-by-8 rule
+            return max(1, int(v * scale))
+
+        def dw_sep(in_c, out_c, stride):
+            return nn.Sequential(
+                _ConvBNRelu(in_c, in_c, 3, stride, groups=in_c, df=df,
+                            relu6=False),
+                _ConvBNRelu(in_c, out_c, 1, 1, df=df, relu6=False))
+
+        plan = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2)] \
+            + [(512, 1)] * 5 + [(1024, 2), (1024, 1)]
+        blocks = [_ConvBNRelu(3, c(32), 3, 2, df=df, stem=True, relu6=False)]
+        in_c = c(32)
+        for out, s in plan:
+            blocks.append(dw_sep(in_c, c(out), s))
+            in_c = c(out)
+        self.features = nn.Sequential(*blocks)
+        self._out_c = in_c
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(in_c, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.data_format == "NHWC":
+            from ...tensor.manipulation import transpose
+
+            x = transpose(x, [0, 3, 1, 2])
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ...tensor.manipulation import flatten
+
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, in_c, out_c, stride, expand, df):
+        super().__init__()
+        hidden = int(round(in_c * expand))
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if expand != 1:
+            layers.append(_ConvBNRelu(in_c, hidden, 1, df=df))
+        layers.append(_ConvBNRelu(hidden, hidden, 3, stride, groups=hidden,
+                                  df=df))
+        layers.append(nn.Conv2D(hidden, out_c, 1, bias_attr=False,
+                                data_format=df))
+        layers.append(nn.BatchNorm2D(out_c, data_format=df))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Layer):
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000,
+                 with_pool: bool = True, data_format: str = "auto"):
+        super().__init__()
+        from ...incubate.autotune import resolve_conv_data_format
+
+        if data_format == "auto":
+            data_format = resolve_conv_data_format()
+        self.data_format = df = data_format
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        plan = [  # t (expand), c, n (repeats), s (first stride)
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        in_c = _mk(32 * scale)
+        blocks = [_ConvBNRelu(3, in_c, 3, 2, df=df, stem=True)]
+        for t, c_, n, s in plan:
+            out_c = _mk(c_ * scale)
+            for i in range(n):
+                blocks.append(_InvertedResidual(in_c, out_c,
+                                                s if i == 0 else 1, t, df))
+                in_c = out_c
+        self._out_c = _mk(1280 * max(1.0, scale))
+        blocks.append(_ConvBNRelu(in_c, self._out_c, 1, df=df))
+        self.features = nn.Sequential(*blocks)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = nn.Sequential(nn.Dropout(0.2),
+                                            nn.Linear(self._out_c, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.data_format == "NHWC":
+            from ...tensor.manipulation import transpose
+
+            x = transpose(x, [0, 3, 1, 2])
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ...tensor.manipulation import flatten
+
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs) -> MobileNetV1:
+    if pretrained:
+        raise NotImplementedError("no pretrained weight hub (zero egress)")
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs) -> MobileNetV2:
+    if pretrained:
+        raise NotImplementedError("no pretrained weight hub (zero egress)")
+    return MobileNetV2(scale=scale, **kwargs)

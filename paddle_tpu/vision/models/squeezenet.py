@@ -1,0 +1,100 @@
+"""SqueezeNet (reference `python/paddle/vision/models/squeezenet.py:30` —
+fire modules, versions 1.0/1.1, conv classifier head; channels-last
+internals resolved like ResNet)."""
+
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
+
+
+class _Fire(nn.Layer):
+    def __init__(self, in_c, squeeze, e1, e3, df):
+        super().__init__()
+        self.squeeze = nn.Conv2D(in_c, squeeze, 1, data_format=df)
+        self.e1 = nn.Conv2D(squeeze, e1, 1, data_format=df)
+        self.e3 = nn.Conv2D(squeeze, e3, 3, padding=1, data_format=df)
+        self.relu = nn.ReLU()
+        self._axis = 3 if df == "NHWC" else 1
+
+    def forward(self, x):
+        from ...tensor.manipulation import concat
+
+        s = self.relu(self.squeeze(x))
+        return concat([self.relu(self.e1(s)), self.relu(self.e3(s))],
+                      axis=self._axis)
+
+
+class SqueezeNet(nn.Layer):
+    def __init__(self, version: str = "1.0", num_classes: int = 1000,
+                 with_pool: bool = True, data_format: str = "auto"):
+        super().__init__()
+        from ...incubate.autotune import resolve_conv_data_format
+
+        if version not in ("1.0", "1.1"):
+            raise ValueError(f"version must be '1.0' or '1.1', got {version!r}")
+        if data_format == "auto":
+            data_format = resolve_conv_data_format()
+        self.data_format = df = data_format
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        stem_df = "NCHW:NHWC" if df == "NHWC" else df
+        relu, pool = nn.ReLU, lambda: nn.MaxPool2D(3, stride=2, data_format=df)
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2, data_format=stem_df), relu(),
+                pool(),
+                _Fire(96, 16, 64, 64, df), _Fire(128, 16, 64, 64, df),
+                _Fire(128, 32, 128, 128, df), pool(),
+                _Fire(256, 32, 128, 128, df), _Fire(256, 48, 192, 192, df),
+                _Fire(384, 48, 192, 192, df), _Fire(384, 64, 256, 256, df),
+                pool(),
+                _Fire(512, 64, 256, 256, df))
+        else:
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2, data_format=stem_df), relu(),
+                pool(),
+                _Fire(64, 16, 64, 64, df), _Fire(128, 16, 64, 64, df),
+                pool(),
+                _Fire(128, 32, 128, 128, df), _Fire(256, 32, 128, 128, df),
+                pool(),
+                _Fire(256, 48, 192, 192, df), _Fire(384, 48, 192, 192, df),
+                _Fire(384, 64, 256, 256, df), _Fire(512, 64, 256, 256, df))
+        if num_classes > 0:
+            self.classifier_conv = nn.Conv2D(512, num_classes, 1,
+                                             data_format=df)
+            self.dropout = nn.Dropout(0.5)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(
+                (1, 1), data_format=df if num_classes > 0 else "NCHW")
+
+    def forward(self, x):
+        from ...tensor.manipulation import flatten, transpose
+
+        x = self.features(x)
+        if self.num_classes > 0:
+            # conv classifier runs in the internal layout, then pool+flatten
+            x = self.classifier_conv(self.dropout(x))
+            if self.with_pool:
+                x = self.pool(x)
+            if self.data_format == "NHWC":
+                x = transpose(x, [0, 3, 1, 2])
+            return flatten(x, 1)
+        if self.data_format == "NHWC":
+            x = transpose(x, [0, 3, 1, 2])  # public NCHW features
+        if self.with_pool:
+            x = self.pool(x)
+        return x
+
+
+def squeezenet1_0(pretrained=False, **kwargs) -> SqueezeNet:
+    if pretrained:
+        raise NotImplementedError("no pretrained weight hub (zero egress)")
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs) -> SqueezeNet:
+    if pretrained:
+        raise NotImplementedError("no pretrained weight hub (zero egress)")
+    return SqueezeNet("1.1", **kwargs)

@@ -1,0 +1,95 @@
+"""VGG family (reference `python/paddle/vision/models/vgg.py:30` — same
+cfgs A/B/D/E, optional batch_norm, 4096-4096 classifier; channels-last
+internals resolved like ResNet)."""
+
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19"]
+
+_CFGS = {
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+          512, 512, 512, "M"],
+    "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512, 512,
+          512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+def _make_layers(cfg, batch_norm: bool, df: str):
+    layers = []
+    in_c = 3
+    first = True
+    for v in cfg:
+        if v == "M":
+            layers.append(nn.MaxPool2D(2, stride=2, data_format=df))
+            continue
+        conv_df = ("NCHW:NHWC" if df == "NHWC" else df) if first else df
+        layers.append(nn.Conv2D(in_c, v, 3, padding=1, data_format=conv_df))
+        if batch_norm:
+            layers.append(nn.BatchNorm2D(v, data_format=df))
+        layers.append(nn.ReLU())
+        in_c = v
+        first = False
+    return nn.Sequential(*layers)
+
+
+class VGG(nn.Layer):
+    def __init__(self, features: nn.Layer, num_classes: int = 1000,
+                 with_pool: bool = True, data_format: str = "NCHW"):
+        super().__init__()
+        self.features = features
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.data_format = data_format
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D((7, 7))
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(512 * 7 * 7, 4096), nn.ReLU(), nn.Dropout(),
+                nn.Linear(4096, 4096), nn.ReLU(), nn.Dropout(),
+                nn.Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.data_format == "NHWC":
+            from ...tensor.manipulation import transpose
+
+            x = transpose(x, [0, 3, 1, 2])  # public NCHW contract
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            from ...tensor.manipulation import flatten
+
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+def _vgg(cfg: str, batch_norm: bool, pretrained: bool, **kwargs) -> VGG:
+    if pretrained:
+        raise NotImplementedError("no pretrained weight hub (zero egress)")
+    from ...incubate.autotune import resolve_conv_data_format
+
+    df = kwargs.pop("data_format", "auto")
+    if df == "auto":
+        df = resolve_conv_data_format()
+    return VGG(_make_layers(_CFGS[cfg], batch_norm, df), data_format=df,
+               **kwargs)
+
+
+def vgg11(pretrained=False, batch_norm=False, **kwargs) -> VGG:
+    return _vgg("A", batch_norm, pretrained, **kwargs)
+
+
+def vgg13(pretrained=False, batch_norm=False, **kwargs) -> VGG:
+    return _vgg("B", batch_norm, pretrained, **kwargs)
+
+
+def vgg16(pretrained=False, batch_norm=False, **kwargs) -> VGG:
+    return _vgg("D", batch_norm, pretrained, **kwargs)
+
+
+def vgg19(pretrained=False, batch_norm=False, **kwargs) -> VGG:
+    return _vgg("E", batch_norm, pretrained, **kwargs)

@@ -131,7 +131,29 @@ class TestDataLoader:
         assert len(batches) == 3 and batches[-1].shape == [1]
 
 
+_GLOBAL_BN = None
+
+
 class TestJit:
+    def test_to_static_discovers_global_layer(self):
+        """to_static(lambda x: model(x)) where model is a module GLOBAL (not
+        a closure cell): buffer-mutating layers (train-mode BN) previously
+        leaked tracers because the model's state was never swapped."""
+        global _GLOBAL_BN
+        paddle.seed(0)
+        _GLOBAL_BN = nn.BatchNorm2D(4)
+        x = t(np.random.default_rng(5).standard_normal((2, 4, 8, 8)))
+        st = paddle.jit.to_static(lambda v: _GLOBAL_BN(v))
+        out1 = st(x)
+        out2 = st(x)  # second call reuses the compiled entry
+        assert np.isfinite(out2.numpy()).all()
+        # running stats updated AND stayed concrete (no leaked tracer)
+        import jax
+
+        assert isinstance(_GLOBAL_BN._mean._value, jax.Array)
+        assert not np.allclose(_GLOBAL_BN._mean.numpy(), 0.0)
+        _GLOBAL_BN = None
+
     def test_to_static_matches_eager(self):
         m = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
         x = t(np.random.default_rng(0).standard_normal((3, 4)))

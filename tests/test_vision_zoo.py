@@ -1,0 +1,122 @@
+"""Vision model zoo beyond ResNet (reference `python/paddle/vision/models`):
+LeNet, AlexNet, VGG, MobileNetV1/V2, SqueezeNet — architecture parity via
+the published parameter counts, output shapes, layout-parity, and a
+train-step smoke per family."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision import models as M
+
+pytestmark = pytest.mark.slow
+
+
+def _n_params(m):
+    return sum(int(np.prod(p.shape)) for p in m.parameters())
+
+
+class TestArchitectureParity:
+    """Parameter counts are a strong architecture fingerprint — these are
+    the published reference/torchvision numbers."""
+
+    @pytest.mark.parametrize("ctor,expected", [
+        # reference lenet.py uses a 3x3 first conv (61610), not the 5x5
+        # torch LeNet-5 variant (61706)
+        (lambda: M.LeNet(), 61_610),
+        (lambda: M.alexnet(), 61_100_840),
+        (lambda: M.vgg16(), 138_357_544),
+        (lambda: M.vgg11(batch_norm=True), 132_868_840),
+        (lambda: M.mobilenet_v2(), 3_504_872),
+        (lambda: M.squeezenet1_0(), 1_248_424),
+        (lambda: M.squeezenet1_1(), 1_235_496),
+    ])
+    def test_param_counts(self, ctor, expected):
+        assert _n_params(ctor()) == expected
+
+    def test_mobilenet_v1_scale(self):
+        # width multiplier shrinks the net (exact count is topology-dependent;
+        # the 1.0 net matches the canonical ~4.2M)
+        full = _n_params(M.mobilenet_v1())
+        half = _n_params(M.mobilenet_v1(scale=0.5))
+        assert 4_100_000 < full < 4_400_000
+        assert half < full / 2.5
+
+
+class TestForwardShapes:
+    @pytest.mark.parametrize("ctor,in_shape,out_dim", [
+        (lambda: M.LeNet(num_classes=10), (2, 1, 28, 28), 10),
+        (lambda: M.alexnet(num_classes=7), (2, 3, 224, 224), 7),
+        (lambda: M.vgg11(num_classes=5), (1, 3, 224, 224), 5),
+        (lambda: M.mobilenet_v2(num_classes=6), (2, 3, 224, 224), 6),
+        (lambda: M.mobilenet_v1(num_classes=6), (2, 3, 224, 224), 6),
+        (lambda: M.squeezenet1_1(num_classes=9), (2, 3, 224, 224), 9),
+    ])
+    def test_logits_shape(self, ctor, in_shape, out_dim):
+        paddle.seed(0)
+        m = ctor()
+        m.eval()
+        x = paddle.to_tensor(np.random.default_rng(0)
+                             .standard_normal(in_shape).astype("float32"))
+        out = m(x)
+        assert tuple(out.shape) == (in_shape[0], out_dim)
+
+    def test_features_only_stay_nchw(self):
+        m = M.mobilenet_v2(num_classes=0, with_pool=False,
+                           data_format="NHWC")
+        x = paddle.to_tensor(np.zeros((1, 3, 64, 64), "float32"))
+        out = m(x)
+        assert tuple(out.shape) == (1, 1280, 2, 2)  # NCHW features
+
+
+class TestLayoutParity:
+    @pytest.mark.parametrize("family,hw", [("alexnet", 224), ("vgg11", 64),
+                                           ("mobilenet_v2", 64),
+                                           ("squeezenet1_1", 64)])
+    def test_nhwc_matches_nchw(self, family, hw):
+        ctor = getattr(M, family)
+        paddle.seed(3)
+        a = ctor(num_classes=4, data_format="NCHW")
+        paddle.seed(3)
+        b = ctor(num_classes=4, data_format="NHWC")
+        a.eval()
+        b.eval()
+        x = np.random.default_rng(1).standard_normal((2, 3, hw, hw)).astype("float32")
+        np.testing.assert_allclose(a(paddle.to_tensor(x)).numpy(),
+                                   b(paddle.to_tensor(x)).numpy(),
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestTrainSmoke:
+    @pytest.mark.parametrize("ctor,in_shape", [
+        (lambda: M.LeNet(num_classes=4), (4, 1, 28, 28)),
+        (lambda: M.mobilenet_v2(num_classes=4, scale=0.5), (4, 3, 64, 64)),
+        (lambda: M.squeezenet1_1(num_classes=4), (4, 3, 64, 64)),
+    ])
+    def test_loss_decreases(self, ctor, in_shape):
+        paddle.seed(0)
+        m = ctor()
+        opt = paddle.optimizer.Adam(1e-3, parameters=m.parameters())
+        x = paddle.to_tensor(np.random.default_rng(0)
+                             .standard_normal(in_shape).astype("float32"))
+        y = paddle.to_tensor(np.arange(in_shape[0]) % 4)
+        losses = []
+        for _ in range(4):
+            loss = F.cross_entropy(m(x), y).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+
+class TestErrors:
+    def test_pretrained_raises(self):
+        for fn in (M.alexnet, M.vgg16, M.mobilenet_v2, M.squeezenet1_0):
+            with pytest.raises(NotImplementedError, match="zero egress"):
+                fn(pretrained=True)
+
+    def test_bad_squeezenet_version(self):
+        with pytest.raises(ValueError, match="1.0.*1.1"):
+            M.SqueezeNet(version="2.0")
