@@ -9,10 +9,15 @@ matching the reference's semantics (it likewise ships pickled python
 between trusted job workers; this is an intra-job control channel, not an
 open endpoint).
 
-Every frame is authenticated with HMAC-SHA256 over a per-job secret that
-rank 0 publishes through the TCPStore at init: a frame whose tag does not
-verify is dropped BEFORE unpickling, so reaching the ephemeral port is not
-enough to inject code — the peer must also hold the job secret. The server
+Every frame is authenticated with HMAC-SHA256 over a per-job secret: a
+frame whose tag does not verify is dropped BEFORE unpickling.  Trust
+boundary (advisor round 4): by default rank 0 mints the secret and
+publishes it through the UNAUTHENTICATED TCPStore rendezvous, so the HMAC
+only protects against peers who cannot reach the rendezvous master — any
+process that can talk to the master endpoint during init can read the
+secret.  For a stronger boundary set ``PADDLE_RPC_SECRET`` (hex string) in
+every worker's environment; the secret then never transits the store and
+reaching the master is NOT enough to forge frames. The server
 binds to the interface that routes to the rendezvous master (or
 ``PADDLE_LOCAL_IP``), not 0.0.0.0, and the same address is advertised to
 peers (``gethostbyname(gethostname())`` resolves to 127.0.1.1 on some
@@ -172,14 +177,51 @@ def init_rpc(name: str, rank: Optional[int] = None,
         store, node_rank = rendezvous(
             master_endpoint, world_size, job_id="rpc",
             node_rank=None if rank is None or rank < 0 else rank)
-        # per-job frame-auth secret: rank 0 mints it, everyone reads it
+        # per-job frame-auth secret: out-of-band via PADDLE_RPC_SECRET if
+        # set (the store rendezvous is unauthenticated — see module
+        # docstring); otherwise rank 0 mints it and everyone reads it
         # through the store before any RPC socket accepts traffic
-        import secrets as _secrets
+        import os as _os
 
+        env_secret = _os.environ.get("PADDLE_RPC_SECRET")
         if node_rank == 0:
-            store.set("rpc/secret", _secrets.token_hex(32).encode())
-        store.wait(["rpc/secret"], timeout=_DEFAULT_RPC_TIMEOUT * 10)
-        secret = bytes(store.get("rpc/secret"))
+            store.set("rpc/secret_source", b"env" if env_secret else b"store")
+        store.wait(["rpc/secret_source"], timeout=_DEFAULT_RPC_TIMEOUT * 10)
+        source = bytes(store.get("rpc/secret_source")).decode()
+        if source == "env" and not env_secret:
+            raise RuntimeError(
+                "rank 0 uses PADDLE_RPC_SECRET but it is not set on this "
+                "rank — set it on every worker (partial deployment would "
+                "hang on the first call)")
+        if env_secret and source != "env":
+            raise RuntimeError(
+                "PADDLE_RPC_SECRET is set on this rank but not on rank 0 — "
+                "set it everywhere or nowhere")
+        if env_secret:
+            secret = env_secret.encode()
+        else:
+            import secrets as _secrets
+
+            if node_rank == 0:
+                store.set("rpc/secret", _secrets.token_hex(32).encode())
+            store.wait(["rpc/secret"], timeout=_DEFAULT_RPC_TIMEOUT * 10)
+            secret = bytes(store.get("rpc/secret"))
+        # consistency check: a PARTIAL PADDLE_RPC_SECRET deployment (some
+        # ranks env, some store) would otherwise degrade to silent dropped
+        # frames / timeouts — every rank publishes a digest of the secret
+        # it will actually use, rank 0's is the reference
+        import hashlib as _hashlib
+
+        digest = _hashlib.sha256(b"rpc-secret-check:" + secret).hexdigest()
+        if node_rank == 0:
+            store.set("rpc/secret_digest", digest.encode())
+        store.wait(["rpc/secret_digest"], timeout=_DEFAULT_RPC_TIMEOUT * 10)
+        ref = bytes(store.get("rpc/secret_digest")).decode()
+        if ref != digest:
+            raise RuntimeError(
+                "rpc secret mismatch: this rank's frame-auth secret differs "
+                "from rank 0's (PADDLE_RPC_SECRET set on some ranks but not "
+                "all?) — refusing to start, every call would silently hang")
         info = WorkerInfo(name, node_rank, ip, port)
         store.set(f"rpc/worker/{name}",
                   pickle.dumps((name, node_rank, ip, port)))

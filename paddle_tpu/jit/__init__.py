@@ -100,12 +100,21 @@ class StaticFunction:
         if code is not None and fglobals is not None:
             import dis
 
-            # walk LOAD_GLOBAL instructions specifically: co_names also
-            # lists ATTRIBUTE names, which would falsely capture an
-            # unrelated global Layer that happens to share a name with
-            # e.g. an `obj.model` access
+            # walk LOAD_GLOBAL/LOAD_NAME instructions specifically:
+            # co_names also lists ATTRIBUTE names, which would falsely
+            # capture an unrelated global Layer that happens to share a
+            # name with e.g. an `obj.model` access.  LOAD_NAME is what
+            # class-body / exec / some REPL scopes emit instead of
+            # LOAD_GLOBAL (advisor round 4).  Two documented gaps remain:
+            # (a) Layers reached only through attribute access on a
+            # container (``holder.model``) are NOT discoverable; (b) a
+            # LOAD_NAME that actually binds a class-body LOCAL resolves
+            # here against __globals__, so a same-named module-level
+            # Layer would be captured instead of the local one (which
+            # stays missed).  In both cases pass the Layer explicitly or
+            # bind it via closure/defaults.
             for ins in dis.get_instructions(code):
-                if ins.opname == "LOAD_GLOBAL":
+                if ins.opname in ("LOAD_GLOBAL", "LOAD_NAME"):
                     v = fglobals.get(ins.argval)
                     if isinstance(v, Layer):
                         layers.append(v)

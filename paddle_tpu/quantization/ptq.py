@@ -66,8 +66,20 @@ class PTQ:
                         aq = sub._sub_layers.get("activation_quanter")
                         if isinstance(wrapped, Linear) and aq is not None \
                                 and hasattr(aq, "scales"):
-                            a_scale = float(jnp.asarray(
-                                aq.scales()._value).reshape(-1)[0])
+                            a_scales = jnp.asarray(
+                                aq.scales()._value).reshape(-1)
+                            # Int8Linear freezes ONE activation scale; a
+                            # per-channel activation quanter would be
+                            # silently truncated to channel 0 (advisor
+                            # round 4) — refuse instead
+                            if getattr(aq, "quant_axis", lambda: None)() \
+                                    is not None or a_scales.size != 1:
+                                raise RuntimeError(
+                                    f"PTQ.convert(to_int8=True): '{name}' "
+                                    "has a per-channel activation quanter "
+                                    f"({a_scales.size} scales); Int8Linear "
+                                    "needs a per-tensor activation scale")
+                            a_scale = float(a_scales[0])
                             if a_scale <= 0.0:
                                 raise RuntimeError(
                                     f"PTQ.convert: '{name}' saw no "
