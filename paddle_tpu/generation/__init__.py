@@ -107,6 +107,29 @@ class GenerationMixin:
         kv = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
         return cfg.num_hidden_layers, kv, cfg.head_dim
 
+    def _cached_program(self, sig, build):
+        """LRU-bounded compile cache (``generate_cache_size`` flag): every
+        distinct signature compiles one program; a serving process must not
+        retain them forever.  ``self._generate_compiles`` counts builds so
+        serving tests can assert bucketing keeps the program count at the
+        bucket count."""
+        from collections import OrderedDict
+
+        from ..framework.flags import get_flags
+
+        cache = self.__dict__.setdefault("_generate_cache", OrderedDict())
+        if sig in cache:
+            cache.move_to_end(sig)
+            return cache[sig]
+        prog = build()
+        self._generate_compiles = getattr(self, "_generate_compiles", 0) + 1
+        cache[sig] = prog
+        cap = max(1, int(get_flags("generate_cache_size")
+                         ["generate_cache_size"]))
+        while len(cache) > cap:
+            cache.popitem(last=False)
+        return prog
+
     # -- public API --------------------------------------------------------
     @no_grad()
     def generate(self, input_ids, max_new_tokens: int = 64,
@@ -115,9 +138,30 @@ class GenerationMixin:
                  eos_token_id: Optional[int] = None,
                  pad_token_id: Optional[int] = None, seed: int = 0,
                  min_new_tokens: int = 0, repetition_penalty: float = 1.0,
-                 attention_mask=None):
-        """Greedy (``do_sample=False``) or sampled decoding with a static
-        KV cache, fully jit-compiled (prefill + scan over decode steps).
+                 attention_mask=None, num_beams: int = 1,
+                 length_penalty: float = 1.0, early_stopping: bool = False,
+                 num_return_sequences: int = 1, bucket: Optional[str] = None):
+        """Greedy (``do_sample=False``), sampled, or — with ``num_beams>1``
+        — beam-search decoding with a static KV cache, fully jit-compiled
+        (prefill + scan over decode steps).
+
+        Beam search (reference `nn/decode.py:153,994` capability; HF/
+        PaddleNLP knobs): ``num_beams`` beams per row, hypotheses scored
+        ``cum_logprob / len**length_penalty``; ``early_stopping=True``
+        stops a row once ``num_beams`` hypotheses exist, False keeps
+        searching while a running beam could still win.  Returns the best
+        ``num_return_sequences`` hypotheses per row as
+        ``[batch*num_return_sequences, max_new_tokens]`` ids and their
+        final scores (one per sequence — not per token as in sampling).
+        ``do_sample=True`` is incompatible with ``num_beams>1``.
+
+        ``bucket="pow2"`` left-pads the prompt to the next power-of-two
+        length (≥16, capped by the position budget) so ragged serving
+        prompts share compiled programs instead of compiling one per
+        length (the reference absorbs ragged prompts in its paged
+        block_multi_head_attention cache; here the static-cache program
+        is reused via the left-pad machinery, so outputs are
+        row-identical to the unbucketed decode).
 
         ``input_ids``: int Tensor/array [batch, prompt_len].  Batched
         ragged prompts use LEFT padding + ``attention_mask`` ([batch,
@@ -135,6 +179,25 @@ class GenerationMixin:
             else jnp.asarray(input_ids)
         if ids.ndim != 2:
             raise ValueError(f"input_ids must be [batch, seq], got {ids.shape}")
+        if bucket is not None:
+            if bucket != "pow2":
+                raise ValueError(f"bucket={bucket!r}: only 'pow2' supported")
+            cur = int(ids.shape[1])
+            cap = self.config.max_position_embeddings - int(max_new_tokens)
+            tgt = max(16, 1 << (cur - 1).bit_length())
+            tgt = max(min(tgt, cap), cur)
+            if tgt > cur:
+                extra = tgt - cur
+                nb = int(ids.shape[0])
+                filler = jnp.zeros((nb, extra), ids.dtype)  # masked out below
+                ids = jnp.concatenate([filler, ids], axis=1)
+                m = (np.ones((nb, cur), np.int32) if attention_mask is None
+                     else np.asarray(
+                         attention_mask.numpy()
+                         if isinstance(attention_mask, Tensor)
+                         else attention_mask).astype(np.int32))
+                attention_mask = np.concatenate(
+                    [np.zeros((nb, extra), np.int32), m], axis=1)
         pad_lens = None
         if attention_mask is not None:
             m = np.asarray(attention_mask.numpy()
@@ -170,18 +233,45 @@ class GenerationMixin:
             raise ValueError("min_new_tokens must be in [0, max_new_tokens]")
         if repetition_penalty <= 0:
             raise ValueError("repetition_penalty must be > 0")
+        if num_beams > 1:
+            if do_sample:
+                raise ValueError("num_beams > 1 requires do_sample=False "
+                                 "(beam-sample is not supported)")
+            if repetition_penalty != 1.0:
+                raise NotImplementedError(
+                    "repetition_penalty with beam search is not supported")
+            if not 1 <= int(num_return_sequences) <= num_beams:
+                raise ValueError("num_return_sequences must be in "
+                                 "[1, num_beams]")
+            sig = ("beam", b, prompt, max_new, int(num_beams), eos, pad,
+                   int(min_new_tokens), float(length_penalty),
+                   bool(early_stopping), pad_lens is not None)
+            prog = self._cached_program(
+                sig, lambda: self._build_generate_beam(*sig[1:]))
+            params = [p for _, p in self.named_parameters()]
+            buffers = [bf for _, bf in self.named_buffers()]
+            if pad_lens is None:
+                pad_lens = jnp.zeros((b,), jnp.int32)
+            all_ids, all_scores = prog(
+                [p._value for p in params], [bf._value for bf in buffers],
+                ids.astype(jnp.int32), pad_lens)
+            nrs = int(num_return_sequences)
+            out = all_ids[:, :nrs, :].reshape(b * nrs, max_new)
+            sc = all_scores[:, :nrs].reshape(b * nrs)
+            return Tensor(out), Tensor(sc)
+        if num_return_sequences != 1:
+            raise ValueError(
+                "num_return_sequences > 1 requires num_beams > 1")
         sig = (b, prompt, max_new, bool(do_sample), int(top_k),
                float(top_p), float(temperature), eos, pad,
                int(min_new_tokens), float(repetition_penalty),
                pad_lens is not None)
-        cache: Dict = self.__dict__.setdefault("_generate_cache", {})
-        if sig not in cache:
-            cache[sig] = self._build_generate(*sig)
+        prog = self._cached_program(sig, lambda: self._build_generate(*sig))
         params = [p for _, p in self.named_parameters()]
         buffers = [bf for _, bf in self.named_buffers()]
         if pad_lens is None:
             pad_lens = jnp.zeros((b,), jnp.int32)  # shape-stable jit arg
-        out_ids, scores = cache[sig](
+        out_ids, scores = prog(
             [p._value for p in params], [bf._value for bf in buffers],
             ids.astype(jnp.int32), pad_lens, jax.random.PRNGKey(seed))
         return Tensor(out_ids), Tensor(scores)
@@ -211,8 +301,8 @@ class GenerationMixin:
                 eos_col = jnp.arange(logits.shape[-1]) == eos
                 logits = jnp.where(suppress & eos_col[None, :],
                                    jnp.finfo(jnp.float32).min, logits)
-            logprobs_full = jax.nn.log_softmax(logits, axis=-1)
             if not do_sample:
+                logprobs_full = jax.nn.log_softmax(logits, axis=-1)
                 tok = jnp.argmax(logits, axis=-1)
             else:
                 scaled = logits / max(temperature, 1e-6)
@@ -232,6 +322,10 @@ class GenerationMixin:
                     scaled = jnp.where(scaled < kth,
                                        jnp.finfo(jnp.float32).min, scaled)
                 tok = jax.random.categorical(key, scaled, axis=-1)
+                # scores reflect the distribution actually SAMPLED from
+                # (post temperature/top-k/top-p), matching the reference
+                # generation convention (advisor round 4)
+                logprobs_full = jax.nn.log_softmax(scaled, axis=-1)
             logp = jnp.take_along_axis(logprobs_full, tok[:, None],
                                        axis=-1)[:, 0]
             return tok.astype(jnp.int32), logp
@@ -295,5 +389,53 @@ class GenerationMixin:
                 else:
                     out, scores = tok[:, None], logp[:, None]
             return out, scores
+
+        return jax.jit(fn)
+
+    def _build_generate_beam(self, b, prompt, max_new, num_beams, eos, pad,
+                             min_new=0, length_penalty=1.0,
+                             early_stopping=False, padded=False):
+        """Compile beam search: prefill (batch b) + K-fold cache tiling +
+        the ``beam_search_loop`` scan, all in ONE XLA program."""
+        from ..jit import _StateSwap
+        from .beam_search import beam_search_loop
+
+        params = [p for _, p in self.named_parameters()]
+        buffers = [bf for _, bf in self.named_buffers()]
+        n_layers, kv_heads, head_dim = self._kv_cache_spec()
+        total = prompt + max_new
+        K = int(num_beams)
+        model = self
+
+        def step_model(ids_slice, caches, offset, pad_lens):
+            logits, caches = model(Tensor(ids_slice), kv_cache=caches,
+                                   position_offset=offset,
+                                   pad_lens=pad_lens if padded else None)
+            return logits._value, caches
+
+        def fn(param_arrays, buffer_arrays, ids, pad_lens):
+            with _StateSwap(params, param_arrays), \
+                    _StateSwap(buffers, buffer_arrays), no_grad():
+                cdt = next((a.dtype for a in param_arrays
+                            if jnp.issubdtype(a.dtype, jnp.floating)),
+                           jnp.float32)
+                caches = [(jnp.zeros((b, total, kv_heads, head_dim), cdt),
+                           jnp.zeros((b, total, kv_heads, head_dim), cdt))
+                          for _ in range(n_layers)]
+                logits, caches = step_model(ids, caches, 0, pad_lens)
+                caches = jax.tree_util.tree_map(
+                    lambda a: jnp.repeat(a, K, axis=0), caches)
+                beam_pad_lens = jnp.repeat(pad_lens, K, axis=0)
+
+                def beam_step(tok, caches, offset, pl):
+                    return step_model(tok, caches, offset, pl)
+
+                return beam_search_loop(
+                    beam_step, caches, logits[:, -1, :],
+                    num_beams=K, max_new=max_new, eos=eos, pad=pad,
+                    length_penalty=length_penalty,
+                    early_stopping=early_stopping, min_new=min_new,
+                    prompt_len=prompt,
+                    pad_lens=beam_pad_lens if padded else None)
 
         return jax.jit(fn)

@@ -1,0 +1,159 @@
+"""Beam-search decoding inside the compiled-scan generation design.
+
+Reference capability: `/root/reference/python/paddle/nn/decode.py:153`
+(``BeamSearchDecoder``) / `:994` (``dynamic_decode``) and the
+PaddleNLP-side ``generate(decode_strategy="beam_search")`` convention
+(HF-style ``BeamSearchScorer``: per-batch bank of finished hypotheses,
+2K-candidate pool so finished beams never starve the frontier,
+``length_penalty`` applied when a hypothesis is banked, ``early_stopping``
+controlling whether the search keeps refining after K hypotheses exist).
+
+TPU-native translation: the whole search — every decode step, the KV-cache
+reordering when beams switch parents, the hypothesis bank, the stop rule —
+is ONE ``lax.scan`` inside ONE compiled XLA program.  All shapes are
+static: the bank is a fixed ``[batch, K]`` block, candidate pools are
+``[batch, 2K]``, and per-batch completion is a latch (finished batches keep
+computing pass-through values; there is no host round-trip per token).
+
+Semantics (pinned for the brute-force parity test in
+``tests/test_beam_search.py``):
+
+- running beams are selected each step by CUMULATIVE log-prob (raw, not
+  length-normalized) from the 2K best (beam, token) continuations whose
+  token is not eos — matching the reference decoder's selection rule;
+- a continuation that ends in eos is a CANDIDATE HYPOTHESIS, scored
+  ``cum_logprob / (length ** length_penalty)`` with length counting the
+  eos token (HF/PaddleNLP convention), and merged into the per-batch
+  top-K bank;
+- the search for a batch row stops when its bank holds K hypotheses and
+  either ``early_stopping`` is True or no running beam can still beat the
+  worst banked hypothesis (HF heuristic: best running cumulative score
+  length-normalized at the current length);
+- at ``max_new_tokens``, still-running beams are banked at max length;
+  finished hypotheses always outrank unfinished fill-ins.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["beam_search_loop"]
+
+_NEG = jnp.float32(-1e9)
+
+
+def beam_search_loop(step_fn: Callable, caches, first_logits,
+                     *, num_beams: int, max_new: int, eos: int, pad: int,
+                     length_penalty: float = 1.0, early_stopping: bool = False,
+                     min_new: int = 0, prompt_len: int = 0,
+                     pad_lens=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Run the compiled beam search.
+
+    ``step_fn(tok[b*K, 1], caches, offset, pad_lens[b*K]) -> (logits[b*K, V],
+    caches)`` is one cached decode step; ``caches`` must already be tiled to
+    ``b*K`` rows (beam-fastest: row = batch*K + beam).  ``first_logits``
+    [b, V] are the prefill logits at the last prompt position.  Returns
+    ``(ids [b, K, max_new], scores [b, K])`` sorted best-first per batch;
+    positions after each hypothesis's eos hold ``pad``.
+    """
+    K = int(num_beams)
+    b, V = first_logits.shape
+    if K < 1:
+        raise ValueError("num_beams must be >= 1")
+    kk = min(2 * K, K * V)  # candidate pool (vocab smaller than 2K: degrade)
+    lp = float(length_penalty)
+
+    def suppress_eos(logp, t):
+        if eos < 0 or min_new <= 0:
+            return logp
+        eos_col = jnp.arange(V) == eos
+        return jnp.where((t < min_new) & eos_col[None, None, :], _NEG, logp)
+
+    # step-0 frontier: only beam 0 is alive, all beams share the prefill
+    # logits, so the first 2K candidates are beam 0's best tokens
+    logp0 = jax.nn.log_softmax(first_logits.astype(jnp.float32), axis=-1)
+    logp0 = jnp.broadcast_to(logp0[:, None, :], (b, K, V))
+    run_scores0 = jnp.full((b, K), _NEG, jnp.float32).at[:, 0].set(0.0)
+    run_ids0 = jnp.full((b, K, max_new), pad, jnp.int32)
+    bank_ids0 = jnp.full((b, K, max_new), pad, jnp.int32)
+    bank_scores0 = jnp.full((b, K), _NEG, jnp.float32)
+    done0 = jnp.zeros((b,), bool)
+    rows = jnp.arange(b)[:, None]
+
+    def body(carry, t):
+        logp, caches, run_ids, run_scores, bank_ids, bank_scores, done = carry
+        logp = suppress_eos(logp, t)
+        cand = (run_scores[:, :, None] + logp).reshape(b, K * V)
+        top_scores, top_idx = jax.lax.top_k(cand, kk)      # [b, kk]
+        beam = top_idx // V
+        tok = (top_idx % V).astype(jnp.int32)
+        cand_ids = jnp.take_along_axis(run_ids, beam[:, :, None], axis=1)
+        cand_ids = jax.lax.dynamic_update_slice_in_dim(
+            cand_ids, tok[:, :, None], t, axis=2)
+        is_eos = tok == eos if eos >= 0 else jnp.zeros_like(tok, bool)
+
+        # bank merge: eos-candidates length-normalized at len = t+1
+        pen = top_scores / jnp.power(jnp.float32(t + 1), lp)
+        eos_pen = jnp.where(is_eos, pen, _NEG)
+        merged_scores = jnp.concatenate([bank_scores, eos_pen], axis=1)
+        merged_ids = jnp.concatenate([bank_ids, cand_ids], axis=1)
+        new_bank_scores, sel = jax.lax.top_k(merged_scores, K)
+        new_bank_ids = jnp.take_along_axis(merged_ids, sel[:, :, None], axis=1)
+        new_bank_scores = jnp.where(done[:, None], bank_scores, new_bank_scores)
+        new_bank_ids = jnp.where(done[:, None, None], bank_ids, new_bank_ids)
+
+        # running frontier: best K non-eos continuations
+        run_pool = jnp.where(is_eos, _NEG, top_scores)
+        new_run_scores, rsel = jax.lax.top_k(run_pool, K)   # [b, K]
+        new_run_ids = jnp.take_along_axis(cand_ids, rsel[:, :, None], axis=1)
+        new_tok = jnp.take_along_axis(tok, rsel, axis=1)
+        parent = jnp.take_along_axis(beam, rsel, axis=1)    # [b, K]
+        new_run_scores = jnp.where(done[:, None], run_scores, new_run_scores)
+        new_run_ids = jnp.where(done[:, None, None], run_ids, new_run_ids)
+
+        # stop rule (per batch, latched)
+        bank_full = new_bank_scores[:, K - 1] > _NEG / 2
+        if early_stopping:
+            newly_done = bank_full
+        else:
+            highest = new_run_scores[:, 0] / jnp.power(jnp.float32(t + 1), lp)
+            newly_done = bank_full & (new_bank_scores[:, K - 1] >= highest)
+        done = done | newly_done
+
+        # KV-cache beam reordering: row bi*K + ki takes parent bi*K + p
+        flat_parent = (rows * K + parent).reshape(b * K)
+        caches = jax.tree_util.tree_map(
+            lambda a: jnp.take(a, flat_parent, axis=0), caches)
+
+        # one cached model step on the selected tokens (generated token t
+        # lives at cache position prompt_len + t; the final iteration's
+        # logits are computed but never consumed — the carry is discarded)
+        pl = (jnp.zeros((b * K,), jnp.int32) if pad_lens is None
+              else pad_lens)
+        logits, caches = step_fn(new_tok.reshape(b * K, 1), caches,
+                                 prompt_len + t, pl)
+        logp_next = jax.nn.log_softmax(
+            logits.reshape(b, K, V).astype(jnp.float32), axis=-1)
+        return (logp_next, caches, new_run_ids, new_run_scores,
+                new_bank_ids, new_bank_scores, done), None
+
+    carry0 = (logp0, caches, run_ids0, run_scores0, bank_ids0, bank_scores0,
+              done0)
+    (logp, caches, run_ids, run_scores, bank_ids, bank_scores, done), _ = \
+        jax.lax.scan(body, carry0, jnp.arange(max_new))
+
+    # fill under-full banks from still-running beams, normalized at max
+    # length; finished hypotheses always outrank running fill-ins
+    run_pen = run_scores / jnp.power(jnp.float32(max_new), lp)
+    finished_key = bank_scores + jnp.where(bank_scores > _NEG / 2, 1e6, 0.0)
+    running_key = jnp.where(run_scores > _NEG / 2, run_pen, _NEG)
+    all_keys = jnp.concatenate([finished_key, running_key], axis=1)
+    all_ids = jnp.concatenate([bank_ids, run_ids], axis=1)
+    all_scores = jnp.concatenate([bank_scores, run_pen], axis=1)
+    key_sorted, sel = jax.lax.top_k(all_keys, K)
+    out_ids = jnp.take_along_axis(all_ids, sel[:, :, None], axis=1)
+    out_scores = jnp.take_along_axis(all_scores, sel, axis=1)
+    return out_ids, out_scores

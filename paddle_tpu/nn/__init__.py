@@ -37,3 +37,4 @@ from .layer.common import (ChannelShuffle, PairwiseDistance, PixelUnshuffle,  # 
                            Unflatten, ZeroPad2D)
 from .layer.activation import LogSigmoid, RReLU, Silu, Softmax2D  # noqa: E402,F401
 from .layer.pooling import AdaptiveMaxPool3D  # noqa: E402,F401
+from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: E402,F401

@@ -1,0 +1,268 @@
+"""``paddle.nn`` decoding API: ``Decoder`` / ``BeamSearchDecoder`` /
+``dynamic_decode`` (reference `python/paddle/nn/decode.py:153` and `:994`).
+
+The reference drives ``decoder.step`` from a host-side python/while-op loop.
+TPU-native translation: ``dynamic_decode`` compiles the WHOLE decode — every
+``cell`` call, the beam bookkeeping, the finish latch — into one
+``lax.scan`` program.  Consequences, pinned here:
+
+- ``max_step_num`` is REQUIRED (static bound; the reference's "decode until
+  finished" open-ended mode has no static-shape equivalent) and the stacked
+  outputs always have ``max_step_num + 1`` time entries — once every row is
+  finished the remaining entries are frozen pass-through values (for
+  ``BeamSearchDecoder``: ``end_token`` with parent = self, which
+  ``gather_tree`` collapses), where the reference would simply have stopped
+  appending.  Callers use ``sequence_lengths`` (``return_length=True``) to
+  trim, exactly as with the reference.
+- per-step selection follows the reference exactly: cumulative log-probs,
+  finished beams frozen through the ``noend`` mask (only ``end_token``
+  continuable at probability 1), NO length penalty (the reference's
+  ``# TODO: length penalty`` — the penalty lives in
+  ``generate(num_beams=...)``, `generation/beam_search.py`).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+from .layer.layers import Layer
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+_KINF = 1e9
+
+
+def _map(fn, *trees):
+    """tree_map over possibly-nested structures of Tensors/arrays."""
+    is_leaf = lambda x: isinstance(x, Tensor)  # noqa: E731
+    return jax.tree_util.tree_map(fn, *trees, is_leaf=is_leaf)
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Decoder:
+    """Base decoding protocol (reference `nn/decode.py:41`):
+    ``initialize(inits) -> (inputs, states, finished)``,
+    ``step(time, inputs, states, **kwargs) -> (outputs, states, inputs,
+    finished)``, optional ``finalize``."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over an RNN-style ``cell`` (reference `nn/decode.py:153`).
+
+    ``cell(inputs, states) -> (outputs, new_states)`` with batch dim
+    ``batch*beam`` (merged); ``embedding_fn`` maps selected token ids to the
+    next step's inputs; ``output_fn`` maps cell outputs to logits."""
+
+    OutputWrapper = collections.namedtuple(
+        "OutputWrapper", ("scores", "predicted_ids", "parent_ids"))
+    StateWrapper = collections.namedtuple(
+        "StateWrapper", ("cell_states", "log_probs", "finished", "lengths"))
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[batch, ...] -> [batch*beam, ...] with each entry repeated
+        ``beam_size`` times (reference `:471`)."""
+        v = _val(x)
+        out = jnp.repeat(v, beam_size, axis=0)
+        return Tensor(out) if isinstance(x, Tensor) else out
+
+    # -- shape helpers ----------------------------------------------------
+    def _split(self, v):
+        return v.reshape((-1, self.beam_size) + v.shape[1:])
+
+    def _merge(self, v):
+        return v.reshape((-1,) + v.shape[2:])
+
+    def _expand(self, v):
+        return jnp.repeat(v[:, None, ...], self.beam_size, axis=1)
+
+    def _gather(self, v, indices):
+        """v [batch, beam, ...], indices [batch, beam] -> reorder beams."""
+        idx = indices.reshape(indices.shape + (1,) * (v.ndim - 2))
+        return jnp.take_along_axis(v, idx, axis=1)
+
+    # -- protocol ---------------------------------------------------------
+    def initialize(self, initial_cell_states):
+        states = _map(_val, initial_cell_states)
+        leaves = jax.tree_util.tree_leaves(states)
+        batch = leaves[0].shape[0]
+        K = self.beam_size
+        cell_states = _map(self._expand, states)
+        init_inputs = jnp.full((batch, K), self.start_token, jnp.int32)
+        log_probs = jnp.tile(
+            jnp.asarray([[0.0] + [-_KINF] * (K - 1)], jnp.float32),
+            (batch, 1))
+        finished = jnp.zeros((batch, K), bool)
+        lengths = jnp.zeros((batch, K), jnp.int32)
+        if self.embedding_fn is not None:
+            init_inputs = _val(self.embedding_fn(Tensor(init_inputs)))
+        return (init_inputs,
+                self.StateWrapper(cell_states, log_probs, finished, lengths),
+                finished)
+
+    def step(self, time, inputs, states, **kwargs):
+        K = self.beam_size
+        merged_inputs = _map(lambda v: Tensor(self._merge(_val(v))), inputs)
+        merged_states = _map(lambda v: Tensor(self._merge(v)),
+                             states.cell_states)
+        outs, next_cell = self.cell(merged_inputs, merged_states, **kwargs)
+        outs = _map(lambda v: self._split(_val(v)), outs)
+        next_cell = _map(lambda v: self._split(_val(v)), next_cell)
+        if self.output_fn is not None:
+            outs = _val(self.output_fn(Tensor(outs)))
+        logits = outs.astype(jnp.float32)          # [batch, beam, vocab]
+        batch, _, V = logits.shape
+
+        step_log_probs = jax.nn.log_softmax(logits, axis=-1)
+        # finished beams may only continue with end_token, at probability 1
+        noend = jnp.full((V,), -_KINF, jnp.float32).at[self.end_token].set(0.0)
+        step_log_probs = jnp.where(states.finished[:, :, None],
+                                   noend[None, None, :], step_log_probs)
+        log_probs = step_log_probs + states.log_probs[:, :, None]
+        scores = log_probs.reshape(batch, K * V)
+        topk_scores, topk_idx = jax.lax.top_k(scores, K)
+        beam_idx = topk_idx // V
+        token_idx = (topk_idx % V).astype(jnp.int32)
+        next_log_probs = jnp.take_along_axis(scores, topk_idx, axis=1)
+        next_cell = _map(lambda v: self._gather(v, beam_idx), next_cell)
+        next_finished = self._gather(states.finished, beam_idx)
+        next_lengths = self._gather(states.lengths, beam_idx)
+        next_lengths = next_lengths + (~next_finished).astype(jnp.int32)
+        next_finished = next_finished | (token_idx == self.end_token)
+
+        output = self.OutputWrapper(topk_scores, token_idx,
+                                    beam_idx.astype(jnp.int32))
+        new_state = self.StateWrapper(next_cell, next_log_probs,
+                                      next_finished, next_lengths)
+        next_inputs = (token_idx if self.embedding_fn is None
+                       else _val(self.embedding_fn(Tensor(token_idx))))
+        return output, new_state, next_inputs, next_finished
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        """Back-trace the beam tree (reference `:631` — drives
+        ``F.gather_tree``)."""
+        from .functional import gather_tree
+
+        predicted = gather_tree(Tensor(outputs.predicted_ids),
+                                Tensor(outputs.parent_ids))
+        return predicted._value, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def dynamic_decode(decoder: Decoder, inits=None,
+                   max_step_num: Optional[int] = None,
+                   output_time_major: bool = False, impute_finished: bool = False,
+                   is_test: bool = False, return_length: bool = False,
+                   **kwargs):
+    """Run ``decoder`` to completion inside ONE compiled scan (reference
+    `nn/decode.py:994`).  Returns ``(final_outputs, final_states)`` plus
+    ``sequence_lengths`` when ``return_length=True``; outputs are
+    batch-major unless ``output_time_major``."""
+    if max_step_num is None:
+        raise ValueError(
+            "dynamic_decode on TPU compiles the whole decode as one "
+            "program and needs a static bound: pass max_step_num")
+    steps = int(max_step_num) + 1  # reference loop runs times 0..max
+
+    # the decoder's Layers (cell/embedding/output) are called inside jit;
+    # swap their param/buffer arrays in as traced values
+    layers = [v for v in vars(decoder).values() if isinstance(v, Layer)]
+    params = [p for lay in layers for _, p in lay.named_parameters()]
+    buffers = [b for lay in layers for _, b in lay.named_buffers()]
+
+    init_inputs, init_states, init_finished = decoder.initialize(inits)
+
+    def run(param_arrays, buffer_arrays, init_inputs, init_states,
+            init_finished):  # compiled once per signature (cache below)
+        from ..jit import _StateSwap
+
+        with _StateSwap(params, param_arrays), \
+                _StateSwap(buffers, buffer_arrays):
+            def body(carry, t):
+                inputs, states, finished, lengths = carry
+                outs, next_states, next_inputs, next_fin = decoder.step(
+                    Tensor(jnp.asarray(t, jnp.int32)), inputs, states,
+                    **kwargs)
+                if not decoder.tracks_own_finished:
+                    next_fin = next_fin | finished
+                if impute_finished:  # carry old state through finished rows
+                    def mask(new, old):
+                        m = finished.reshape(
+                            finished.shape + (1,) * (new.ndim - finished.ndim))
+                        return jnp.where(m, old, new)
+                    next_states = _map(mask, next_states, states)
+                lengths = lengths + (~finished).astype(jnp.int32)
+                return (next_inputs, next_states, next_fin, lengths), outs
+
+            lengths0 = jnp.zeros(init_finished.shape, jnp.int32)
+            carry0 = (init_inputs, init_states, init_finished, lengths0)
+            (final_in, final_states, finished, lengths), outputs = \
+                jax.lax.scan(body, carry0, jnp.arange(steps))
+        return outputs, final_states, lengths
+
+    # cache the compiled program on the decoder: an eval loop calling
+    # dynamic_decode per batch must not re-trace the whole scan each call
+    in_vals = (_map(_val, init_inputs), _map(_val, init_states),
+               init_finished)
+    if kwargs:  # extra step args are BAKED into the trace: never reuse
+        prog = jax.jit(run)
+    else:
+        flat, treedef = jax.tree_util.tree_flatten(in_vals)
+        key = (steps, impute_finished, treedef,
+               tuple((tuple(a.shape), str(a.dtype)) for a in flat),
+               len(params), len(buffers))
+        cache = decoder.__dict__.setdefault("_dyndec_cache", {})
+        if key not in cache:
+            cache[key] = jax.jit(run)
+        prog = cache[key]
+    outputs, final_states, lengths = prog(
+        [p._value for p in params], [b._value for b in buffers], *in_vals)
+
+    if hasattr(decoder, "finalize") and not is_test:
+        try:
+            outputs, final_states = decoder.finalize(outputs, final_states,
+                                                     lengths)
+        except NotImplementedError:
+            pass
+    if not output_time_major:
+        outputs = _map(
+            lambda v: jnp.swapaxes(v, 0, 1), outputs)
+    outputs = _map(Tensor, outputs)
+    final_states = _map(lambda v: Tensor(v) if not isinstance(v, Tensor)
+                        else v, final_states)
+    if return_length:
+        return outputs, final_states, Tensor(lengths)
+    return outputs, final_states

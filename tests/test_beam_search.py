@@ -1,0 +1,181 @@
+"""Beam-search decoding: generate(num_beams=...) must match a brute-force
+numpy beam search that recomputes every prefix with the model's FULL
+forward (no KV cache) — verifying both the compiled-scan selection logic
+and cache/no-cache consistency.  Semantics pinned in
+paddle_tpu/generation/beam_search.py (reference capability:
+nn/decode.py:153,994 + PaddleNLP generate knobs)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.tensor.tensor import Tensor
+
+NEG = -1e9
+
+
+def _log_softmax(x):
+    x = x - x.max()
+    return x - np.log(np.exp(x).sum())
+
+
+def _full_logits(model, prefix):
+    out = model(Tensor(np.asarray(prefix, np.int32)[None]))
+    return np.asarray(out.numpy(), np.float64)[0, -1]
+
+
+def brute_beam(model, prompt, K, max_new, eos, pad, lp=1.0,
+               early_stopping=False, min_new=0):
+    """Independent reference: python loops + full-forward logits.
+    Tie-breaks replicate lax.top_k (stable: lower flat index wins)."""
+    V = None
+    running = [(0.0, [])] + [(NEG, []) for _ in range(K - 1)]
+    bank = []  # (penalized_score, tokens)
+    done = False
+    for t in range(max_new):
+        if done:
+            break
+        cands = []  # (score, flat_index, beam, tok)
+        for k, (cum, toks) in enumerate(running):
+            logp = _log_softmax(_full_logits(
+                model, np.concatenate([prompt, toks]).astype(np.int32)))
+            V = logp.shape[0]
+            if eos >= 0 and t < min_new:
+                logp = logp.copy()
+                logp[eos] = NEG
+            for v in range(V):
+                cands.append((cum + logp[v], k * V + v, k, v))
+        cands.sort(key=lambda c: (-c[0], c[1]))
+        top = cands[:min(2 * K, K * V)]
+        for score, _, k, v in top:
+            if v == eos:
+                bank.append((score / ((t + 1) ** lp),
+                             running[k][1] + [v]))
+        bank = sorted(bank, key=lambda h: -h[0])[:K]
+        non_eos = [c for c in top if c[3] != eos][:K]
+        running = [(c[0], running[c[2]][1] + [c[3]]) for c in non_eos]
+        full = len(bank) == K
+        if early_stopping:
+            done = full
+        else:
+            highest = running[0][0] / ((t + 1) ** lp)
+            done = full and bank[-1][0] >= highest
+    # merge still-running beams at max length; finished always outrank
+    fill = [(cum / (max_new ** lp), toks) for cum, toks in running
+            if cum > NEG / 2]
+    merged = ([(s, toks, 1) for s, toks in bank]
+              + [(s, toks, 0) for s, toks in fill])
+    merged.sort(key=lambda h: (-h[2], -h[0]))
+    out_ids, out_scores = [], []
+    for s, toks, _ in merged[:K]:
+        out_ids.append(toks + [pad] * (max_new - len(toks)))
+        out_scores.append(s)
+    return np.asarray(out_ids, np.int32), np.asarray(out_scores)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(7)
+    cfg = llama_tiny(num_hidden_layers=2, vocab_size=64,
+                     max_position_embeddings=64)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return model, cfg
+
+
+@pytest.mark.parametrize("lp,early", [(1.0, False), (1.0, True),
+                                      (2.0, False), (0.0, True)])
+def test_beam4_matches_bruteforce(tiny_model, lp, early):
+    model, cfg = tiny_model
+    rng = np.random.default_rng(3)
+    prompts = rng.integers(0, cfg.vocab_size, (2, 5)).astype(np.int32)
+    K, max_new, eos = 4, 6, 9
+    ids, scores = model.generate(
+        paddle.to_tensor(prompts), max_new_tokens=max_new, num_beams=K,
+        eos_token_id=eos, pad_token_id=0, length_penalty=lp,
+        early_stopping=early, num_return_sequences=K)
+    got_ids = ids.numpy().reshape(2, K, max_new)
+    got_scores = scores.numpy().reshape(2, K)
+    for bi in range(2):
+        want_ids, want_scores = brute_beam(
+            model, prompts[bi], K, max_new, eos, 0, lp=lp,
+            early_stopping=early)
+        np.testing.assert_array_equal(
+            got_ids[bi], want_ids,
+            err_msg=f"row {bi} lp={lp} early={early}")
+        np.testing.assert_allclose(got_scores[bi], want_scores,
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_beam_min_new_tokens(tiny_model):
+    model, cfg = tiny_model
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(0, cfg.vocab_size, (1, 4)).astype(np.int32)
+    K, max_new, eos = 3, 5, 9
+    ids, scores = model.generate(
+        paddle.to_tensor(prompt), max_new_tokens=max_new, num_beams=K,
+        eos_token_id=eos, pad_token_id=0, min_new_tokens=3,
+        num_return_sequences=K)
+    got = ids.numpy().reshape(K, max_new)
+    want_ids, want_scores = brute_beam(model, prompt[0], K, max_new, eos, 0,
+                                       min_new=3)
+    np.testing.assert_array_equal(got, want_ids)
+    # no hypothesis may end before 3 generated tokens
+    for row in got:
+        eos_pos = np.where(row == eos)[0]
+        if eos_pos.size:
+            assert eos_pos[0] >= 2
+
+
+def test_beam_no_eos_returns_running(tiny_model):
+    model, cfg = tiny_model
+    rng = np.random.default_rng(6)
+    prompt = rng.integers(0, cfg.vocab_size, (1, 3)).astype(np.int32)
+    ids, scores = model.generate(paddle.to_tensor(prompt),
+                                 max_new_tokens=4, num_beams=2,
+                                 num_return_sequences=2)
+    got = ids.numpy().reshape(2, 4)
+    want_ids, want_scores = brute_beam(model, prompt[0], 2, 4, -1, -1)
+    np.testing.assert_array_equal(got, want_ids)
+    np.testing.assert_allclose(scores.numpy(), want_scores, rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_beam_batch_rows_match_solo(tiny_model):
+    model, cfg = tiny_model
+    rng = np.random.default_rng(8)
+    prompts = rng.integers(0, cfg.vocab_size, (3, 6)).astype(np.int32)
+    K, max_new, eos = 3, 5, 9
+    batched, _ = model.generate(
+        paddle.to_tensor(prompts), max_new_tokens=max_new, num_beams=K,
+        eos_token_id=eos, pad_token_id=0)
+    batched = batched.numpy()
+    for bi in range(3):
+        solo, _ = model.generate(
+            paddle.to_tensor(prompts[bi:bi + 1]), max_new_tokens=max_new,
+            num_beams=K, eos_token_id=eos, pad_token_id=0)
+        np.testing.assert_array_equal(batched[bi], solo.numpy()[0])
+
+
+def test_beam_default_returns_best_only(tiny_model):
+    model, cfg = tiny_model
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(0, cfg.vocab_size, (2, 4)).astype(np.int32)
+    ids, scores = model.generate(paddle.to_tensor(prompt),
+                                 max_new_tokens=3, num_beams=3,
+                                 eos_token_id=9, pad_token_id=0)
+    assert tuple(ids.shape) == (2, 3)
+    assert tuple(scores.shape) == (2,)
+
+
+def test_beam_arg_validation(tiny_model):
+    model, cfg = tiny_model
+    prompt = paddle.to_tensor(np.zeros((1, 3), np.int32))
+    with pytest.raises(ValueError, match="do_sample"):
+        model.generate(prompt, max_new_tokens=2, num_beams=2, do_sample=True)
+    with pytest.raises(ValueError, match="num_return_sequences"):
+        model.generate(prompt, max_new_tokens=2, num_beams=2,
+                       num_return_sequences=3)
+    with pytest.raises(ValueError, match="num_return_sequences"):
+        model.generate(prompt, max_new_tokens=2, num_return_sequences=2)

@@ -1,0 +1,133 @@
+"""nn.BeamSearchDecoder + nn.dynamic_decode (reference nn/decode.py:153,994):
+the compiled-scan decode must match an eager python reimplementation of the
+reference's beam step (cumulative log-probs, frozen finished beams via the
+noend mask, NO length penalty) plus gather_tree backtrace."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.tensor.tensor import Tensor
+
+NEG = 1e9
+
+
+def _log_softmax(x):
+    x = x - x.max(axis=-1, keepdims=True)
+    return x - np.log(np.exp(x).sum(axis=-1, keepdims=True))
+
+
+def _ref_beam_decode(cell_np, embed_w, out_w, out_b, h0, start, end, K,
+                     max_step_num):
+    """Eager numpy replica of reference BeamSearchDecoder semantics."""
+    batch, H = h0.shape
+    V = out_w.shape[1]
+    h = np.repeat(h0[:, None, :], K, axis=1)          # [b, K, H]
+    log_probs = np.tile([[0.0] + [-NEG] * (K - 1)], (batch, 1))
+    finished = np.zeros((batch, K), bool)
+    tok = np.full((batch, K), start, np.int64)
+    all_pred, all_parent = [], []
+    for t in range(max_step_num + 1):
+        emb = embed_w[tok]                            # [b, K, E]
+        h_new = cell_np(emb.reshape(batch * K, -1),
+                        h.reshape(batch * K, H)).reshape(batch, K, H)
+        logits = h_new @ out_w + out_b                # [b, K, V]
+        step_lp = _log_softmax(logits)
+        noend = np.full((V,), -NEG)
+        noend[end] = 0.0
+        step_lp = np.where(finished[:, :, None], noend[None, None, :], step_lp)
+        scores = (step_lp + log_probs[:, :, None]).reshape(batch, K * V)
+        # lax.top_k tie-break: lower flat index wins
+        idx = np.argsort(-scores, axis=1, kind="stable")[:, :K]
+        topk = np.take_along_axis(scores, idx, axis=1)
+        beam = idx // V
+        token = (idx % V).astype(np.int64)
+        log_probs = topk
+        h = np.take_along_axis(h_new, beam[:, :, None], axis=1)
+        finished = np.take_along_axis(finished, beam, axis=1)
+        finished = finished | (token == end)
+        tok = token
+        all_pred.append(token)
+        all_parent.append(beam)
+        if finished.all():
+            pass  # compiled version keeps stepping with frozen semantics
+    pred = np.stack(all_pred)                          # [T, b, K]
+    parent = np.stack(all_parent)
+    # gather_tree backtrace
+    T = pred.shape[0]
+    out = np.zeros_like(pred)
+    ptr = np.tile(np.arange(K)[None, :], (batch, 1))
+    for ti in range(T - 1, -1, -1):
+        out[ti] = np.take_along_axis(pred[ti], ptr, axis=1)
+        ptr = np.take_along_axis(parent[ti], ptr, axis=1)
+    return out
+
+
+@pytest.fixture(scope="module")
+def setup():
+    paddle.seed(11)
+    V, E, H, K = 23, 8, 16, 4
+    embed = nn.Embedding(V, E)
+    cell = nn.GRUCell(E, H)
+    out = nn.Linear(H, V)
+    dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=1, beam_size=K,
+                               embedding_fn=embed, output_fn=out)
+    return dec, cell, embed, out, (V, E, H, K)
+
+
+def test_dynamic_decode_matches_reference_semantics(setup):
+    dec, cell, embed, out, (V, E, H, K) = setup
+    batch, max_step = 3, 7
+    rng = np.random.default_rng(0)
+    h0 = rng.standard_normal((batch, H)).astype("float32")
+
+    outputs, states, lengths = nn.dynamic_decode(
+        dec, inits=Tensor(h0), max_step_num=max_step, return_length=True)
+    got = outputs.numpy()                              # [b, T, K] batch-major
+    assert got.shape == (batch, max_step + 1, K)
+
+    # numpy replica of the same math
+    wi, wh = cell.weight_ih.numpy(), cell.weight_hh.numpy()
+    bi, bh = cell.bias_ih.numpy(), cell.bias_hh.numpy()
+
+    def gru_np(x, h):
+        gi = x @ wi.T + bi
+        gh = h @ wh.T + bh
+        H_ = h.shape[1]
+        rz = 1.0 / (1.0 + np.exp(-(gi[:, :2 * H_] + gh[:, :2 * H_])))
+        r, z = rz[:, :H_], rz[:, H_:]
+        c = np.tanh(gi[:, 2 * H_:] + r * gh[:, 2 * H_:])
+        return (h - c) * z + c
+
+    want = _ref_beam_decode(gru_np, embed.weight.numpy(), out.weight.numpy(),
+                            out.bias.numpy(), h0, 0, 1, K, max_step)
+    np.testing.assert_array_equal(got, np.transpose(want, (1, 0, 2)))
+
+
+def test_dynamic_decode_time_major_and_lengths(setup):
+    dec, _, _, _, (V, E, H, K) = setup
+    batch, max_step = 2, 5
+    h0 = np.random.default_rng(1).standard_normal((batch, H)).astype("float32")
+    outputs, states, lengths = nn.dynamic_decode(
+        dec, inits=Tensor(h0), max_step_num=max_step,
+        output_time_major=True, return_length=True)
+    assert outputs.numpy().shape == (max_step + 1, batch, K)
+    assert lengths.numpy().shape == (batch, K)
+    assert (lengths.numpy() <= max_step + 1).all()
+
+
+def test_dynamic_decode_requires_static_bound(setup):
+    dec, _, _, _, (V, E, H, K) = setup
+    h0 = np.zeros((1, H), np.float32)
+    with pytest.raises(ValueError, match="max_step_num"):
+        nn.dynamic_decode(dec, inits=Tensor(h0))
+
+
+def test_tile_beam_merge_with_batch():
+    x = np.arange(6).reshape(3, 2).astype("float32")
+    tiled = nn.BeamSearchDecoder.tile_beam_merge_with_batch(
+        Tensor(x), 2).numpy()
+    assert tiled.shape == (6, 2)
+    np.testing.assert_array_equal(tiled[0], tiled[1])
+    np.testing.assert_array_equal(tiled[4], tiled[5])
