@@ -1,0 +1,142 @@
+"""DenseNet (reference `python/paddle/vision/models/densenet.py:203` —
+pre-activation dense layers (BN-relu-conv1x1 → BN-relu-conv3x3), concat
+growth, half-width transitions; spec table `:249`).  Channels-last
+internals resolved like ResNet; the dense concat runs on the feature-minor
+axis, which is exactly where TPU wants it."""
+
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+_SPEC = {
+    121: (64, 32, [6, 12, 24, 16]),
+    161: (96, 48, [6, 12, 36, 24]),
+    169: (64, 32, [6, 12, 32, 32]),
+    201: (64, 32, [6, 12, 48, 32]),
+    264: (64, 32, [6, 12, 64, 48]),
+}
+
+
+class _BNReluConv(nn.Layer):
+    """Pre-activation unit: BN → relu → conv (reference BNACConvLayer)."""
+
+    def __init__(self, in_c, out_c, k, stride=1, pad=0, df="NCHW"):
+        super().__init__()
+        self.bn = nn.BatchNorm2D(in_c, data_format=df)
+        self.relu = nn.ReLU()
+        self.conv = nn.Conv2D(in_c, out_c, k, stride=stride, padding=pad,
+                              bias_attr=False, data_format=df)
+
+    def forward(self, x):
+        return self.conv(self.relu(self.bn(x)))
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_c, growth, bn_size, dropout, df):
+        super().__init__()
+        self.f1 = _BNReluConv(in_c, bn_size * growth, 1, df=df)
+        self.f2 = _BNReluConv(bn_size * growth, growth, 3, pad=1, df=df)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+        self._axis = 3 if df == "NHWC" else 1
+
+    def forward(self, x):
+        from ...tensor.manipulation import concat
+
+        y = self.f2(self.f1(x))
+        if self.dropout is not None:
+            y = self.dropout(y)
+        return concat([x, y], axis=self._axis)
+
+
+class _Transition(nn.Layer):
+    def __init__(self, in_c, out_c, df):
+        super().__init__()
+        self.conv = _BNReluConv(in_c, out_c, 1, df=df)
+        self.pool = nn.AvgPool2D(2, stride=2, data_format=df)
+
+    def forward(self, x):
+        return self.pool(self.conv(x))
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers: int = 121, bn_size: int = 4,
+                 dropout: float = 0.0, num_classes: int = 1000,
+                 with_pool: bool = True, data_format: str = "auto"):
+        super().__init__()
+        from ...incubate.autotune import resolve_conv_data_format
+
+        if layers not in _SPEC:
+            raise ValueError(
+                f"supported layers are {sorted(_SPEC)}, got {layers}")
+        if data_format == "auto":
+            data_format = resolve_conv_data_format()
+        self.data_format = df = data_format
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        init_c, growth, block_config = _SPEC[layers]
+        stem_df = "NCHW:NHWC" if df == "NHWC" else df
+
+        self.stem_conv = nn.Conv2D(3, init_c, 7, stride=2, padding=3,
+                                   bias_attr=False, data_format=stem_df)
+        self.stem_bn = nn.BatchNorm2D(init_c, data_format=df)
+        self.stem_relu = nn.ReLU()
+        self.stem_pool = nn.MaxPool2D(3, stride=2, padding=1, data_format=df)
+
+        blocks, c = [], init_c
+        for i, n_layers in enumerate(block_config):
+            for _ in range(n_layers):
+                blocks.append(_DenseLayer(c, growth, bn_size, dropout, df))
+                c += growth
+            if i != len(block_config) - 1:
+                blocks.append(_Transition(c, c // 2, df))
+                c //= 2
+        self.blocks = nn.Sequential(*blocks)
+        self.final_bn = nn.BatchNorm2D(c, data_format=df)
+        self.final_relu = nn.ReLU()
+        self._out_c = c
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1, data_format=df)
+        if num_classes > 0:
+            self.out = nn.Linear(c, num_classes)
+
+    def forward(self, x):
+        from ...tensor.manipulation import flatten, transpose
+
+        x = self.stem_pool(self.stem_relu(self.stem_bn(self.stem_conv(x))))
+        x = self.final_relu(self.final_bn(self.blocks(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            return self.out(flatten(x, 1))
+        if self.data_format == "NHWC":
+            x = transpose(x, [0, 3, 1, 2])  # public NCHW features
+        return x
+
+
+def _densenet(layers, pretrained, **kwargs) -> DenseNet:
+    if pretrained:
+        raise NotImplementedError("no pretrained weight hub (zero egress)")
+    return DenseNet(layers=layers, **kwargs)
+
+
+def densenet121(pretrained=False, **kwargs) -> DenseNet:
+    return _densenet(121, pretrained, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs) -> DenseNet:
+    return _densenet(161, pretrained, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs) -> DenseNet:
+    return _densenet(169, pretrained, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs) -> DenseNet:
+    return _densenet(201, pretrained, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs) -> DenseNet:
+    return _densenet(264, pretrained, **kwargs)

@@ -1,0 +1,178 @@
+"""ShuffleNetV2 (reference `python/paddle/vision/models/shufflenetv2.py:195`
+— channel-split inverted residuals with channel shuffle, stage table by
+width scale, swish variant).  Channels-last internals resolved like ResNet;
+``F.channel_shuffle`` runs natively in either layout."""
+
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0", "shufflenet_v2_swish"]
+
+_STAGE_OUT = {
+    0.25: [-1, 24, 24, 48, 96, 512],
+    0.33: [-1, 24, 32, 64, 128, 512],
+    0.5: [-1, 24, 48, 96, 192, 1024],
+    1.0: [-1, 24, 116, 232, 464, 1024],
+    1.5: [-1, 24, 176, 352, 704, 1024],
+    2.0: [-1, 24, 224, 488, 976, 2048],
+}
+_STAGE_REPEATS = [4, 8, 4]
+
+
+def _act_layer(act):
+    if act == "swish":
+        return nn.Silu
+    if act == "relu":
+        return nn.ReLU
+    if act is None:
+        return None
+    raise ValueError(f"unsupported activation: {act!r}")
+
+
+class _ConvBN(nn.Layer):
+    def __init__(self, in_c, out_c, k, stride=1, pad=0, groups=1, act=nn.ReLU,
+                 df="NCHW", stem=False):
+        super().__init__()
+        conv_df = ("NCHW:NHWC" if df == "NHWC" else df) if stem else df
+        self.conv = nn.Conv2D(in_c, out_c, k, stride=stride, padding=pad,
+                              groups=groups, bias_attr=False,
+                              data_format=conv_df)
+        self.bn = nn.BatchNorm2D(out_c, data_format=df)
+        self.act = act() if act is not None else None
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return self.act(x) if self.act is not None else x
+
+
+class _InvertedResidual(nn.Layer):
+    """Stride-1 unit: split channels, transform one half, shuffle."""
+
+    def __init__(self, in_c, out_c, act, df):
+        super().__init__()
+        h = out_c // 2
+        self.pw = _ConvBN(in_c // 2, h, 1, act=act, df=df)
+        self.dw = _ConvBN(h, h, 3, 1, 1, groups=h, act=None, df=df)
+        self.linear = _ConvBN(h, h, 1, act=act, df=df)
+        self._df = df
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+        from ...tensor.manipulation import concat, split
+
+        axis = 3 if self._df == "NHWC" else 1
+        c = x.shape[axis]
+        x1, x2 = split(x, [c // 2, c // 2], axis=axis)
+        x2 = self.linear(self.dw(self.pw(x2)))
+        return F.channel_shuffle(concat([x1, x2], axis=axis), 2,
+                                 data_format=self._df)
+
+
+class _InvertedResidualDS(nn.Layer):
+    """Stride-2 downsampling unit: both branches transform, then shuffle."""
+
+    def __init__(self, in_c, out_c, act, df):
+        super().__init__()
+        h = out_c // 2
+        self.dw1 = _ConvBN(in_c, in_c, 3, 2, 1, groups=in_c, act=None, df=df)
+        self.linear1 = _ConvBN(in_c, h, 1, act=act, df=df)
+        self.pw2 = _ConvBN(in_c, h, 1, act=act, df=df)
+        self.dw2 = _ConvBN(h, h, 3, 2, 1, groups=h, act=None, df=df)
+        self.linear2 = _ConvBN(h, h, 1, act=act, df=df)
+        self._df = df
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+        from ...tensor.manipulation import concat
+
+        axis = 3 if self._df == "NHWC" else 1
+        x1 = self.linear1(self.dw1(x))
+        x2 = self.linear2(self.dw2(self.pw2(x)))
+        return F.channel_shuffle(concat([x1, x2], axis=axis), 2,
+                                 data_format=self._df)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale: float = 1.0, act: str = "relu",
+                 num_classes: int = 1000, with_pool: bool = True,
+                 data_format: str = "auto"):
+        super().__init__()
+        from ...incubate.autotune import resolve_conv_data_format
+
+        if scale not in _STAGE_OUT:
+            raise ValueError(f"scale {scale} not implemented; "
+                             f"choose from {sorted(_STAGE_OUT)}")
+        if data_format == "auto":
+            data_format = resolve_conv_data_format()
+        self.data_format = df = data_format
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        out_c = _STAGE_OUT[scale]
+        a = _act_layer(act)
+
+        self.conv1 = _ConvBN(3, out_c[1], 3, 2, 1, act=a, df=df, stem=True)
+        self.max_pool = nn.MaxPool2D(3, stride=2, padding=1, data_format=df)
+        blocks = []
+        for stage, reps in enumerate(_STAGE_REPEATS):
+            blocks.append(_InvertedResidualDS(out_c[stage + 1],
+                                              out_c[stage + 2], a, df))
+            for _ in range(reps - 1):
+                blocks.append(_InvertedResidual(out_c[stage + 2],
+                                                out_c[stage + 2], a, df))
+        self.blocks = nn.Sequential(*blocks)
+        self.last_conv = _ConvBN(out_c[-2], out_c[-1], 1, act=a, df=df)
+        self._out_c = out_c[-1]
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1, data_format=df)
+        if num_classes > 0:
+            self.fc = nn.Linear(out_c[-1], num_classes)
+
+    def forward(self, x):
+        from ...tensor.manipulation import flatten, transpose
+
+        x = self.last_conv(self.blocks(self.max_pool(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            return self.fc(flatten(x, 1))
+        if self.data_format == "NHWC":
+            x = transpose(x, [0, 3, 1, 2])  # public NCHW features
+        return x
+
+
+def _shufflenet(pretrained, **kwargs) -> ShuffleNetV2:
+    if pretrained:
+        raise NotImplementedError("no pretrained weight hub (zero egress)")
+    return ShuffleNetV2(**kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return _shufflenet(pretrained, scale=0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return _shufflenet(pretrained, scale=0.33, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return _shufflenet(pretrained, scale=0.5, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return _shufflenet(pretrained, scale=1.0, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return _shufflenet(pretrained, scale=1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return _shufflenet(pretrained, scale=2.0, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return _shufflenet(pretrained, scale=1.0, act="swish", **kwargs)

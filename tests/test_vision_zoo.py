@@ -31,6 +31,17 @@ class TestArchitectureParity:
         (lambda: M.mobilenet_v2(), 3_504_872),
         (lambda: M.squeezenet1_0(), 1_248_424),
         (lambda: M.squeezenet1_1(), 1_235_496),
+        (lambda: M.densenet121(), 7_978_856),
+        (lambda: M.densenet169(), 14_149_480),
+        (lambda: M.shufflenet_v2_x1_0(), 2_278_604),
+        (lambda: M.shufflenet_v2_x0_5(), 1_366_792),
+        (lambda: M.mobilenet_v3_large(), 5_483_032),
+        (lambda: M.mobilenet_v3_small(), 2_542_856),
+        # no-aux InceptionV3 (the reference ships no aux head)
+        (lambda: M.inception_v3(), 23_834_568),
+        # reference googlenet is the bias-free no-BN variant with
+        # fc-1152 aux heads — count pinned from this implementation
+        (lambda: M.googlenet(), 11_535_736),
     ])
     def test_param_counts(self, ctor, expected):
         assert _n_params(ctor()) == expected
@@ -52,6 +63,10 @@ class TestForwardShapes:
         (lambda: M.mobilenet_v2(num_classes=6), (2, 3, 224, 224), 6),
         (lambda: M.mobilenet_v1(num_classes=6), (2, 3, 224, 224), 6),
         (lambda: M.squeezenet1_1(num_classes=9), (2, 3, 224, 224), 9),
+        (lambda: M.densenet121(num_classes=8), (1, 3, 224, 224), 8),
+        (lambda: M.shufflenet_v2_x0_5(num_classes=6), (2, 3, 224, 224), 6),
+        (lambda: M.mobilenet_v3_small(num_classes=7), (2, 3, 224, 224), 7),
+        (lambda: M.inception_v3(num_classes=5), (1, 3, 299, 299), 5),
     ])
     def test_logits_shape(self, ctor, in_shape, out_dim):
         paddle.seed(0)
@@ -61,6 +76,19 @@ class TestForwardShapes:
                              .standard_normal(in_shape).astype("float32"))
         out = m(x)
         assert tuple(out.shape) == (in_shape[0], out_dim)
+
+    def test_googlenet_three_heads(self):
+        """Reference googlenet returns [out, aux1, aux2] (224 input only)."""
+        paddle.seed(0)
+        m = M.googlenet(num_classes=6)
+        m.eval()
+        x = paddle.to_tensor(np.random.default_rng(0)
+                             .standard_normal((1, 3, 224, 224))
+                             .astype("float32"))
+        out, aux1, aux2 = m(x)
+        assert tuple(out.shape) == (1, 6)
+        assert tuple(aux1.shape) == (1, 6)
+        assert tuple(aux2.shape) == (1, 6)
 
     def test_features_only_stay_nchw(self):
         m = M.mobilenet_v2(num_classes=0, with_pool=False,
@@ -73,7 +101,11 @@ class TestForwardShapes:
 class TestLayoutParity:
     @pytest.mark.parametrize("family,hw", [("alexnet", 224), ("vgg11", 64),
                                            ("mobilenet_v2", 64),
-                                           ("squeezenet1_1", 64)])
+                                           ("squeezenet1_1", 64),
+                                           ("densenet121", 64),
+                                           ("shufflenet_v2_x0_5", 64),
+                                           ("mobilenet_v3_small", 64),
+                                           ("inception_v3", 96)])
     def test_nhwc_matches_nchw(self, family, hw):
         ctor = getattr(M, family)
         paddle.seed(3)
@@ -93,6 +125,9 @@ class TestTrainSmoke:
         (lambda: M.LeNet(num_classes=4), (4, 1, 28, 28)),
         (lambda: M.mobilenet_v2(num_classes=4, scale=0.5), (4, 3, 64, 64)),
         (lambda: M.squeezenet1_1(num_classes=4), (4, 3, 64, 64)),
+        (lambda: M.shufflenet_v2_x0_25(num_classes=4), (4, 3, 64, 64)),
+        (lambda: M.mobilenet_v3_small(num_classes=4, scale=0.5),
+         (4, 3, 64, 64)),
     ])
     def test_loss_decreases(self, ctor, in_shape):
         paddle.seed(0)
@@ -113,10 +148,20 @@ class TestTrainSmoke:
 
 class TestErrors:
     def test_pretrained_raises(self):
-        for fn in (M.alexnet, M.vgg16, M.mobilenet_v2, M.squeezenet1_0):
+        for fn in (M.alexnet, M.vgg16, M.mobilenet_v2, M.squeezenet1_0,
+                   M.densenet121, M.googlenet, M.inception_v3,
+                   M.shufflenet_v2_x1_0, M.mobilenet_v3_large):
             with pytest.raises(NotImplementedError, match="zero egress"):
                 fn(pretrained=True)
 
     def test_bad_squeezenet_version(self):
         with pytest.raises(ValueError, match="1.0.*1.1"):
             M.SqueezeNet(version="2.0")
+
+    def test_bad_densenet_layers(self):
+        with pytest.raises(ValueError, match="supported layers"):
+            M.DenseNet(layers=42)
+
+    def test_bad_shufflenet_scale(self):
+        with pytest.raises(ValueError, match="not implemented"):
+            M.ShuffleNetV2(scale=3.0)
