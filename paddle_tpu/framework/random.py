@@ -146,3 +146,33 @@ def get_rng_state():
 
 def set_rng_state(state) -> None:
     default_generator.set_state(state)
+
+
+def bulk_key(key):
+    """An ``rbg``-implementation view of ``key`` for BULK mask sampling
+    (dropout and friends).
+
+    The default threefry PRNG is bit-for-bit reproducible but expensive on
+    TPU — measured on v5e, ERNIE-base fine-tune spends 105 ms/step (30% of
+    the step!) generating dropout masks with threefry vs ~0 with the
+    hardware-friendly ``rbg`` generator (`_ernie_probe` round-5).  rbg's
+    statistical quality is ample for masking; the key is derived
+    deterministically from the input key, so a fixed seed still fixes the
+    masks.  Gated by the ``fast_dropout_rng`` flag (on by default; turn off
+    to get threefry masks)."""
+    import jax.numpy as jnp
+
+    from .flags import get_flags
+
+    if not get_flags("fast_dropout_rng")["fast_dropout_rng"]:
+        return key
+    try:
+        if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+            kd = jax.random.key_data(key)
+        else:
+            kd = key
+        if kd.shape[-1] == 2:
+            kd = jnp.concatenate([kd, kd], axis=-1)
+        return jax.random.wrap_key_data(kd.astype(jnp.uint32), impl="rbg")
+    except Exception:  # unknown key flavor: fall back to it unchanged
+        return key

@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...framework.random import next_key
+from ...framework.random import bulk_key, next_key
 from ...tensor._op_utils import ensure_tensor
 from ...tensor.tensor import Tensor, apply_op
 
@@ -205,7 +205,7 @@ def dropout(x, p: float = 0.5, axis=None, training: bool = True, mode: str =
         mask_shape = tuple(s if i in axes else 1 for i, s in enumerate(shape))
     else:
         mask_shape = shape
-    keep = jax.random.bernoulli(next_key(), 1.0 - p, mask_shape)
+    keep = jax.random.bernoulli(bulk_key(next_key()), 1.0 - p, mask_shape)
 
     def fn(v):
         if mode == "upscale_in_train":
@@ -232,7 +232,7 @@ def alpha_dropout(x, p=0.5, training=True, name=None) -> Tensor:
     alpha = 1.6732632423543772
     scale = 1.0507009873554805
     alpha_p = -alpha * scale
-    keep = jax.random.bernoulli(next_key(), 1.0 - p, tuple(x.shape))
+    keep = jax.random.bernoulli(bulk_key(next_key()), 1.0 - p, tuple(x.shape))
     a = (1.0 / np.sqrt((1 - p) * (1 + p * alpha_p ** 2)))
     b = -a * alpha_p * p
 
@@ -1020,7 +1020,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     mask_val = attn_mask._value if isinstance(attn_mask, Tensor) else attn_mask
     tensors = (query, key, value)
     p = dropout_p if training else 0.0
-    dkey = next_key() if p > 0.0 else None
+    dkey = bulk_key(next_key()) if p > 0.0 else None
 
     mode = pallas_mode("use_flash_attention")
     if mode is not None:
