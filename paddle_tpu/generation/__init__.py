@@ -58,6 +58,20 @@ def cached_attention(q, k_new, v_new, cache_k, cache_v, pos, pad_lens=None):
         cache_k, k_new.astype(cache_k.dtype), pos, 1)
     cache_v = jax.lax.dynamic_update_slice_in_dim(
         cache_v, v_new.astype(cache_v.dtype), pos, 1)
+    if s > 1 and pad_lens is None and isinstance(pos, int) and pos == 0:
+        # PREFILL fast path: the prefix being attended IS q's own window,
+        # so this is plain causal self-attention — route it through the
+        # flash kernel instead of materializing the [s, C] score matrix
+        # (at an 8K prompt that matrix is the exact blow-up the reference
+        # built masked_multihead/flash kernels to avoid).  The dense
+        # masked path below stays for decode steps (s small, prefix
+        # large) and padded prefills (flash takes no mask).
+        from ..nn.functional import scaled_dot_product_attention
+        from ..tensor.tensor import Tensor as _T
+
+        out = scaled_dot_product_attention(_T(q), _T(k_new), _T(v_new),
+                                           is_causal=True, training=False)
+        return out._value.astype(q.dtype), cache_k, cache_v
     k = cache_k
     v = cache_v
     if kv != h:  # GQA: broadcast kv groups up to the query heads
