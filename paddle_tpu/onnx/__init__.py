@@ -1,25 +1,30 @@
 """paddle.onnx parity surface (reference `python/paddle/onnx/export.py:22`).
 
-The reference delegates to the external ``paddle2onnx`` package. This build
-runs zero-egress and the image carries no onnx library, so:
-
-- ``format="onnx"`` (the default) requires the ``onnx`` package and raises a
-  clear ImportError without it;
-- ``format="stablehlo"`` serializes the traced program through
-  ``paddle_tpu.jit.save`` — the TPU-native interchange format (StableHLO is
-  what an XLA-backed runtime consumes the way onnxruntime consumes ONNX).
-"""
+The reference delegates to the external ``paddle2onnx`` package.  This
+build runs zero-egress, so the protobuf is emitted DIRECTLY from the traced
+jaxpr (``emit.py``; wire format via protoc-generated bindings from the
+in-tree ``onnx_mini.proto`` schema subset).  Supported: the inference op
+set of MLP/conv/attention-style Layers — see ``emit.py``; unsupported
+primitives raise ``UnsupportedOnnxOp``.  ``format="stablehlo"`` remains
+the TPU-native interchange path (``paddle_tpu.jit.save`` — what an
+XLA-backed runtime consumes the way onnxruntime consumes ONNX)."""
 
 from __future__ import annotations
 
 from typing import Optional, Sequence
 
-__all__ = ["export"]
+from .emit import UnsupportedOnnxOp, emit_model  # noqa: F401
+
+__all__ = ["export", "UnsupportedOnnxOp"]
 
 
 def export(layer, path: str, input_spec: Optional[Sequence] = None,
            opset_version: int = 9, format: str = "onnx", **configs):
-    """Export ``layer`` for inference (reference `onnx/export.py:22`)."""
+    """Export ``layer`` for inference (reference `onnx/export.py:22`).
+
+    ``format="onnx"`` writes ``{path}.onnx``; ``format="stablehlo"``
+    delegates to ``jit.save``.  ``input_spec`` must carry CONCRETE shapes
+    for the onnx path (dim_param-style dynamic dims are not emitted)."""
     if format == "stablehlo":
         from .. import jit
 
@@ -27,15 +32,51 @@ def export(layer, path: str, input_spec: Optional[Sequence] = None,
         return path
     if format != "onnx":
         raise ValueError(f"format must be 'onnx' or 'stablehlo', got {format!r}")
+    if not input_spec:
+        raise ValueError("onnx export needs input_spec (concrete shapes)")
+
+    import jax.numpy as jnp
+
+    from ..jit import InputSpec
+    from ..nn.layer.layers import Layer
+    from ..tensor.tensor import Tensor
+
+    examples = []
+    for spec in input_spec:
+        if isinstance(spec, Tensor):
+            examples.append(spec._value)
+            continue
+        if not isinstance(spec, InputSpec):
+            raise TypeError(f"input_spec entries must be InputSpec/Tensor, "
+                            f"got {type(spec)}")
+        if any(not isinstance(d, int) for d in spec.shape):
+            raise ValueError(
+                f"onnx export needs concrete dims, got {spec.shape} — "
+                "use format='stablehlo' for shape-polymorphic export")
+        import jax
+
+        dt = jnp.dtype("int32" if str(spec.dtype).startswith("int")
+                       else spec.dtype)
+        examples.append(jax.ShapeDtypeStruct(spec.shape, dt))
+
+    model = layer
+    was_training = getattr(model, "training", False)
+    if isinstance(model, Layer):
+        model.eval()
     try:
-        import onnx  # noqa: F401
-    except ImportError as e:
-        raise ImportError(
-            "paddle_tpu.onnx.export(format='onnx') needs the 'onnx' package, "
-            "which this zero-egress image does not ship. Use "
-            "format='stablehlo' for the TPU-native serialized program "
-            "(consumed by paddle_tpu.jit.load / any StableHLO runtime)."
-        ) from e
-    raise NotImplementedError(
-        "ONNX graph emission is not implemented in this build; export with "
-        "format='stablehlo' instead")
+        def fn(*arrays):
+            out = model(*[Tensor(a) for a in arrays])
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            return [o._value if isinstance(o, Tensor) else o for o in outs
+                    if o is not None]
+
+        blob = emit_model(fn, examples,
+                          name=type(model).__name__ if isinstance(model, Layer)
+                          else "paddle_tpu_model")
+    finally:
+        if isinstance(model, Layer) and was_training:
+            model.train()
+    out_path = path if path.endswith(".onnx") else path + ".onnx"
+    with open(out_path, "wb") as f:
+        f.write(blob)
+    return out_path

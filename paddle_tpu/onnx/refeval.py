@@ -1,0 +1,195 @@
+"""Minimal numpy evaluator for the ONNX op subset `emit.py` produces.
+
+Exists so the export path can be NUMERICALLY validated end-to-end in a
+zero-egress image (no onnxruntime): parse the emitted ModelProto with the
+protoc-generated bindings, execute the graph by each op's published ONNX
+semantics, and compare against the live model.  This is a test oracle, not
+a serving runtime."""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import numpy as np
+
+__all__ = ["OnnxRefEvaluator"]
+
+import ml_dtypes
+
+_NP_DTYPES = {1: np.float32, 2: np.uint8, 3: np.int8, 6: np.int32,
+              7: np.int64, 9: np.bool_, 10: np.float16, 11: np.float64,
+              12: np.uint32, 13: np.uint64, 16: ml_dtypes.bfloat16}
+
+
+def _tensor_to_np(t):
+    dt = _NP_DTYPES.get(t.data_type)
+    if dt is None:
+        raise NotImplementedError(f"tensor data_type {t.data_type}")
+    if t.raw_data:
+        arr = np.frombuffer(t.raw_data, dtype=dt)
+    elif t.float_data:
+        arr = np.asarray(list(t.float_data), dtype=dt)
+    elif t.int64_data:
+        arr = np.asarray(list(t.int64_data), dtype=dt)
+    else:
+        arr = np.zeros(0, dt)
+    return arr.reshape(tuple(t.dims))
+
+
+def _attrs(node) -> Dict:
+    out = {}
+    for a in node.attribute:
+        if a.type == 1:    # FLOAT
+            out[a.name] = a.f
+        elif a.type == 2:  # INT
+            out[a.name] = a.i
+        elif a.type == 3:  # STRING
+            out[a.name] = a.s.decode()
+        elif a.type == 6:  # FLOATS
+            out[a.name] = list(a.floats)
+        elif a.type == 7:  # INTS
+            out[a.name] = list(a.ints)
+        else:
+            raise NotImplementedError(f"attribute type {a.type}")
+    return out
+
+
+def _conv(x, w, attrs, b=None):
+    strides = attrs.get("strides", [1, 1])
+    dil = attrs.get("dilations", [1, 1])
+    group = attrs.get("group", 1)
+    pads = attrs.get("pads", [0] * 4)
+    nd = x.ndim - 2
+    lo, hi = pads[:nd], pads[nd:]
+    x = np.pad(x, [(0, 0), (0, 0)] + [(int(l), int(h))
+                                      for l, h in zip(lo, hi)])
+    N, C, H, W = x.shape
+    O, CpG, kh, kw = w.shape
+    eh = (kh - 1) * dil[0] + 1
+    ew = (kw - 1) * dil[1] + 1
+    oh = (H - eh) // strides[0] + 1
+    ow = (W - ew) // strides[1] + 1
+    out = np.zeros((N, O, oh, ow), np.float32)
+    og = O // group
+    for g in range(group):
+        xs = x[:, g * (C // group):(g + 1) * (C // group)]
+        ws = w[g * og:(g + 1) * og]
+        for i in range(oh):
+            for j in range(ow):
+                patch = xs[:, :, i * strides[0]:i * strides[0] + eh:dil[0],
+                           j * strides[1]:j * strides[1] + ew:dil[1]]
+                out[:, g * og:(g + 1) * og, i, j] = np.einsum(
+                    "nchw,ochw->no", patch.astype(np.float32),
+                    ws.astype(np.float32))
+    if b is not None:
+        out += b.reshape(1, -1, 1, 1)
+    return out
+
+
+class OnnxRefEvaluator:
+    def __init__(self, model_bytes: bytes):
+        from . import onnx_mini_pb2 as om
+
+        self.model = om.ModelProto.FromString(model_bytes)
+        self.graph = self.model.graph
+
+    def run(self, *inputs: Sequence[np.ndarray]):
+        env: Dict[str, np.ndarray] = {}
+        for t in self.graph.initializer:
+            env[t.name] = _tensor_to_np(t)
+        for vi, arr in zip(self.graph.input, inputs):
+            env[vi.name] = np.asarray(arr)
+        for node in self.graph.node:
+            ins = [env[n] for n in node.input]
+            a = _attrs(node)
+            op = node.op_type
+            if op == "MatMul":
+                r = ins[0].astype(np.float32) @ ins[1].astype(np.float32)
+            elif op == "Add":
+                r = ins[0] + ins[1]
+            elif op == "Sub":
+                r = ins[0] - ins[1]
+            elif op == "Mul":
+                r = ins[0] * ins[1]
+            elif op == "Div":
+                r = ins[0] / ins[1]
+            elif op == "Max":
+                r = np.maximum(ins[0], ins[1])
+            elif op == "Min":
+                r = np.minimum(ins[0], ins[1])
+            elif op == "Neg":
+                r = -ins[0]
+            elif op == "Exp":
+                r = np.exp(ins[0])
+            elif op == "Log":
+                r = np.log(ins[0])
+            elif op == "Sqrt":
+                r = np.sqrt(ins[0])
+            elif op == "Reciprocal":
+                r = 1.0 / ins[0]
+            elif op == "Tanh":
+                r = np.tanh(ins[0])
+            elif op == "Sigmoid":
+                r = 1.0 / (1.0 + np.exp(-ins[0]))
+            elif op == "Erf":
+                from math import erf
+                r = np.vectorize(erf)(ins[0]).astype(np.float32)
+            elif op == "Abs":
+                r = np.abs(ins[0])
+            elif op == "Pow":
+                r = np.power(ins[0], ins[1])
+            elif op == "Relu":
+                r = np.maximum(ins[0], 0)
+            elif op == "Greater":
+                r = ins[0] > ins[1]
+            elif op == "Less":
+                r = ins[0] < ins[1]
+            elif op == "GreaterOrEqual":
+                r = ins[0] >= ins[1]
+            elif op == "LessOrEqual":
+                r = ins[0] <= ins[1]
+            elif op == "Equal":
+                r = ins[0] == ins[1]
+            elif op == "And":
+                r = ins[0] & ins[1]
+            elif op == "Or":
+                r = ins[0] | ins[1]
+            elif op == "Not":
+                r = ~ins[0]
+            elif op == "Identity":
+                r = ins[0]
+            elif op == "Cast":
+                r = ins[0].astype(_NP_DTYPES[a["to"]])
+            elif op == "Reshape":
+                r = ins[0].reshape(tuple(int(d) for d in ins[1]))
+            elif op == "Transpose":
+                r = np.transpose(ins[0], a["perm"])
+            elif op == "Expand":
+                r = np.broadcast_to(ins[0], tuple(int(d) for d in ins[1]))
+            elif op == "Concat":
+                r = np.concatenate(ins, axis=a["axis"])
+            elif op == "Squeeze":
+                r = np.squeeze(ins[0], axis=tuple(int(d) for d in ins[1]))
+            elif op == "Where":
+                r = np.where(ins[0], ins[1], ins[2])
+            elif op in ("ReduceSum", "ReduceMax", "ReduceMin"):
+                fn = {"ReduceSum": np.sum, "ReduceMax": np.max,
+                      "ReduceMin": np.min}[op]
+                axes = tuple(int(d) for d in ins[1])
+                r = fn(ins[0], axis=axes,
+                       keepdims=bool(a.get("keepdims", 1)))
+            elif op == "Slice":
+                starts, ends, axes, steps = (
+                    [int(v) for v in ins[i]] for i in (1, 2, 3, 4))
+                sl = [slice(None)] * ins[0].ndim
+                for s, e, ax, st in zip(starts, ends, axes, steps):
+                    sl[ax] = slice(s, e, st)
+                r = ins[0][tuple(sl)]
+            elif op == "Conv":
+                r = _conv(ins[0], ins[1], a,
+                          ins[2] if len(ins) > 2 else None)
+            else:
+                raise NotImplementedError(f"refeval op {op}")
+            for out_name in node.output:
+                env[out_name] = r
+        return [env[vo.name] for vo in self.graph.output]

@@ -6,6 +6,28 @@ under the eager vjp tape (apply_op) and inside whole-step jit alike. On
 non-TPU backends the functional layer falls back to the XLA reference paths;
 tests exercise the kernels in interpreter mode."""
 
+def sds_like(shape, dtype, like):
+    """``jax.ShapeDtypeStruct`` for a pallas_call out_shape that PROPAGATES
+    the manual-mesh varying axes (vma) of an input operand.
+
+    Inside a manual ``shard_map`` with ``check_vma=True`` — e.g. the
+    compiled pipeline engine's tick program (`distributed/pipeline_1f1b.py`)
+    — every pallas_call out_shape must declare how it varies across the
+    manual axes; a bare ShapeDtypeStruct raises ``vma must not be None``
+    (round-5 finding: OneFOneBLayers over attention blocks with the Pallas
+    kernels enabled failed on real TPU).  Outside any manual context the
+    vma set is empty and this degrades to a plain ShapeDtypeStruct."""
+    import jax
+
+    try:
+        vma = getattr(jax.typeof(like), "vma", None)
+        if vma:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except Exception:
+        pass
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 from .flash_attention import flash_attention, flash_attention_supported
 from .fused_norm import fused_rms_norm
 from .rope import fused_rope

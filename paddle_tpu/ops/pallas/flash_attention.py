@@ -32,6 +32,8 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from . import sds_like
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -149,8 +151,8 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
                          lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b, hq, sq, _LANES), jnp.float32),
+            sds_like((b, hq, sq, d), q.dtype, q),
+            sds_like((b, hq, sq, _LANES), jnp.float32, q),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -288,8 +290,8 @@ def _bwd(scale, causal, block_q, block_k, interpret, res, do):
                          lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b, hq, sq, _LANES), jnp.float32),
+            sds_like((b, hq, sq, d), q.dtype, q),
+            sds_like((b, hq, sq, _LANES), jnp.float32, q),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -326,8 +328,8 @@ def _bwd(scale, causal, block_q, block_k, interpret, res, do):
                          lambda ib, ihkv, ik, ir, iq: (ib, ihkv, ik, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, hkv, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((b, hkv, sk, d), v.dtype),
+            sds_like((b, hkv, sk, d), k.dtype, k),
+            sds_like((b, hkv, sk, d), v.dtype, v),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),

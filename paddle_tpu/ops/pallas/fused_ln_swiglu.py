@@ -19,6 +19,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+from . import sds_like
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -103,10 +105,10 @@ def _ln_fwd(x, residual, weight, bias, eps, interpret):
             pl.BlockSpec((bn, 1), lambda i: (i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n, h), x.dtype),
-            jax.ShapeDtypeStruct((n, h), x.dtype),
-            jax.ShapeDtypeStruct((n, 1), jnp.float32),
-            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+            sds_like((n, h), x.dtype, x),
+            sds_like((n, h), x.dtype, x),
+            sds_like((n, 1), jnp.float32, x),
+            sds_like((n, 1), jnp.float32, x),
         ],
         interpret=interpret,
     )(x2, r2, weight.reshape(1, h), bias.reshape(1, h))
@@ -136,9 +138,9 @@ def _ln_bwd(eps, interpret, res, cts):
             pl.BlockSpec((1, h), lambda i: (0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n, h), sum_.dtype),
-            jax.ShapeDtypeStruct((1, h), weight.dtype),
-            jax.ShapeDtypeStruct((1, h), weight.dtype),
+            sds_like((n, h), sum_.dtype, sum_),
+            sds_like((1, h), weight.dtype, sum_),
+            sds_like((1, h), weight.dtype, sum_),
         ],
         scratch_shapes=[pltpu.VMEM((1, h), jnp.float32),
                         pltpu.VMEM((1, h), jnp.float32)],
@@ -193,7 +195,7 @@ def _elementwise_call(kernel, args, n_out, interpret):
         grid=(n // bn,),
         in_specs=[pl.BlockSpec((bn, h), lambda i: (i, 0))] * len(rows),
         out_specs=[pl.BlockSpec((bn, h), lambda i: (i, 0))] * n_out,
-        out_shape=[jax.ShapeDtypeStruct((n, h), args[0].dtype)] * n_out,
+        out_shape=[sds_like((n, h), args[0].dtype, args[0])] * n_out,
         interpret=interpret,
     )(*rows)
     outs = outs if isinstance(outs, (list, tuple)) else [outs]
@@ -291,9 +293,9 @@ def fused_adamw(p, g, m, v, lr, t, beta1: float, beta2: float, eps: float,
             pl.BlockSpec((bn, h), lambda i: (i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n, h), p.dtype),
-            jax.ShapeDtypeStruct((n, h), jnp.float32),
-            jax.ShapeDtypeStruct((n, h), jnp.float32),
+            sds_like((n, h), p.dtype, p),
+            sds_like((n, h), jnp.float32, p),
+            sds_like((n, h), jnp.float32, p),
         ],
         interpret=interpret,
     )(p.reshape(n, h), g.reshape(n, h).astype(jnp.float32),

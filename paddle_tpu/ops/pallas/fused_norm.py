@@ -12,6 +12,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+from . import sds_like
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -95,8 +97,8 @@ def _rms_fwd(x, weight, eps, interpret):
             pl.BlockSpec((bn, 1), lambda i: (i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n, h), x.dtype),
-            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+            sds_like((n, h), x.dtype, x),
+            sds_like((n, 1), jnp.float32, x),
         ],
         interpret=interpret,
     )(x2, weight.reshape(1, h))
@@ -121,8 +123,8 @@ def _rms_bwd(eps, interpret, res, dy):
             pl.BlockSpec((1, h), lambda i: (0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n, h), x.dtype),
-            jax.ShapeDtypeStruct((1, h), weight.dtype),
+            sds_like((n, h), x.dtype, x),
+            sds_like((1, h), weight.dtype, x),
         ],
         scratch_shapes=[pltpu.VMEM((1, h), jnp.float32)],
         compiler_params=pltpu.CompilerParams(

@@ -12,6 +12,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+from . import sds_like
 from jax.experimental import pallas as pl
 
 _BLOCK_S = 128  # seq rows per block; keeps (Bs, h, d) f32 temps inside VMEM
@@ -56,8 +58,8 @@ def _rope_raw(q, k, cos_s, sin_s, interpret):
             pl.BlockSpec((1, bs, hk, d), lambda ib, i: (ib, i, 0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            sds_like(q.shape, q.dtype, q),
+            sds_like(k.shape, k.dtype, k),
         ],
         interpret=interpret,
     )(q, k, cos_s, sin_s)

@@ -430,3 +430,36 @@ class TestInterleavedVPP:
                             num_virtual_pipeline_stages=2)
         with pytest.raises(ValueError, match="multiple of the pipe degree"):
             PipelineParallelWithInterleave(pl2, accumulate_steps=3)
+
+
+class TestEnginePallasComposition:
+    def test_engine_over_attention_blocks_with_pallas(self):
+        """The engine's manual shard_map must accept nested Pallas kernels:
+        pallas_call out_shapes need the manual-axes vma propagated
+        (ops/pallas sds_like — round-5 finding: OneFOneBLayers over GPT
+        blocks with the kernels enabled failed on real TPU)."""
+        from paddle_tpu.models import GPTConfig
+        from paddle_tpu.models.gpt import GPTBlock
+
+        prior = paddle.get_flags(["pallas_interpret"])
+        paddle.set_flags({"pallas_interpret": True})
+        try:
+            cfg = GPTConfig(vocab_size=64, hidden_size=64,
+                            num_hidden_layers=4, num_attention_heads=4,
+                            intermediate_size=128,
+                            max_position_embeddings=256)
+            mesh = build_mesh(dp=1, pp=2, sharding=1, sep=1, mp=1,
+                              devices=jax.devices()[:2])
+            paddle.seed(0)
+            blocks = [GPTBlock(cfg) for _ in range(4)]
+            eng = dist.OneFOneBLayers(blocks, mesh, num_microbatches=2,
+                                      loss_fn=lambda o, t: F.mse_loss(o, t))
+            rng = np.random.default_rng(0)
+            x = rng.standard_normal((4, 256, 64)).astype("float32")
+            y = rng.standard_normal(x.shape).astype("float32")
+            loss, grads = eng.loss_and_grads(paddle.to_tensor(x),
+                                             paddle.to_tensor(y))
+            assert np.isfinite(float(loss.numpy()))
+            assert len(grads) > 0
+        finally:
+            paddle.set_flags(prior)
