@@ -280,8 +280,21 @@ class GenerationMixin:
             sc = all_scores[:, :nrs].reshape(b * nrs)
             return Tensor(out), Tensor(sc)
         if num_return_sequences != 1:
-            raise ValueError(
-                "num_return_sequences > 1 requires num_beams > 1")
+            if not do_sample:
+                raise ValueError(
+                    "num_return_sequences > 1 requires num_beams > 1 or "
+                    "do_sample=True")
+            if int(num_return_sequences) < 1:
+                raise ValueError("num_return_sequences must be >= 1")
+            # sampling path: expand each row num_return_sequences times —
+            # categorical draws independent noise per batch row, so the
+            # copies decode to distinct samples (PaddleNLP convention:
+            # returns [batch*num_return_sequences, ...])
+            nrs = int(num_return_sequences)
+            ids = jnp.repeat(ids, nrs, axis=0)
+            if pad_lens is not None:
+                pad_lens = jnp.repeat(pad_lens, nrs, axis=0)
+            b = b * nrs
         sig = (b, prompt, max_new, bool(do_sample), int(top_k),
                float(top_p), float(temperature), eos, pad,
                int(min_new_tokens), float(repetition_penalty),

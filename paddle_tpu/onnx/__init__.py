@@ -34,6 +34,16 @@ def export(layer, path: str, input_spec: Optional[Sequence] = None,
         raise ValueError(f"format must be 'onnx' or 'stablehlo', got {format!r}")
     if not input_spec:
         raise ValueError("onnx export needs input_spec (concrete shapes)")
+    if opset_version not in (9, 18):  # 9 = reference default signature
+        raise ValueError(f"opset_version={opset_version}: this emitter "
+                         "targets opset 18")
+    if opset_version != 18:
+        import logging
+
+        logging.getLogger("paddle_tpu.onnx").warning(
+            "opset_version=%s requested but emission targets opset 18 "
+            "(ReduceMax/Squeeze/Slice use axes-as-input forms)",
+            opset_version)
 
     import jax.numpy as jnp
 
@@ -55,6 +65,14 @@ def export(layer, path: str, input_spec: Optional[Sequence] = None,
                 "use format='stablehlo' for shape-polymorphic export")
         import jax
 
+        # x64 is disabled: integer inputs trace (and therefore emit) as
+        # int32 — say so rather than declaring a dtype the graph won't use
+        if str(spec.dtype) in ("int64", "int16", "int8"):
+            import logging
+
+            logging.getLogger("paddle_tpu.onnx").warning(
+                "input dtype %s traces as int32 under jax x32; the "
+                "emitted graph declares INT32 inputs", spec.dtype)
         dt = jnp.dtype("int32" if str(spec.dtype).startswith("int")
                        else spec.dtype)
         examples.append(jax.ShapeDtypeStruct(spec.shape, dt))

@@ -179,3 +179,18 @@ def test_beam_arg_validation(tiny_model):
                        num_return_sequences=3)
     with pytest.raises(ValueError, match="num_return_sequences"):
         model.generate(prompt, max_new_tokens=2, num_return_sequences=2)
+
+
+def test_sampling_num_return_sequences(tiny_model):
+    """PaddleNLP parity: do_sample + num_return_sequences expands the batch
+    and the copies decode to DISTINCT samples (independent noise per row)."""
+    model, cfg = tiny_model
+    rng = np.random.default_rng(12)
+    prompt = rng.integers(1, cfg.vocab_size, (2, 5)).astype(np.int32)
+    ids, scores = model.generate(
+        paddle.to_tensor(prompt), max_new_tokens=8, do_sample=True,
+        temperature=1.5, num_return_sequences=3, seed=7)
+    assert tuple(ids.shape) == (6, 8)
+    got = ids.numpy()
+    # at least one pair of the 3 samples per row must differ
+    assert not (np.all(got[0] == got[1]) and np.all(got[1] == got[2]))
