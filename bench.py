@@ -30,41 +30,70 @@ import json
 import time
 
 
-# chip kind → peak bf16 TFLOP/s (public specs)
-_PEAK_TFLOPS = {
-    "v5 lite": 197.0, "v5e": 197.0, "v5litepod": 197.0,
-    "v5p": 459.0, "v4": 275.0, "v6e": 918.0, "v6": 918.0,
-    "cpu": 0.5,  # nominal, so the script still reports on CPU
-}
+# chip tables (peak TFLOP/s, ICI GB/s, HBM GB/s) live in ONE home now:
+# paddle_tpu.telemetry.collectives — imported lazily so the subprocess
+# modes can pin the jax platform before paddle_tpu loads
 
 
 def _chip_lookup(device, table: dict) -> float:
-    """Match device_kind substrings against a chip table ('v5 lite' vs
-    'v5e' naming quirks live HERE, once)."""
-    kind = getattr(device, "device_kind", "cpu").lower()
-    for key, val in table.items():
-        if key in kind:
-            return val
-    return table["cpu"]
+    from paddle_tpu.telemetry import chip_lookup
+
+    return chip_lookup(device, table)
 
 
 def _peak_tflops(device) -> float:
-    return _chip_lookup(device, _PEAK_TFLOPS)
+    from paddle_tpu.telemetry import PEAK_TFLOPS
+
+    return _chip_lookup(device, PEAK_TFLOPS)
 
 
-def _time_steps(step, batches, warmup):
+def _make_meter(name: str, **kw):
+    """Telemetry StepMeter for one bench loop (hbm watermarks + per-step
+    collective bytes ride into the BENCH detail via _meter_detail).
+    jsonl_path is pinned to None: meter.step() runs inside the timed
+    region, and a per-step file write (the PADDLE_TPU_TELEMETRY_DIR
+    default) would tax the measured tokens/s."""
+    from paddle_tpu.telemetry import StepMeter
+
+    return StepMeter(name, jsonl_path=False, **kw)
+
+
+def _time_steps(step, batches, warmup, meter=None):
     """Run warmup then timed steps over FRESH batches; host-read sync (the
-    axon relay does not block in block_until_ready)."""
+    axon relay does not block in block_until_ready). ``meter`` (a telemetry
+    StepMeter) is stepped once per timed step — measured 10.8 us/step host
+    cost (8-device CPU mesh, JSONL off), <=0.2% of any >=5 ms bench step."""
     loss = None
     for x, y in batches[:warmup]:
         loss = step(x, y)
     first = float(loss) if loss is not None else float("nan")
+    if meter is not None:
+        meter.begin()
     t0 = time.perf_counter()
     for x, y in batches[warmup:]:
         loss = step(x, y)
+        if meter is not None:
+            meter.step()
     final = float(loss)
     dt = time.perf_counter() - t0
     return dt, first, final
+
+
+def _meter_detail(meter) -> dict:
+    """HBM watermarks + per-step collective-bytes from the StepMeter that
+    drove a _time_steps loop — extra detail fields only; the top-level
+    BENCH schema the harness consumes is unchanged. hbm_peak_gb is PJRT's
+    process-lifetime high-water mark (it never resets, so later ladder
+    points inherit earlier peaks); hbm_live_max_gb is the max live sample
+    within THIS loop's steps — the per-point attributable number."""
+    if meter is None or meter.step_num == 0:
+        return {}
+    s = meter.summary()
+    steps = max(1, s["steps"])
+    return {"hbm_peak_gb": s["hbm_peak_gb"],
+            "hbm_live_max_gb": s["hbm_live_max_gb"],
+            "collective_bytes_per_step":
+                {k: v // steps for k, v in s["collective_bytes"].items()}}
 
 
 def _llama_measure(cfg, batch, seq, steps, warmup):
@@ -89,8 +118,10 @@ def _llama_measure(cfg, batch, seq, steps, warmup):
         ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype("int32")
         batches.append((paddle.to_tensor(ids),
                         paddle.to_tensor(np.roll(ids, -1, axis=1))))
-    dt, first_loss, final_loss = _time_steps(step, batches, warmup)
-    return batch * seq * steps / dt, first_loss, final_loss, n_params
+    meter = _make_meter("bench_llama", tokens_per_step=batch * seq,
+                        model_params=n_params)
+    dt, first_loss, final_loss = _time_steps(step, batches, warmup, meter)
+    return batch * seq * steps / dt, first_loss, final_loss, n_params, meter
 
 
 def bench_llama(on_accel: bool, peak: float):
@@ -108,7 +139,7 @@ def bench_llama(on_accel: bool, peak: float):
                           num_key_value_heads=8, max_position_embeddings=512)
         batch, seq, steps, warmup = 2, 256, 4, 1
 
-    tokens_per_sec, first_loss, final_loss, n_params = _llama_measure(
+    tokens_per_sec, first_loss, final_loss, n_params, meter = _llama_measure(
         cfg, batch, seq, steps, warmup)
     achieved = tokens_per_sec * 6 * n_params / 1e12
     mfu = achieved / peak
@@ -127,6 +158,7 @@ def bench_llama(on_accel: bool, peak: float):
             "ln_vocab": round(math.log(cfg.vocab_size), 4),
             "mfu": round(mfu, 4),
             "achieved_tflops": round(achieved, 2),
+            **_meter_detail(meter),
         },
     }
 
@@ -174,7 +206,9 @@ def bench_resnet(on_accel: bool, peak: float):
         x = rng.standard_normal((batch, 3, hw, hw)).astype("float32")
         y = rng.integers(0, 1000, (batch,)).astype("int64")
         batches.append((paddle.to_tensor(x), paddle.to_tensor(y)))
-    dt, first_loss, final_loss = _time_steps(step, batches, warmup)
+    meter = _make_meter(f"bench_{name}", samples_per_step=batch,
+                        flops_per_step=3 * flops_fwd * batch)
+    dt, first_loss, final_loss = _time_steps(step, batches, warmup, meter)
 
     imgs_per_sec = batch * steps / dt
     achieved = imgs_per_sec * 3 * flops_fwd / 1e12  # train ~ 3x fwd flops
@@ -203,7 +237,8 @@ def bench_resnet(on_accel: bool, peak: float):
                                   "nothing, so the remaining gap to the "
                                   "0.17 single-branch comparator is XLA's "
                                   "conv kernels on the real branched "
-                                  "topology, not framework plumbing"},
+                                  "topology, not framework plumbing",
+                   **_meter_detail(meter)},
     }
 
 
@@ -419,15 +454,6 @@ def _pipeline_eff_main(pp: int, micro: int, v: int = 1) -> None:
                 "t_seq_s": [round(ts1, 4), round(ts2, 4)]},
         "nproc": nproc, "pp": pp, "micro": micro, "virtual_stages": v,
         "policy": "stash"}))
-
-
-# chip kind → per-chip one-directional ICI bandwidth, GB/s (public specs /
-# jax-ml.github.io/scaling-book: v5e 4.5e10 B/s per link one-way)
-_ICI_GBPS_ONEWAY = {
-    "v5 lite": 45.0, "v5e": 45.0, "v5litepod": 45.0,
-    "v5p": 90.0, "v4": 45.0, "v6e": 90.0, "v6": 90.0,
-    "cpu": 10.0,
-}
 
 
 def _tp_derate_main(tp: int, batch: int, seq: int) -> None:
@@ -719,7 +745,10 @@ def bench_gpt_tp_pp(on_accel: bool, peak: float):
         ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype("int32")
         batches.append((paddle.to_tensor(ids),
                         paddle.to_tensor(np.roll(ids, -1, axis=1))))
-    dt, first_loss, final_loss = _time_steps(step, batches, warmup)
+    n_slice = sum(int(np.prod(p.shape)) for p in model.parameters())
+    meter = _make_meter("bench_gpt_tp_pp", tokens_per_step=batch * seq,
+                        model_params=n_slice)
+    dt, first_loss, final_loss = _time_steps(step, batches, warmup, meter)
     slice_tokens_per_sec = batch * seq * steps / dt
 
     # derates: exact schedule tables / silicon-measured engine kappa, the
@@ -742,14 +771,15 @@ def bench_gpt_tp_pp(on_accel: bool, peak: float):
     tp_eff = _virtual_mesh_subprocess("--tp-derate", tp, tp, batch, seq)
     import jax
 
-    ici_gbps = _chip_lookup(jax.devices()[0], _ICI_GBPS_ONEWAY)
+    from paddle_tpu.telemetry import ICI_GBPS_ONEWAY
+
+    ici_gbps = _chip_lookup(jax.devices()[0], ICI_GBPS_ONEWAY)
     t_step = dt / steps
     t_comm = tp_eff["wire_bytes_per_step"] / (ici_gbps * 1e9)
     tp_derate = t_step / (t_step + t_comm)
     tp_eff = dict(tp_eff, t_comm_s=round(t_comm, 5),
                   t_step_s=round(t_step, 5), ici_gbps_oneway=ici_gbps)
     tokens_per_sec = slice_tokens_per_sec * pipe_eff * tp_derate
-    n_slice = sum(int(np.prod(p.shape)) for p in model.parameters())
     # account MFU on the slice's own params and the same derated number
     # reported as the value, so tokens/sec, mfu and vs_baseline are
     # mutually consistent (CPU smoke skips the MFU math entirely)
@@ -782,7 +812,8 @@ def bench_gpt_tp_pp(on_accel: bool, peak: float):
                    "norm_target": "0.50 MFU is a full-width target: raw-jax "
                                   "at the TP2 SLICE shapes ceilings at "
                                   "0.469 vs 0.546 full (this chip); the "
-                                  "slice runs 0.505 — see docstring"},
+                                  "slice runs 0.505 — see docstring",
+                   **_meter_detail(meter)},
     }
 
 
@@ -823,7 +854,7 @@ def bench_llama_longctx(on_accel: bool, peak: float):
     for bq, bk in sweep:
         paddle.set_flags({"flash_block_q": bq, "flash_block_k": bk})
         try:
-            tps, first_loss, final_loss, n_params = _llama_measure(
+            tps, first_loss, final_loss, n_params, meter = _llama_measure(
                 cfg, batch, seq, steps, warmup)
         except Exception as e:  # one bad config must not kill the point
             failed.append({"blocks": [bq, bk], "error": repr(e)[:200]})
@@ -840,10 +871,13 @@ def bench_llama_longctx(on_accel: bool, peak: float):
 
             _jax.clear_caches()  # drop the previous config's executables
         if best is None or tps > best[0]:
-            best = (tps, first_loss, final_loss, n_params, (bq, bk))
+            # the meter rides along so _meter_detail reports the BEST
+            # config's live watermarks / collective bytes, not the
+            # last-executed sweep point (hbm_peak_gb stays process-wide)
+            best = (tps, first_loss, final_loss, n_params, (bq, bk), meter)
     if best is None:
         raise RuntimeError(f"every flash-block sweep config failed: {failed}")
-    tokens_per_sec, first_loss, final_loss, n_params, blocks = best
+    tokens_per_sec, first_loss, final_loss, n_params, blocks, meter = best
 
     attn_per_tok = 6 * cfg.num_hidden_layers * seq * cfg.hidden_size
     achieved = tokens_per_sec * (6 * n_params + attn_per_tok) / 1e12
@@ -865,7 +899,8 @@ def bench_llama_longctx(on_accel: bool, peak: float):
                    "mfu_6N_only": round(
                        tokens_per_sec * 6 * n_params / 1e12 / peak, 4),
                    "flops_note": "6N + 6*L*s*d per token (causal-executed "
-                                 "attention; flash skips masked blocks)"},
+                                 "attention; flash skips masked blocks)",
+                   **_meter_detail(meter)},
     }
 
 
@@ -910,7 +945,9 @@ def bench_ernie_ft(on_accel: bool, peak: float):
         ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype("int32")
         y = rng.integers(0, 2, (batch,)).astype("int64")
         batches.append((paddle.to_tensor(ids), paddle.to_tensor(y)))
-    dt, first_loss, final_loss = _time_steps(step, batches, warmup)
+    meter = _make_meter("bench_ernie", samples_per_step=batch,
+                        tokens_per_step=batch * seq, model_params=n_params)
+    dt, first_loss, final_loss = _time_steps(step, batches, warmup, meter)
 
     samples_per_sec = batch * steps / dt
     achieved = samples_per_sec * seq * 6 * n_params / 1e12
@@ -928,17 +965,13 @@ def bench_ernie_ft(on_accel: bool, peak: float):
                    "achieved_tflops": round(achieved, 2),
                    "norm_target": "0.50 MFU (raw-jax same-shape ceiling "
                                   "0.79 on this chip — silicon not the "
-                                  "limit; dropout RNG was: see docstring)"},
+                                  "limit; dropout RNG was: see docstring)",
+                   **_meter_detail(meter)},
     }
 
 
-# chip kind → peak HBM bandwidth GB/s (public specs) — decode is
-# bandwidth-bound, so its utilization metric is MBU, not MFU
-_PEAK_HBM_GBPS = {
-    "v5 lite": 819.0, "v5e": 819.0, "v5litepod": 819.0,
-    "v5p": 2765.0, "v4": 1228.0, "v6e": 1640.0, "v6": 1640.0,
-    "cpu": 50.0,
-}
+# decode is bandwidth-bound, so its utilization metric is MBU, not MFU —
+# peak HBM GB/s comes from telemetry's chip table
 
 
 def bench_llama_decode(on_accel: bool, peak: float, longctx: bool = False):
@@ -1005,8 +1038,10 @@ def bench_llama_decode(on_accel: bool, peak: float, longctx: bool = False):
     n_steps = new - 1
     tokens_per_sec = batch * n_steps / dt
     steps_per_sec = n_steps / dt
+    from paddle_tpu.telemetry import PEAK_HBM_GBPS
+
     dev = jax.devices()[0]
-    bw = _chip_lookup(dev, _PEAK_HBM_GBPS)
+    bw = _chip_lookup(dev, PEAK_HBM_GBPS)
     param_bytes = n_params * 2  # bf16
     n_layers = cfg.num_hidden_layers
     kv_heads = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
@@ -1042,7 +1077,7 @@ _COMPACT_KEYS = (
     "mfu", "mbu", "seq", "batch", "prompt", "final_loss", "layout",
     "pipeline_efficiency", "tp_derate", "flash_blocks", "steps_per_sec",
     "slice_tokens_per_sec", "virtual_stages", "micro_batches",
-    "cache_gb_read_per_step", "norm_target", "device",
+    "cache_gb_read_per_step", "norm_target", "device", "hbm_peak_gb",
 )
 
 

@@ -39,6 +39,7 @@ from . import metric  # noqa: E402
 from . import vision  # noqa: E402
 from . import incubate  # noqa: E402
 from . import profiler  # noqa: E402
+from . import telemetry  # noqa: E402
 from . import hapi  # noqa: E402
 from .hapi import Model  # noqa: E402
 from .hapi import callbacks  # noqa: E402

@@ -103,6 +103,12 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
         new = jax.make_array_from_callback(
             shape, v.sharding, make_local).astype(v.dtype)
         _set_value(leaf, new)
+    try:  # flight recorder: restarts show as load events after a dump gap
+        from ... import telemetry
+
+        telemetry.record_event("checkpoint_load", path, keys=len(flat))
+    except Exception:
+        pass
 
 
 def _set_value(leaf, new) -> None:

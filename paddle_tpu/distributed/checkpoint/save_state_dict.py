@@ -104,6 +104,13 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
         _pending.append(t)
     else:
         _write()
+    try:  # flight recorder: checkpoints bound what a restart can lose
+        from ... import telemetry
+
+        telemetry.record_event("checkpoint_save", path, rank=rank,
+                               keys=len(flat), async_save=bool(async_save))
+    except Exception:
+        pass
 
 
 def _global_shards(v: jax.Array):

@@ -158,15 +158,41 @@ def _collective_fn(kind: str, mesh: Mesh, axes, op: str, extra=None):
     else:
         raise ValueError(kind)
 
-    fn = jax.shard_map(body, mesh=mesh, in_specs=spec, out_specs=out_spec,
-                       check_vma=False)
+    from ..framework.jax_compat import shard_map
+
+    fn = shard_map(body, mesh, spec, out_spec, check_vma=False)
     return jax.jit(fn)
+
+
+def _telemetry_record(kind: str, tensor, g: CommGroup) -> None:
+    """Report one collective into the telemetry layer: payload bytes from
+    the aval (works for concrete arrays AND tracers), mesh axes, group
+    size. Inside someone else's jit (tensor value is a Tracer) the call
+    executes whenever the enclosing program runs — recorded once per trace
+    and tagged trace_time. Never allowed to break the collective itself."""
+    try:
+        from .. import telemetry
+
+        v = tensor._value if isinstance(tensor, Tensor) else tensor
+        trace_time = isinstance(v, jax.core.Tracer)
+        nbytes = int(np.prod(v.shape)) * jnp.dtype(v.dtype).itemsize
+        telemetry.record_collective(kind, nbytes=nbytes, axes=g.axes,
+                                    group_size=g.nranks,
+                                    trace_time=trace_time)
+    except Exception:
+        pass
 
 
 def _run(kind, tensor, group, op=ReduceOp.SUM, extra=None, differentiable=True):
     g = _resolve_group(group)
     fn = _collective_fn(kind, g.mesh, g.axes, op, extra)
-    return apply_op(kind, fn, (tensor,))
+    out = apply_op(kind, fn, (tensor,))
+    # record AFTER dispatch: a collective that raises must not count as an
+    # executed call (XLA dispatch is async, so a device-side hang still
+    # reaches this line; the host-side in-flight marker is the watchdog's
+    # watch_armed event)
+    _telemetry_record(kind, tensor, g)
+    return out
 
 
 def all_reduce(tensor: Tensor, op: str = ReduceOp.SUM, group: Optional[CommGroup] = None,

@@ -38,6 +38,7 @@ from ..jit import TrainStep, _StateSwap
 from ..nn.layer.layers import Layer
 from ..tensor.tensor import Tensor
 from .topology import HybridCommunicateGroup
+from ..framework.jax_compat import pcast as _pcast, shard_map as _shard_map
 
 __all__ = ["DistributedTrainStep", "ScannedLayers", "GPipeLayers", "gpipe_spmd_step"]
 
@@ -178,6 +179,36 @@ class DistributedTrainStep(TrainStep):
         # batch shardings resolved lazily (shape-dependent): placeholder None
         self._batch_shardings_holder = None
         self._log_sharding_report()
+        self._telemetry_program = self._register_telemetry()
+
+    def _register_telemetry(self):
+        """Register the analytic collective profile of the compiled step: the
+        grad psum XLA inserts for data parallelism (≡ fused-bucket allreduce)
+        — a reduce-scatter instead when optimizer states are sharded (stage
+        >= 1 scatters the update over "sharding"). These collectives exist
+        only inside the jit, so they are trace-time records with an
+        execution counter bumped per __call__."""
+        try:
+            from .. import telemetry
+
+            n_data = self.mesh.shape.get("data", 1)
+            n_shard = self.mesh.shape.get("sharding", 1)
+            n_red = n_data * n_shard
+            if n_red <= 1:
+                return None
+            grad_bytes = sum(
+                p._value.size * p._value.dtype.itemsize for p in self._params
+                if not getattr(p, "stop_gradient", False))
+            kind = "reduce_scatter" if (self.sharding_stage >= 1
+                                        and n_shard > 1) else "all_reduce"
+            axes = [a for a, n in (("data", n_data), ("sharding", n_shard))
+                    if n > 1]
+            return telemetry.register_traced_program(
+                f"DistributedTrainStep_stage{self.sharding_stage}",
+                [{"kind": kind, "nbytes": int(grad_bytes),
+                  "group_size": n_red, "count": 1, "axes": axes}])
+        except Exception:
+            return None
 
     def _log_sharding_report(self):
         """_add_axis silently leaves a param replicated when no dim divides
@@ -240,7 +271,10 @@ class DistributedTrainStep(TrainStep):
             else:
                 sh = self._batch_sharding(v)
             batch_arrays.append(jax.device_put(v, sh))
-        return super().__call__(*[Tensor(a) for a in batch_arrays])
+        out = super().__call__(*[Tensor(a) for a in batch_arrays])
+        if self._telemetry_program is not None:
+            self._telemetry_program.record_execution()
+        return out
 
 
 class ScannedLayers(Layer):
@@ -370,9 +404,9 @@ class GPipeLayers(ScannedLayers):
             xs = xv_.reshape((m, mb) + xv_.shape[1:])
             # initial carries become pipe-varying inside the loop:
             # declare them so (scan requires carry VMA types to be invariant)
-            state0 = jax.lax.pcast(jnp.zeros((mb,) + xv_.shape[1:], xv_.dtype),
+            state0 = _pcast(jnp.zeros((mb,) + xv_.shape[1:], xv_.dtype),
                                    (axis,), to="varying")
-            ys0 = jax.lax.pcast(jnp.zeros_like(xs), (axis,), to="varying")
+            ys0 = _pcast(jnp.zeros_like(xs), (axis,), to="varying")
             perm = [(s, (s + 1) % n_stages) for s in range(n_stages)]
 
             def tick(carry, i):
@@ -397,7 +431,7 @@ class GPipeLayers(ScannedLayers):
             # full-output masked psum this used to do (round-2 weak #4)
             return ys.reshape((1,) + xv_.shape)
 
-        pipeline = jax.shard_map(
+        pipeline = _shard_map(
             sharded_body, mesh=mesh, axis_names={axis},
             in_specs=tuple([P()] + [P(axis)] * len(stacked)),
             out_specs=P(axis), check_vma=True)

@@ -158,6 +158,19 @@ class ElasticManager:
     # -- watch loop --------------------------------------------------------
     def watch_once(self) -> str:
         """One membership check → ElasticStatus (reference watch loop body)."""
+        status = self._watch_once()
+        if status != ElasticStatus.HOLD:
+            try:  # flight recorder: elastic transitions bracket restarts
+                from .... import telemetry
+
+                telemetry.record_event("elastic", status,
+                                       host=self.host_id,
+                                       live=len(self.hosts()))
+            except Exception:
+                pass
+        return status
+
+    def _watch_once(self) -> str:
         if self.store.get(f"{self.job_id}/completed"):
             return ElasticStatus.COMPLETED
         world = self.store.get(self._world_key) or []

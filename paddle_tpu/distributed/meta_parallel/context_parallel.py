@@ -36,6 +36,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...tensor.tensor import Tensor, apply_op
 from ..topology import get_hybrid_communicate_group
+from ...framework.jax_compat import pcast as _pcast, shard_map as _shard_map
 
 __all__ = ["ring_attention", "ulysses_attention"]
 
@@ -168,11 +169,11 @@ def ring_attention(q, k, v, mesh: Optional[Mesh] = None, sep_axis: str = "sep",
         qf = qc.astype(jnp.float32) * sc
 
         # accumulator carries become sep-varying inside the scan: declare so
-        acc0 = jax.lax.pcast(jnp.zeros(qc.shape, jnp.float32), (sep_axis,),
+        acc0 = _pcast(jnp.zeros(qc.shape, jnp.float32), (sep_axis,),
                              to="varying")
-        m0 = jax.lax.pcast(jnp.full((b, h, c), -jnp.inf, jnp.float32),
+        m0 = _pcast(jnp.full((b, h, c), -jnp.inf, jnp.float32),
                            (sep_axis,), to="varying")
-        l0 = jax.lax.pcast(jnp.zeros((b, h, c), jnp.float32), (sep_axis,),
+        l0 = _pcast(jnp.zeros((b, h, c), jnp.float32), (sep_axis,),
                            to="varying")
         # positions within a chunk (for the diagonal block's causal tril)
         qpos = jnp.arange(c)
@@ -226,7 +227,7 @@ def ring_attention(q, k, v, mesh: Optional[Mesh] = None, sep_axis: str = "sep",
                         (q, k, v))
 
     spec = P(None, sep_axis, None, None)
-    ring = jax.shard_map(block_body, mesh=mesh, axis_names={sep_axis},
+    ring = _shard_map(block_body, mesh=mesh, axis_names={sep_axis},
                          in_specs=(spec, spec, spec), out_specs=spec,
                          check_vma=True)
     return apply_op("ring_attention", ring, (q, k, v))

@@ -57,6 +57,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..nn.layer.layers import Layer
 from ..tensor.tensor import Tensor
 from .engine import GPipeLayers
+from ..framework.jax_compat import pcast as _pcast, shard_map as _shard_map
 
 __all__ = ["make_1f1b_schedule", "schedule_efficiency", "OneFOneBLayers"]
 
@@ -336,6 +337,33 @@ class OneFOneBLayers(GPipeLayers):
         self._stash_budget = stash_budget_bytes
         self.stash_by_key: Dict = {}  # per compiled shape: True = stash mode
         self._cache = {}
+        self._telemetry_programs: Dict = {}  # per compiled shape
+
+    def _register_telemetry(self, key, xv):
+        """Analytic collective profile of one compiled 1F1B step: every tick
+        issues a forward AND a backward activation ring hop (ppermute) plus
+        the final scalar loss psum — collectives that exist only inside the
+        jit, so they are trace-time records whose execution counter is
+        bumped per loss_and_grads call."""
+        p = self._mesh.shape[self._pipe_axis]
+        if p <= 1:
+            return
+        try:
+            from .. import telemetry
+
+            T = self._sched()["T"]
+            mb = xv.shape[0] // self.num_microbatches
+            act_bytes = (int(np.prod((mb,) + tuple(xv.shape[1:])))
+                         * jnp.dtype(xv.dtype).itemsize)
+            self._telemetry_programs[key] = telemetry.register_traced_program(
+                f"OneFOneB_p{p}m{self.num_microbatches}v{self._v}_"
+                f"{'x'.join(map(str, xv.shape))}",
+                [{"kind": "ppermute", "nbytes": act_bytes, "group_size": p,
+                  "count": 2 * T, "axes": [self._pipe_axis]},
+                 {"kind": "psum", "nbytes": 4, "group_size": p, "count": 1,
+                  "axes": [self._pipe_axis]}])
+        except Exception:
+            pass
 
     def _budget_bytes(self) -> int:
         if self._stash_budget is not None:
@@ -413,7 +441,7 @@ class OneFOneBLayers(GPipeLayers):
 
         def inner(h, *stks):
             try:
-                h = jax.lax.pcast(h, (axis,), to="varying")
+                h = _pcast(h, (axis,), to="varying")
             except ValueError:
                 pass
             chunk = [s[:ell] for s in stks]
@@ -425,7 +453,7 @@ class OneFOneBLayers(GPipeLayers):
                              for l in leaves]
             return jnp.zeros((1,), jnp.float32)
 
-        sm = jax.shard_map(inner, mesh=self._mesh, axis_names={axis},
+        sm = _shard_map(inner, mesh=self._mesh, axis_names={axis},
                            in_specs=(P(),) + (P(axis),) * len(stack_sds),
                            out_specs=P())
         jax.eval_shape(sm, h_sd, *stack_sds)
@@ -498,7 +526,7 @@ class OneFOneBLayers(GPipeLayers):
             adt = xv.dtype
             def vary(a):
                 try:  # no-op when the value is already pipe-varying
-                    return jax.lax.pcast(a, (axis,), to="varying")
+                    return _pcast(a, (axis,), to="varying")
                 except ValueError:
                     return a
 
@@ -723,7 +751,7 @@ class OneFOneBLayers(GPipeLayers):
             return (loss,) + gacc
 
         n_stacks = len(self._stack_names)
-        smapped = jax.shard_map(
+        smapped = _shard_map(
             sharded_step, mesh=mesh, axis_names={axis},
             in_specs=(P(), P()) + (P(),) * n_tab + (P(axis),) * n_stacks,
             out_specs=(P(),) + (P(axis),) * n_stacks, check_vma=True)
@@ -748,9 +776,13 @@ class OneFOneBLayers(GPipeLayers):
             stash, probe = self._decide_stash(xv)
             self.stash_by_key[key] = stash
             self._cache[key] = self._build(stash, probe)
+            self._register_telemetry(key, xv)
         stacks = [self._parameters[n.replace(".", "__")]._value
                   for n in self._stack_names]
         out = self._cache[key](xv, yv, *stacks)
+        prog = self._telemetry_programs.get(key)
+        if prog is not None:
+            prog.record_execution()
         return Tensor(out[0]), list(out[1:])
 
     def train_batch(self, data, optimizer, lr_scheduler=None) -> Tensor:

@@ -27,6 +27,14 @@ def group_sharded_parallel(model: Layer, optimizer, level: str, scaler=None,
         raise ValueError(f"level must be one of {sorted(_LEVELS)}, got {level!r}")
     optimizer._sharding_stage = _LEVELS[level]
     model._sharding_stage = _LEVELS[level]
+    try:  # telemetry: the stage decides which grad collective the engine
+        # registers (all_reduce vs reduce_scatter) — record the transition
+        from .. import telemetry
+
+        telemetry.record_event("sharding", f"group_sharded_{level}",
+                               stage=_LEVELS[level], offload=bool(offload))
+    except Exception:
+        pass
     # offload (reference `group_sharded_stage3.py:85`): optimizer-state /
     # master-weight slices live in host memory — consumed by
     # DistributedTrainStep as pinned_host memory-kind shardings (TPU; other

@@ -89,6 +89,13 @@ class CommWatchdog:
         wid = id(w)
         with self._lock:
             self._watches[wid] = w
+        try:  # flight recorder: a hang dump must show what was in flight
+            from .. import telemetry
+
+            telemetry.record_event("watch_armed", name,
+                                   timeout_s=w.deadline - w.started)
+        except Exception:
+            pass
         return wid
 
     def _disarm(self, wid: int) -> None:
@@ -110,10 +117,28 @@ class CommWatchdog:
                 self.timeout_count += 1
                 info = {"name": w.name, "elapsed": now - w.started,
                         "stacks": self._all_stacks()}
+                info["flight_recorder_dump"] = self._dump_flight_recorder(
+                    w, now)
                 try:
                     self.on_timeout(info)
                 except Exception:
                     traceback.print_exc()
+
+    @staticmethod
+    def _dump_flight_recorder(w: _Watch, now: float) -> str:
+        """Crash-dump path (reference comm_task_manager dumps comm-task
+        state before abort): record the timeout as the ring's final event —
+        so the dump's tail identifies the hung wait — then write the dump.
+        Returns the file path ('' when telemetry is unavailable/disabled)."""
+        try:
+            from .. import telemetry
+
+            telemetry.bump("watchdog_timeouts_total")
+            telemetry.record_event("watchdog_timeout", w.name,
+                                   elapsed_s=now - w.started)
+            return telemetry.dump_flight_recorder(reason="watchdog_hang")
+        except Exception:
+            return ""
 
     @staticmethod
     def _all_stacks() -> str:

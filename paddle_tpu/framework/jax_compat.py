@@ -1,0 +1,48 @@
+"""Version compatibility for jax APIs this codebase targets.
+
+The code is written against jax >= 0.5 (`jax.shard_map` with
+``check_vma``/``axis_names``, `jax.lax.pcast` VMA casts). On older jax
+(0.4.x) the same machinery lives in ``jax.experimental.shard_map`` with a
+different surface:
+
+- ``check_vma`` was named ``check_rep`` (we always pass False: the bodies
+  here use collectives the checker cannot type);
+- partial-manual ``axis_names={...}`` is expressed inversely via
+  ``auto=<the other axes>``;
+- ``pcast`` does not exist — pre-VMA tracing has no varying/manual
+  distinction, so the cast is the identity.
+
+Every shard_map/pcast call site in the package routes through here so one
+probe decides the dialect.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Set
+
+import jax
+
+__all__ = ["shard_map", "pcast"]
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False,
+              axis_names: Optional[Set[str]] = None):
+    if hasattr(jax, "shard_map"):
+        kw = {"check_vma": check_vma}
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    auto = frozenset()
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False, auto=auto)
+
+
+def pcast(x, axes, to: str = "varying"):
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axes, to=to)
+    return x  # pre-VMA jax: nothing to cast

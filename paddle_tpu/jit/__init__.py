@@ -468,6 +468,17 @@ class TrainStep:
             b._value = arr
             b._producer = None
         self.optimizer._step_count += 1
+        try:  # telemetry: step event for the flight recorder + prometheus.
+            # No host sync here — loss stays a device value.
+            from .. import telemetry
+
+            if telemetry.enabled():
+                telemetry.bump("train_step_calls_total")
+                telemetry.record_event(
+                    "step", type(self).__name__,
+                    step=self.optimizer._step_count)
+        except Exception:
+            pass
         return Tensor(loss)
 
 

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from .flash_attention import _LANES, _bwd, _from_internal, _fwd, _to_internal
+from ...framework.jax_compat import pcast as _pcast
 
 
 def _pvary(x, axes: Tuple[str, ...]):
@@ -40,7 +41,7 @@ def _pvary(x, axes: Tuple[str, ...]):
     declare their VMA type up front; fresh constants start unvaried)."""
     if not axes:
         return x
-    return jax.lax.pcast(x, tuple(axes), to="varying")
+    return _pcast(x, tuple(axes), to="varying")
 
 
 def _merge(o, lse, o_i, lse_i):

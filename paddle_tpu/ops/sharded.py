@@ -28,6 +28,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+from ..framework.jax_compat import shard_map as _shard_map
 
 __all__ = ["active_mesh", "mesh_flash_supported", "mesh_flash_attention",
            "mesh_ulysses_flash_supported", "mesh_ulysses_flash",
@@ -157,7 +158,7 @@ def mesh_flash_attention(q, k, v, mesh: Mesh, *, causal: bool = False,
             return flash_attention(ql, kl, vl, scale, causal, bq, bk,
                                    interpret)
 
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+    fn = _shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     return fn(q, k, v)
 
@@ -230,7 +231,7 @@ def mesh_ulysses_flash(q, k, v, mesh: Mesh, *, causal: bool = False,
     def body(ql, kl, vl):
         return flash_attention(ql, kl, vl, scale, causal, bq, bk, interpret)
 
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+    fn = _shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     return fn(q, k, v)
 
@@ -263,7 +264,7 @@ def mesh_rms_norm(x, weight, mesh: Mesh, eps: float, interpret: bool = False):
     from .pallas import fused_rms_norm
 
     spec = _rows_spec(mesh, x.ndim)
-    fn = jax.shard_map(
+    fn = _shard_map(
         lambda xl, wl: fused_rms_norm(xl, wl, eps, interpret=interpret),
         mesh=mesh, in_specs=(spec, P(None)), out_specs=spec, check_vma=False)
     return fn(x, weight)
@@ -289,7 +290,7 @@ def mesh_rope(q, k, cos_s, sin_s, mesh: Mesh, interpret: bool = False):
     spec = _attn_spec(mesh)
     sep = "sep" if _size(mesh, "sep") > 1 else None
     tspec = P(sep, None)
-    fn = jax.shard_map(
+    fn = _shard_map(
         lambda ql, kl, cl, sl: fused_rope(ql, kl, cl, sl, interpret=interpret),
         mesh=mesh, in_specs=(spec, spec, tspec, tspec),
         out_specs=(spec, spec), check_vma=False)

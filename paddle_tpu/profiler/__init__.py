@@ -50,16 +50,26 @@ class ProfilerState(Enum):
 
 
 class ProfilerTarget(Enum):
-    """Profiled hardware. TPU replaces the reference's GPU/CUPTI target."""
+    """Profiled hardware. TPU replaces the reference's GPU/CUPTI target.
+
+    ``ProfilerTarget.GPU`` is an ALIAS of ``ProfilerTarget.TPU`` (same enum
+    value, ``GPU is TPU``): scripts written against the reference's
+    ``targets=[ProfilerTarget.GPU]`` select the device (XLA/xplane) trace
+    here, exactly as ``TPU`` does — there is no separate CUDA path."""
 
     CPU = 0
     TPU = 1
-    GPU = 1  # alias: scripts written against the reference keep working
+    GPU = 1  # alias of TPU (see class docstring)
     CUSTOM_DEVICE = 2
 
 
 class SortedKeys(Enum):
-    """Summary-table sort orders (reference `profiler.py:259`)."""
+    """Summary-table sort orders (reference `profiler.py:259`).
+
+    ``TPUTotal``/``TPUAvg``/``TPUMax``/``TPUMin`` are this port's native
+    names; the reference's ``GPU*`` spellings are kept as aliases (same
+    values) so reference-written scripts keep working. Both sort the host
+    timeline — device-side timing lives in the xplane trace."""
 
     CPUTotal = 0
     CPUAvg = 1
@@ -69,6 +79,10 @@ class SortedKeys(Enum):
     GPUAvg = 5
     GPUMax = 6
     GPUMin = 7
+    TPUTotal = 4  # alias of GPUTotal
+    TPUAvg = 5    # alias of GPUAvg
+    TPUMax = 6    # alias of GPUMax
+    TPUMin = 7    # alias of GPUMin
 
 
 def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
@@ -255,6 +269,9 @@ class Profiler:
         self._device_trace_dir: Optional[str] = None
         self._device_tracing = False
         self._step_start_ns: Optional[int] = None
+        self._session_start_ns: Optional[int] = None
+        self._window_start_ns: Optional[int] = None
+        self._emitted_window_start_ns: Optional[int] = None
         self._bench = benchmark()
 
     # -- lifecycle ---------------------------------------------------------
@@ -262,6 +279,7 @@ class Profiler:
     def start(self) -> None:
         global _active_profiler
         _active_profiler = self
+        self._session_start_ns = time.perf_counter_ns()
         self._bench.begin()
         self.current_state = self._scheduler(self.step_num)
         self._apply_state(self.current_state)
@@ -271,9 +289,12 @@ class Profiler:
         global _active_profiler
         self._close_step_span()
         if self._recording:
+            # final window: clear the flag FIRST so _emit_window does not
+            # re-arm a fresh buffer (which would also advance the telemetry
+            # window cutoff past the events being exported)
+            self._recording = False
             self._emit_window()
         self._stop_device_trace()
-        self._recording = False
         self.current_state = ProfilerState.CLOSED
         if _active_profiler is self:
             _active_profiler = None
@@ -312,6 +333,7 @@ class Profiler:
         want_record = state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
         if want_record and not self._recording:
             self._timeline = _Timeline()
+            self._window_start_ns = time.perf_counter_ns()
             self._recording = True
             self._start_device_trace()
         elif not want_record and self._recording:
@@ -348,11 +370,15 @@ class Profiler:
 
     def _emit_window(self) -> None:
         self._windows.append(self._timeline.events())
+        # export() may run long after this window rotates: remember ITS
+        # start so the telemetry merge matches _last_window()'s host events
+        self._emitted_window_start_ns = self._window_start_ns
         self._stop_device_trace()
         if self._on_trace_ready is not None:
             self._on_trace_ready(self)
         if self._recording:  # next window gets a fresh buffer
             self._timeline = _Timeline()
+            self._window_start_ns = time.perf_counter_ns()
             self._start_device_trace()
 
     # -- results -----------------------------------------------------------
@@ -363,7 +389,10 @@ class Profiler:
         return self._timeline.events()
 
     def export(self, path: str, format: str = "json") -> None:
-        """Write the most recent window as chrome-trace JSON."""
+        """Write the most recent window as chrome-trace JSON, with telemetry
+        flight-recorder events (collectives, steps, checkpoints, watchdog
+        arms) recorded since :meth:`start` merged onto the timeline under
+        the ``telemetry`` category."""
         if format not in ("json", "chrome"):
             raise ValueError("paddle_tpu profiler exports chrome-trace json "
                              "(device traces go to TensorBoard via xplane dir)")
@@ -375,8 +404,47 @@ class Profiler:
                 "ts": ev.start_ns / 1e3, "dur": (ev.end_ns - ev.start_ns) / 1e3,
                 "cat": ev.event_type, "args": ev.args,
             })
+        trace["traceEvents"].extend(self._telemetry_events(pid))
         with open(path, "w") as f:
             json.dump(trace, f)
+
+    def _telemetry_events(self, pid: int) -> List[dict]:
+        """Flight-recorder events since the exported window began (falling
+        back to session start) as chrome-trace entries: collectives with an
+        ICI estimate become duration ('X') slices on a dedicated track,
+        everything else instant ('i') marks — all under cat 'telemetry' so
+        merged events are distinguishable. The window cutoff keeps repeat-
+        scheduler exports from re-shipping earlier windows' events."""
+        try:
+            from .. import telemetry
+
+            # cutoff must match _last_window(): the last EMITTED window's
+            # start when windows exist, else the live window's
+            start = self._emitted_window_start_ns if self._windows \
+                else self._window_start_ns
+            events = telemetry.get_flight_recorder().events(
+                since_mono_ns=start or self._session_start_ns or 0)
+        except Exception:
+            return []
+        out = []
+        for ev in events:
+            mono = ev.get("mono_ns")
+            if mono is None:
+                continue
+            args = {k: v for k, v in ev.items()
+                    if k not in ("kind", "name", "mono_ns", "ts")}
+            entry = {"name": f"{ev['kind']}:{ev['name']}", "pid": pid,
+                     "tid": "telemetry", "ts": mono / 1e3,
+                     "cat": "telemetry", "args": args}
+            est = ev.get("ici_est_s")
+            if ev["kind"] == "collective" and est:
+                entry["ph"] = "X"
+                entry["dur"] = max(est * 1e6, 0.001)  # µs
+            else:
+                entry["ph"] = "i"
+                entry["s"] = "t"
+            out.append(entry)
+        return out
 
     def summary(self, sorted_by: SortedKeys = SortedKeys.CPUTotal,
                 op_detail: bool = True, thread_sep: bool = False,
@@ -390,8 +458,10 @@ class Profiler:
         rows = [(name, len(ds), sum(ds), sum(ds) / len(ds), max(ds), min(ds))
                 for name, ds in agg.items()]
         key = {SortedKeys.CPUTotal: 2, SortedKeys.CPUAvg: 3, SortedKeys.CPUMax: 4,
-               SortedKeys.CPUMin: 5}.get(sorted_by, 2)
-        rows.sort(key=lambda r: r[key], reverse=sorted_by != SortedKeys.CPUMin)
+               SortedKeys.CPUMin: 5, SortedKeys.TPUTotal: 2, SortedKeys.TPUAvg: 3,
+               SortedKeys.TPUMax: 4, SortedKeys.TPUMin: 5}.get(sorted_by, 2)
+        rows.sort(key=lambda r: r[key],
+                  reverse=sorted_by not in (SortedKeys.CPUMin, SortedKeys.TPUMin))
         w = max([len(r[0]) for r in rows] + [10])
         lines = [f"{'Name':<{w}}  {'Calls':>6} {'Total(' + time_unit + ')':>12} "
                  f"{'Avg':>10} {'Max':>10} {'Min':>10}"]
@@ -399,6 +469,17 @@ class Profiler:
         for name, n, tot, avg, mx, mn in rows:
             lines.append(f"{name:<{w}}  {n:>6} {tot:>12.3f} {avg:>10.3f} "
                          f"{mx:>10.3f} {mn:>10.3f}")
+        try:  # HBM watermarks (PJRT memory stats; absent on CPU backends)
+            from .. import telemetry
+
+            wm = telemetry.hbm_watermarks()
+            if wm["devices"]:
+                lines.append(f"HBM ({wm['devices']} device(s)): live "
+                             f"{wm['live_gb']:.3f} GB, peak "
+                             f"{wm['peak_gb']:.3f} GB, limit "
+                             f"{wm['limit_gb']:.3f} GB")
+        except Exception:
+            pass
         table = "\n".join(lines)
         print(table)
         return table
@@ -447,9 +528,20 @@ class benchmark:
         self._step_start = now
 
     def step_info(self, unit: str = "samples") -> str:
-        ips = (self.total_samples / self.total_time) if self.total_time > 0 and \
-            self.total_samples else (self.steps / self.total_time if self.total_time else 0.0)
-        u = unit if self.total_samples else "steps"
-        self._last_info = (f"reader_cost: {self.reader_cost:.5f} s, "
+        """Readout for the last step. ``reader_cost`` is the PER-STEP
+        AVERAGE of accumulated reader time (the reference timer's
+        semantics), not the raw cumulative sum. Every rate guards a zero
+        denominator (a zero-duration first step — e.g. step() straight
+        after begin(), or a sub-tick clock — reads 0.0 instead of
+        raising)."""
+        avg_reader = self.reader_cost / self.steps if self.steps > 0 \
+            else self.reader_cost
+        if self.total_samples and self.total_time > 0:
+            ips, u = self.total_samples / self.total_time, unit
+        elif self.total_time > 0:
+            ips, u = self.steps / self.total_time, "steps"
+        else:
+            ips, u = 0.0, unit if self.total_samples else "steps"
+        self._last_info = (f"reader_cost: {avg_reader:.5f} s, "
                            f"batch_cost: {self.batch_cost:.5f} s, ips: {ips:.3f} {u}/s")
         return self._last_info

@@ -1,0 +1,61 @@
+"""Per-device HBM watermarks via PJRT ``memory_stats()``.
+
+XLA owns the TPU allocator, so live/peak/limit come straight from the
+runtime (bytes_in_use / peak_bytes_in_use / bytes_limit). The CPU backend
+exposes no counters — every function here degrades to empty/zero rather
+than raising, so the same telemetry code runs in CPU smoke tests.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+__all__ = ["hbm_stats", "hbm_watermarks", "hbm_peak_gb"]
+
+
+def hbm_stats() -> List[dict]:
+    """One dict per local device: {device, platform, kind, bytes_in_use,
+    peak_bytes_in_use, bytes_limit}. Empty list when no backend exposes
+    counters (CPU) or jax is unavailable."""
+    try:
+        import jax
+        devices = jax.local_devices()
+    except Exception:
+        return []
+    out = []
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        out.append({
+            "device": int(getattr(d, "id", len(out))),
+            "platform": getattr(d, "platform", "?"),
+            "kind": getattr(d, "device_kind", "?"),
+            "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+            "peak_bytes_in_use": int(stats.get("peak_bytes_in_use",
+                                               stats.get("bytes_in_use", 0))),
+            "bytes_limit": int(stats.get("bytes_limit", 0)),
+        })
+    return out
+
+
+def hbm_watermarks() -> dict:
+    """Worst-device watermarks in GB: {live_gb, peak_gb, limit_gb,
+    devices}. All zeros with devices=0 on counter-less backends — the
+    graceful CPU no-op the step record relies on."""
+    stats = hbm_stats()
+    if not stats:
+        return {"live_gb": 0.0, "peak_gb": 0.0, "limit_gb": 0.0, "devices": 0}
+    return {
+        "live_gb": round(max(s["bytes_in_use"] for s in stats) / 1e9, 4),
+        "peak_gb": round(max(s["peak_bytes_in_use"] for s in stats) / 1e9, 4),
+        "limit_gb": round(max(s["bytes_limit"] for s in stats) / 1e9, 4),
+        "devices": len(stats),
+    }
+
+
+def hbm_peak_gb() -> float:
+    return hbm_watermarks()["peak_gb"]

@@ -1,0 +1,98 @@
+"""Prometheus text-format export of the telemetry state.
+
+``prometheus_text()`` renders the exposition format (text/plain version
+0.0.4) from the process-wide counters, the collective aggregates, and the
+current HBM watermarks — scrape-ready for a node exporter sidecar, or just
+diff-able in logs. No HTTP server here: serving one line of text is the
+deployment's job; producing it is ours.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from . import runtime
+from .collectives import collective_stats
+from .memory import hbm_stats
+from .recorder import get_flight_recorder
+
+__all__ = ["prometheus_text"]
+
+_PREFIX = "paddle_tpu"
+
+
+def _esc(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"')
+
+
+def _metric(lines: List[str], name: str, mtype: str, help_: str,
+            samples: List[tuple]) -> None:
+    """samples: [(labels_dict_or_None, value), ...]"""
+    full = f"{_PREFIX}_{name}"
+    lines.append(f"# HELP {full} {help_}")
+    lines.append(f"# TYPE {full} {mtype}")
+    for labels, value in samples:
+        if labels:
+            lab = ",".join(f'{k}="{_esc(str(v))}"' for k, v in labels.items())
+            lines.append(f"{full}{{{lab}}} {value}")
+        else:
+            lines.append(f"{full} {value}")
+
+
+def prometheus_text() -> str:
+    lines: List[str] = []
+    ctr = runtime.counters()
+
+    _metric(lines, "steps_total", "counter", "Training steps metered",
+            [(None, int(ctr.get("steps_total", 0)))])
+    _metric(lines, "tokens_total", "counter", "Tokens processed",
+            [(None, int(ctr.get("tokens_total", 0)))])
+    _metric(lines, "samples_total", "counter", "Samples processed",
+            [(None, int(ctr.get("samples_total", 0)))])
+    _metric(lines, "train_step_calls_total", "counter",
+            "Compiled TrainStep invocations",
+            [(None, int(ctr.get("train_step_calls_total", 0)))])
+    for gauge, help_ in (("tokens_per_second_last", "Last step tokens/s"),
+                         ("mfu_last", "Last step achieved MFU"),
+                         ("mbu_last", "Last step achieved MBU"),
+                         ("step_duration_seconds_last", "Last step duration")):
+        if gauge in ctr:
+            _metric(lines, gauge, "gauge", help_, [(None, ctr[gauge])])
+
+    coll = collective_stats()
+    kinds = sorted(coll)
+    _metric(lines, "collective_calls_total", "counter",
+            "Executed collectives by kind",
+            [({"kind": k}, coll[k]["calls"]) for k in kinds] or [(None, 0)])
+    _metric(lines, "collective_bytes_total", "counter",
+            "Payload bytes of executed collectives by kind",
+            [({"kind": k}, coll[k]["bytes"]) for k in kinds] or [(None, 0)])
+    _metric(lines, "collective_wire_seconds_total", "counter",
+            "Analytic ICI wire seconds by kind",
+            [({"kind": k}, round(coll[k]["ici_est_s"], 9)) for k in kinds]
+            or [(None, 0.0)])
+    _metric(lines, "collective_trace_records_total", "counter",
+            "Trace-time collective records by kind",
+            [({"kind": k}, coll[k]["trace_records"]) for k in kinds]
+            or [(None, 0)])
+
+    # HBM watermarks: per device when the backend has counters, else a
+    # single zero sample so the metric names are stable across backends
+    mem = hbm_stats()
+    _metric(lines, "hbm_bytes_in_use", "gauge", "Live HBM bytes per device",
+            [({"device": s["device"]}, s["bytes_in_use"]) for s in mem]
+            or [(None, 0)])
+    _metric(lines, "hbm_peak_bytes", "gauge", "Peak HBM bytes per device",
+            [({"device": s["device"]}, s["peak_bytes_in_use"]) for s in mem]
+            or [(None, 0)])
+    _metric(lines, "hbm_bytes_limit", "gauge", "HBM capacity per device",
+            [({"device": s["device"]}, s["bytes_limit"]) for s in mem]
+            or [(None, 0)])
+
+    _metric(lines, "flight_recorder_events", "gauge",
+            "Events currently in the flight-recorder ring",
+            [(None, len(get_flight_recorder()))])
+    _metric(lines, "watchdog_timeouts_total", "counter",
+            "Comm-watchdog timeouts fired",
+            [(None, int(ctr.get("watchdog_timeouts_total", 0)))])
+    return "\n".join(lines) + "\n"

@@ -8,11 +8,17 @@ Note: this image boots with an `axon` TPU plugin that pins JAX_PLATFORMS=axon
 from sitecustomize, so we must override via jax.config, not just the env."""
 
 import os
+import tempfile
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# telemetry crash dumps (watchdog-timeout tests fire them) go to a temp dir,
+# not the repo checkout
+if "PADDLE_TPU_FLIGHT_RECORDER_DIR" not in os.environ:
+    os.environ["PADDLE_TPU_FLIGHT_RECORDER_DIR"] = \
+        tempfile.mkdtemp(prefix="paddle_tpu_flightrec_")
 
 import jax  # noqa: E402
 

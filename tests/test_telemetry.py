@@ -1,0 +1,350 @@
+"""Telemetry subsystem tests (collective tracing, StepMeter, prometheus,
+HBM watermarks, flight recorder, watchdog crash dump, profiler merge)."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn.functional as F
+import paddle_tpu.profiler as profiler
+from paddle_tpu import telemetry
+from paddle_tpu.distributed import CommWatchdog
+
+
+@pytest.fixture(autouse=True)
+def fresh_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+def _stacked(rows, cols=4):
+    return dist.scatter_stack(
+        paddle.to_tensor(np.ones((rows, cols), np.float32)))
+
+
+class TestCollectiveTracing:
+    def test_eager_collectives_recorded_with_cost(self):
+        x = _stacked(8)
+        dist.all_reduce(x)
+        dist.reduce_scatter(_stacked(64))
+        stats = telemetry.collective_stats()
+        assert stats["all_reduce"]["calls"] == 1
+        assert stats["all_reduce"]["bytes"] == 8 * 4 * 4
+        # ring cost: 2(n-1)/n of the payload crossed the wire
+        n = len(__import__("jax").devices())
+        assert stats["all_reduce"]["wire_bytes"] == \
+            pytest.approx(2 * (n - 1) / n * 8 * 4 * 4)
+        assert stats["all_reduce"]["ici_est_s"] > 0
+        assert stats["reduce_scatter"]["calls"] == 1
+        evs = [e for e in telemetry.get_flight_recorder().events()
+               if e["kind"] == "collective"]
+        names = [e["name"] for e in evs]
+        assert "all_reduce" in names and "reduce_scatter" in names
+        ar = next(e for e in evs if e["name"] == "all_reduce")
+        assert ar["trace_time"] is False
+        assert ar["axes"] and ar["group_size"] >= 1
+
+    def test_trace_time_record_once_per_trace(self):
+        import jax
+
+        x = _stacked(8)
+
+        def f(xv):
+            t = paddle.Tensor(xv)
+            dist.all_reduce(t)
+            return t._value
+
+        jf = jax.jit(f)
+        jf(x._value)
+        jf(x._value)  # second execution: cached program, no new trace
+        stats = telemetry.collective_stats()["all_reduce"]
+        assert stats["trace_records"] == 1
+        assert stats["calls"] == 0  # trace-time records are not executions
+        ev = next(e for e in telemetry.get_flight_recorder().events()
+                  if e["kind"] == "collective")
+        assert ev["trace_time"] is True
+
+    def test_ici_cost_model_ring_factors(self):
+        c = telemetry.ici_cost_estimate("all_reduce", 1024, 4, ici_gbps=1.0)
+        assert c["wire_bytes"] == pytest.approx(2 * 3 / 4 * 1024)
+        assert c["est_s"] == pytest.approx(c["wire_bytes"] / 1e9)
+        assert telemetry.ring_wire_bytes("ppermute", 100, 8) == 100
+        assert telemetry.ring_wire_bytes("all_gather", 800, 8) == \
+            pytest.approx(700)
+
+    def test_traced_program_execution_counter(self):
+        prog = telemetry.register_traced_program(
+            "pipe_step", [{"kind": "ppermute", "nbytes": 10,
+                           "group_size": 4, "count": 3}])
+        assert telemetry.collective_stats()["ppermute"]["trace_records"] == 1
+        prog.record_execution()
+        prog.record_execution()
+        s = telemetry.collective_stats()["ppermute"]
+        assert prog.executions == 2
+        assert s["calls"] == 6            # 3 collectives/step x 2 steps
+        assert s["bytes"] == 60
+        ev = [e for e in telemetry.get_flight_recorder().events()
+              if e["kind"] == "collective_program"]
+        assert ev and ev[-1]["executions"] == 2
+
+    def test_disabled_records_nothing(self):
+        telemetry.disable()
+        try:
+            dist.all_reduce(_stacked(8))
+            assert telemetry.collective_stats() == {}
+            assert len(telemetry.get_flight_recorder()) == 0
+        finally:
+            telemetry.enable()
+
+
+class TestStepMeter:
+    def _train_setup(self):
+        paddle.seed(0)
+        model = paddle.nn.Linear(8, 8)
+        opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+        step = paddle.jit.TrainStep(
+            model, lambda m, x, y: F.mse_loss(m(x), y), opt)
+        return model, step
+
+    def test_smoke_training_loop_jsonl_prometheus_flightrec(self, tmp_path):
+        """ISSUE acceptance: a CPU smoke loop under telemetry produces a
+        JSONL step log (tokens/s + MFU), a prometheus export (step count,
+        collective bytes by kind, HBM peak), and a flight-recorder dump
+        containing the all_reduce/reduce_scatter collectives."""
+        model, step = self._train_setup()
+        n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+        jsonl = tmp_path / "steps.jsonl"
+        meter = telemetry.StepMeter("smoke", tokens_per_step=64,
+                                    model_params=n_params,
+                                    jsonl_path=str(jsonl))
+        rng = np.random.default_rng(0)
+        for _ in range(3):
+            x = paddle.to_tensor(rng.standard_normal((8, 8)).astype("float32"))
+            y = paddle.to_tensor(rng.standard_normal((8, 8)).astype("float32"))
+            loss = step(x, y)
+            dist.all_reduce(_stacked(8))
+            dist.reduce_scatter(_stacked(64))
+            meter.step(loss=float(loss.numpy()), grad_norm=1.0)
+
+        # JSONL step log
+        recs = [json.loads(l) for l in open(jsonl)]
+        assert len(recs) == 3
+        for r in recs:
+            assert r["dt_s"] > 0
+            assert r["tokens_per_s"] > 0
+            assert "mfu" in r and r["mfu"] > 0
+            assert "hbm_peak_gb" in r and "loss" in r and "grad_norm" in r
+        assert recs[-1]["collective_bytes"]["all_reduce"] > 0
+        assert recs[-1]["collective_bytes"]["reduce_scatter"] > 0
+
+        # prometheus text export
+        text = telemetry.prometheus_text()
+        assert "paddle_tpu_steps_total 3" in text
+        assert 'paddle_tpu_collective_bytes_total{kind="all_reduce"}' in text
+        assert 'paddle_tpu_collective_bytes_total{kind="reduce_scatter"}' in text
+        assert "paddle_tpu_hbm_peak_bytes" in text
+        assert "paddle_tpu_train_step_calls_total 3" in text
+        for line in text.splitlines():  # well-formed exposition format
+            assert line.startswith("#") or " " in line
+
+        # flight-recorder dump
+        path = telemetry.dump_flight_recorder(path=str(tmp_path / "fr.json"))
+        doc = json.load(open(path))
+        kinds = {(e["kind"], e["name"]) for e in doc["events"]}
+        assert ("collective", "all_reduce") in kinds
+        assert ("collective", "reduce_scatter") in kinds
+        assert ("step", "smoke") in kinds      # StepMeter events
+        assert ("step", "TrainStep") in kinds  # engine-driven events
+        assert doc["counters"]["steps_total"] == 3
+
+    def test_summary_aggregates(self):
+        meter = telemetry.StepMeter("agg", tokens_per_step=10,
+                                    model_params=100)
+        meter.step(loss=2.0)
+        time.sleep(0.01)
+        meter.step(loss=1.0)
+        s = meter.summary()
+        assert s["steps"] == 2
+        assert s["tokens_per_s"] > 0
+        assert s["first_loss"] == 2.0 and s["final_loss"] == 1.0
+        assert "hbm_peak_gb" in s
+
+    def test_zero_duration_step_reads_zero_rates(self):
+        meter = telemetry.StepMeter("z", tokens_per_step=10, model_params=10)
+        meter._t_last = time.perf_counter() + 1e9  # force dt <= 0
+        rec = meter.step()
+        assert rec["tokens_per_s"] == 0.0
+        assert rec["mfu"] == 0.0
+        assert rec["samples_per_s"] == 0.0
+
+
+class TestMemoryWatermarks:
+    def test_cpu_graceful_noop(self):
+        wm = telemetry.hbm_watermarks()
+        assert wm["devices"] == 0  # CPU PJRT exposes no counters
+        assert wm["peak_gb"] == 0.0 and wm["live_gb"] == 0.0
+        assert telemetry.hbm_stats() == []
+        assert telemetry.hbm_peak_gb() == 0.0
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded(self):
+        fr = telemetry.FlightRecorder(maxlen=4)
+        for i in range(10):
+            fr.record("k", f"e{i}")
+        evs = fr.events()
+        assert len(evs) == 4
+        assert [e["name"] for e in evs] == ["e6", "e7", "e8", "e9"]
+        assert fr._dropped == 6
+
+    def test_dump_on_demand(self, tmp_path):
+        telemetry.record_event("checkpoint_save", "/ckpt/step100", rank=0)
+        path = telemetry.dump_flight_recorder(path=str(tmp_path / "d.json"),
+                                              reason="test")
+        doc = json.load(open(path))
+        assert doc["reason"] == "test"
+        assert doc["events"][-1]["name"] == "/ckpt/step100"
+        assert doc["pid"] == os.getpid()
+
+    def test_watchdog_hang_writes_dump_identifying_inflight(self, tmp_path,
+                                                            monkeypatch):
+        """ISSUE acceptance: a simulated hang (watchdog test hook: a watch
+        armed longer than its timeout) writes a flight-recorder file whose
+        last events identify the in-flight collective."""
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_RECORDER_DIR", str(tmp_path))
+        fired = []
+        wd = CommWatchdog(timeout=0.2, poll_interval=0.05,
+                          on_timeout=fired.append)
+        with wd.watch("all_reduce"):
+            time.sleep(0.6)  # the hang: wait never returns within timeout
+        wd.stop()
+        assert len(fired) == 1
+        dump = fired[0]["flight_recorder_dump"]
+        assert dump and os.path.exists(dump)
+        doc = json.load(open(dump))
+        evs = doc["events"]
+        assert evs[-1]["kind"] == "watchdog_timeout"
+        assert evs[-1]["name"] == "all_reduce"
+        assert evs[-1]["elapsed_s"] >= 0.2
+        armed = [e for e in evs if e["kind"] == "watch_armed"]
+        assert armed and armed[-1]["name"] == "all_reduce"
+        assert "paddle_tpu_watchdog_timeouts_total 1" in \
+            telemetry.prometheus_text()
+
+
+class TestProfilerTelemetryMerge:
+    def test_chrome_roundtrip_nesting_and_telemetry_category(self, tmp_path):
+        """Satellite: export_chrome_tracing/load_profiler_result round-trip —
+        JSON parses, host events nest, merged telemetry events carry the
+        distinguishing 'telemetry' category."""
+        cb = profiler.export_chrome_tracing(str(tmp_path))
+        with profiler.Profiler(targets=[profiler.ProfilerTarget.CPU],
+                               scheduler=profiler.make_scheduler(
+                                   closed=0, ready=0, record=2, repeat=1),
+                               on_trace_ready=cb) as prof:
+            for _ in range(2):
+                with profiler.RecordEvent("outer"):
+                    with profiler.RecordEvent("inner"):
+                        dist.all_reduce(_stacked(8))
+                prof.step()
+        files = [f for f in os.listdir(tmp_path)
+                 if f.endswith(".paddle_trace.json")]
+        assert len(files) == 1
+        loaded = profiler.load_profiler_result(str(tmp_path / files[0]))
+        events = loaded["traceEvents"]
+
+        # host spans nest: inner ⊂ outer ⊂ its ProfileStep span
+        spans = {e["name"]: e for e in events
+                 if e["ph"] == "X" and e.get("cat") != "telemetry"}
+        assert "inner" in spans and "outer" in spans
+
+        def contains(a, b):  # a contains b
+            return a["ts"] <= b["ts"] and \
+                b["ts"] + b["dur"] <= a["ts"] + a["dur"] + 1e-3
+        assert contains(spans["outer"], spans["inner"])
+        steps = [e for e in events if e["name"].startswith("ProfileStep#")]
+        assert any(contains(s, spans["outer"]) for s in steps)
+
+        # merged telemetry events: distinguishing category + the collective
+        tele = [e for e in events if e.get("cat") == "telemetry"]
+        assert tele
+        assert any(e["name"] == "collective:all_reduce" for e in tele)
+        colls = [e for e in tele if e["name"] == "collective:all_reduce"]
+        assert all(e["ph"] in ("X", "i") for e in tele)
+        assert colls[0]["args"]["nbytes"] == 8 * 4 * 4
+
+    def test_merge_excludes_events_before_window(self, tmp_path):
+        telemetry.record_event("checkpoint_save", "/before/window")
+        with profiler.Profiler(targets=[profiler.ProfilerTarget.CPU]) as prof:
+            dist.all_reduce(_stacked(8))
+            prof.step()
+        path = str(tmp_path / "t.json")
+        prof.export(path)
+        tele = [e for e in profiler.load_profiler_result(path)["traceEvents"]
+                if e.get("cat") == "telemetry"]
+        assert any(e["name"] == "collective:all_reduce" for e in tele)
+        assert not any("/before/window" in e["name"] for e in tele)
+
+
+class TestSatellites:
+    def test_sortedkeys_tpu_aliases(self):
+        SK = profiler.SortedKeys
+        assert SK.TPUTotal is SK.GPUTotal
+        assert SK.TPUAvg is SK.GPUAvg
+        assert SK.TPUMax is SK.GPUMax
+        assert SK.TPUMin is SK.GPUMin
+        assert "alias" in profiler.ProfilerTarget.__doc__.lower()
+        assert profiler.ProfilerTarget.GPU is profiler.ProfilerTarget.TPU
+
+    def test_summary_sorted_by_tpu_alias(self):
+        with profiler.Profiler(targets=[profiler.ProfilerTarget.CPU]) as prof:
+            with profiler.RecordEvent("work"):
+                pass
+            prof.step()
+        table = prof.summary(sorted_by=profiler.SortedKeys.TPUTotal)
+        assert "work" in table
+
+    def test_step_info_zero_duration_first_step(self):
+        b = profiler.benchmark()
+        b.begin()
+        assert "ips: 0.000" in b.step_info()  # steps=0, total_time=0
+        b.step()   # zero-ish duration first step must not raise
+        info = b.step_info()
+        assert "reader_cost" in info and "batch_cost" in info
+        # forced exact-zero denominators
+        b.total_time = 0.0
+        b.steps = 0
+        assert "ips: 0.000" in b.step_info()
+
+    def test_engine_registers_grad_psum_profile(self):
+        """DistributedTrainStep registers the implicit DP grad collective
+        as a trace-time program and counts executions per step."""
+        from paddle_tpu.distributed import fleet
+
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 8, "mp_degree": 1,
+                                   "pp_degree": 1, "sharding_degree": 1,
+                                   "sep_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        hcg = dist.get_hybrid_communicate_group()
+        paddle.seed(0)
+        model = paddle.nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+        step = dist.DistributedTrainStep(
+            model, lambda m, x, y: F.mse_loss(m(x), y), opt, hcg)
+        progs = telemetry.traced_programs()
+        tag = "DistributedTrainStep_stage0"
+        assert tag in progs
+        assert progs[tag].collectives[0]["kind"] == "all_reduce"
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.standard_normal((8, 4)).astype("float32"))
+        y = paddle.to_tensor(rng.standard_normal((8, 4)).astype("float32"))
+        step(x, y)
+        assert progs[tag].executions == 1
+        assert telemetry.collective_stats()["all_reduce"]["calls"] >= 1
