@@ -1078,7 +1078,39 @@ _COMPACT_KEYS = (
     "pipeline_efficiency", "tp_derate", "flash_blocks", "steps_per_sec",
     "slice_tokens_per_sec", "virtual_stages", "micro_batches",
     "cache_gb_read_per_step", "norm_target", "device", "hbm_peak_gb",
+    "resume_ok",
 )
+
+
+def _resume_smoke() -> bool:
+    """Save → latest_checkpoint → load round trip through the atomic commit
+    protocol (tiny tensors, one temp dir): the bench's fast proof that the
+    crash-safe checkpoint path works on this build/platform. Rides into the
+    primary metric's detail as ``resume_ok``."""
+    import os
+    import tempfile
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.checkpoint import (is_committed,
+                                                   latest_checkpoint,
+                                                   load_state_dict,
+                                                   save_state_dict)
+
+    with tempfile.TemporaryDirectory() as root:
+        src = np.arange(16, dtype="float32").reshape(4, 4)
+        save_state_dict({"w": paddle.to_tensor(src),
+                         "step": paddle.to_tensor(np.int64(3))},
+                        os.path.join(root, "step_3"))
+        latest = latest_checkpoint(root)
+        if latest is None or not is_committed(latest):
+            return False
+        dst = {"w": paddle.to_tensor(np.zeros_like(src)),
+               "step": paddle.to_tensor(np.int64(0))}
+        load_state_dict(dst, latest)
+        return bool((dst["w"].numpy() == src).all()
+                    and int(np.asarray(dst["step"].numpy())) == 3)
 
 
 def _compact(entry: dict) -> str:
@@ -1114,6 +1146,10 @@ def main() -> None:
 
     primary = bench_llama(on_accel, peak)
     primary["detail"]["device"] = getattr(dev, "device_kind", str(dev))
+    try:  # resume smoke-check: crash-safe checkpoint path works here
+        primary["detail"]["resume_ok"] = _resume_smoke()
+    except Exception:
+        primary["detail"]["resume_ok"] = False
     extras = []
     for fn, kw in ((bench_resnet, {}), (bench_gpt_tp_pp, {}),
                    (bench_llama_longctx, {}), (bench_ernie_ft, {}),

@@ -1,0 +1,202 @@
+"""Deterministic fault injection for the checkpoint/restart stack.
+
+The reference proves its fault tolerance operationally (kill an etcd
+lease, watch ElasticManager restart the pod); a growing codebase needs the
+same proof as a *unit test*. This module is a seeded, scope-based injector
+that the checkpoint storage layer (``storage.py``) and commit protocol
+(``commit.py``) consult at every I/O step. A test arms one or more
+:class:`FaultSpec`\\ s inside a ``with`` block and the next matching
+operations fail in a controlled, reproducible way:
+
+``mode``
+    - ``"error"``     raise :class:`InjectedIOError` (an ``OSError`` — the
+      retriable class, so it exercises the backoff path; a spec with
+      ``times=2`` flakes the first two attempts and lets the third pass);
+    - ``"crash"``     raise :class:`InjectedCrash` (NOT retriable — models
+      the process dying at this exact point; whatever bytes are on disk
+      stay there);
+    - ``"truncate"``  write only ``truncate_frac`` of the payload to the
+      destination, then raise :class:`InjectedCrash` (a kill mid-``write``:
+      a torn file at the final path);
+    - ``"delay"``     sleep ``delay_s`` then continue (storage flake /
+      slow NFS; pairs with the comm watchdog);
+    - ``"sigterm"``   deliver a real ``SIGTERM`` to this process and
+      continue (synthetic preemption notice; pairs with
+      :class:`~paddle_tpu.distributed.fleet.elastic.PreemptionGuard`).
+
+``op`` selects the protocol step (``"write"``, ``"read"``, ``"rename"``,
+``"commit"`` — the marker write — or ``"any"``); ``pattern`` is an
+``fnmatch`` over the file's basename (or full path). ``after``/``times``
+window which matching calls fire, and ``p``/``seed`` make probabilistic
+campaigns reproducible.
+
+usage::
+
+    from paddle_tpu.distributed.checkpoint import faults
+
+    with faults.inject(op="write", pattern="*.distcp", mode="error", times=2):
+        save_state_dict(state, path)        # retries absorb the flakes
+
+    with faults.inject(op="commit", mode="crash"):
+        save_state_dict(state, path)        # dies between rename and marker
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import random
+import signal
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+__all__ = ["FaultSpec", "InjectedIOError", "InjectedCrash", "inject",
+           "scope", "fire", "active", "reset"]
+
+_MODES = ("error", "crash", "truncate", "delay", "sigterm")
+_OPS = ("write", "read", "rename", "commit", "any")
+
+
+class InjectedIOError(OSError):
+    """Retriable injected failure (models disk-full / GCS flake)."""
+
+
+class InjectedCrash(RuntimeError):
+    """Non-retriable injected failure (models the process dying here).
+    Deliberately NOT an OSError so the retry wrapper never absorbs it."""
+
+
+@dataclass
+class FaultSpec:
+    """One armed fault. Mutable counters live on the spec so a test can
+    assert how often it actually fired (``spec.fired``)."""
+
+    op: str = "write"
+    pattern: str = "*"
+    mode: str = "error"
+    times: int = 1            # fire at most N times; -1 = unbounded
+    after: int = 0            # skip the first `after` matching calls
+    p: float = 1.0            # per-call fire probability
+    seed: int = 0             # seeds the p-draws (reproducible campaigns)
+    delay_s: float = 0.05
+    truncate_frac: float = 0.5
+    message: str = "injected fault"
+    matched: int = 0          # matching calls seen (diagnostic)
+    fired: int = 0            # times actually fired
+    _rng: random.Random = field(default=None, repr=False)
+
+    def __post_init__(self):
+        if self.mode not in _MODES:
+            raise ValueError(f"mode must be one of {_MODES}, got {self.mode!r}")
+        if self.op not in _OPS:
+            raise ValueError(f"op must be one of {_OPS}, got {self.op!r}")
+        self._rng = random.Random(self.seed)
+
+    # -- matching ----------------------------------------------------------
+    def _matches(self, op: str, path: str) -> bool:
+        if self.op != "any" and op != self.op:
+            return False
+        return fnmatch.fnmatch(os.path.basename(path), self.pattern) or \
+            fnmatch.fnmatch(path, self.pattern)
+
+    def _should_fire(self) -> bool:
+        # caller holds the module lock: counters (incl. the fired budget)
+        # advance atomically so a times=N spec cannot over-fire when the
+        # main thread and an async writer hit the seam concurrently
+        self.matched += 1
+        if self.matched <= self.after:
+            return False
+        if self.times >= 0 and self.fired >= self.times:
+            return False
+        if self.p < 1.0 and self._rng.random() >= self.p:
+            return False
+        self.fired += 1
+        return True
+
+    # -- action ------------------------------------------------------------
+    def _act(self, op: str, path: str, data: Optional[bytes]) -> None:
+        _record(self, op, path)
+        if self.mode == "delay":
+            time.sleep(self.delay_s)
+            return
+        if self.mode == "sigterm":
+            os.kill(os.getpid(), signal.SIGTERM)
+            return
+        if self.mode == "truncate":
+            if data is not None:
+                cut = max(1, int(len(data) * self.truncate_frac))
+                with open(path, "wb") as f:   # torn file at the FINAL path
+                    f.write(data[:cut])
+            raise InjectedCrash(
+                f"{self.message}: crashed mid-write of {path} "
+                f"(truncated to {self.truncate_frac:.0%})")
+        if self.mode == "crash":
+            raise InjectedCrash(f"{self.message}: crashed at {op} {path}")
+        raise InjectedIOError(f"{self.message}: {op} {path} failed "
+                              f"(fire {self.fired}/{self.times})")
+
+
+_active: List[FaultSpec] = []
+_lock = threading.Lock()
+
+
+def _record(spec: FaultSpec, op: str, path: str) -> None:
+    try:  # flight recorder: injected faults must be visible in post-mortems
+        from ... import telemetry
+
+        telemetry.record_event("fault_injected", spec.mode, op=op,
+                               path=os.path.basename(path),
+                               fired=spec.fired)
+    except Exception:
+        pass
+
+
+class scope:
+    """Context manager arming one or more specs for its duration."""
+
+    def __init__(self, *specs: FaultSpec):
+        self.specs = list(specs)
+
+    def __enter__(self):
+        with _lock:
+            _active.extend(self.specs)
+        return self.specs[0] if len(self.specs) == 1 else self.specs
+
+    def __exit__(self, *exc):
+        with _lock:
+            for s in self.specs:
+                if s in _active:
+                    _active.remove(s)
+        return False
+
+
+def inject(**kw) -> scope:
+    """``with faults.inject(op="write", mode="error", times=2): ...``"""
+    return scope(FaultSpec(**kw))
+
+
+def fire(op: str, path: str, data: Optional[bytes] = None) -> None:
+    """Injection point — called by the storage layer before each I/O step.
+    No-op (and near-zero cost) when nothing is armed."""
+    if not _active:
+        return
+    with _lock:
+        specs = [s for s in _active if s._matches(op, path)]
+        # counters are advanced under the lock; actions run outside it so a
+        # delay/sleep doesn't serialize unrelated I/O
+        to_fire = [s for s in specs if s._should_fire()]
+    for s in to_fire:
+        s._act(op, path, data)
+
+
+def active() -> List[FaultSpec]:
+    with _lock:
+        return list(_active)
+
+
+def reset() -> None:
+    """Disarm everything (test teardown safety net)."""
+    with _lock:
+        _active.clear()

@@ -9,7 +9,15 @@ saved shards from the metadata, reads exactly the overlapping slices from the
 shard files, and assembles the target array with
 ``jax.make_array_from_callback`` — so a checkpoint saved on dp2×mp2 loads
 onto dp4 (or any other mesh) without a gather of the full tensor on any
-single host."""
+single host.
+
+Trust, but verify (commit protocol, ``commit.py``): a directory without the
+``COMMITTED`` marker — an interrupted save — is refused up front with a
+:class:`~.errors.CheckpointError` pointing at ``latest_checkpoint``; every
+shard file's bytes are CRC32-checked against the checksum recorded at save
+time before unpickling, so corruption fails with an error naming the file
+rather than a pickle traceback. Escape hatch for pre-protocol checkpoints:
+``PADDLE_TPU_CKPT_ALLOW_UNCOMMITTED=1``."""
 
 from __future__ import annotations
 
@@ -20,6 +28,9 @@ from typing import Any, Dict
 import jax
 import numpy as np
 
+from . import commit as _commit
+from . import storage
+from .errors import CheckpointCorruptionError, CheckpointError
 from .metadata import LocalTensorIndex
 from .save_state_dict import _wait_pending
 from .utils import (compute_overlap, flatten_state_dict, shard_offsets,
@@ -29,17 +40,53 @@ __all__ = ["load_state_dict"]
 
 
 class _ShardFiles:
-    """Lazy per-file shard cache: rank files are opened at most once."""
+    """Lazy per-file shard cache: rank files are read (and their CRC32
+    verified against the save-time checksum) at most once."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, checksums: Dict[str, int]):
         self.path = path
+        self.checksums = checksums
         self._cache: Dict[str, Dict[tuple, np.ndarray]] = {}
 
     def get(self, file_name: str, key: str, offset: tuple) -> np.ndarray:
         if file_name not in self._cache:
-            with open(os.path.join(self.path, file_name), "rb") as f:
-                self._cache[file_name] = pickle.load(f)
+            full = os.path.join(self.path, file_name)
+            data = storage.read_bytes(full)
+            want = self.checksums.get(file_name)
+            if want is not None and storage.crc32(data) != want:
+                raise CheckpointCorruptionError(
+                    f"checksum mismatch in shard file {file_name!r} of "
+                    f"checkpoint {self.path!r}: expected crc32 {want}, got "
+                    f"{storage.crc32(data)} over {len(data)} bytes — the "
+                    f"file is corrupt or was truncated after commit")
+            try:
+                self._cache[file_name] = pickle.loads(data)
+            except Exception as e:
+                raise CheckpointCorruptionError(
+                    f"shard file {file_name!r} of checkpoint {self.path!r} "
+                    f"is undecodable ({type(e).__name__}: {e}); its bytes "
+                    f"are damaged") from e
         return self._cache[file_name][(key, offset)]
+
+
+def _check_committed(path: str) -> None:
+    if _commit.is_committed(path):
+        return
+    if os.environ.get("PADDLE_TPU_CKPT_ALLOW_UNCOMMITTED") == "1" and \
+            os.path.isfile(os.path.join(path, "metadata")):
+        return  # pre-commit-protocol checkpoint, explicitly allowed
+    if not os.path.isdir(path):
+        raise FileNotFoundError(
+            f"no checkpoint directory at {path!r}"
+            + (f" (a staging dir {_commit.staging_dir(path)!r} exists: the "
+               f"save that produced it never finished)"
+               if os.path.isdir(_commit.staging_dir(path)) else ""))
+    raise CheckpointError(
+        f"checkpoint at {path!r} has no {_commit.COMMITTED_MARKER} marker — "
+        f"the save was interrupted before commit and the directory may be "
+        f"incomplete. Use latest_checkpoint(root) to resume from the newest "
+        f"committed checkpoint (or set PADDLE_TPU_CKPT_ALLOW_UNCOMMITTED=1 "
+        f"to force-load a pre-protocol checkpoint).")
 
 
 def load_state_dict(state_dict: Dict[str, Any], path: str,
@@ -47,9 +94,17 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
     """Fill ``state_dict``'s tensors in place from the checkpoint at
     ``path``, resharding saved shards onto each target's current sharding."""
     _wait_pending()
-    with open(os.path.join(path, "metadata"), "rb") as f:
-        meta = pickle.load(f)
-    files = _ShardFiles(path)
+    _check_committed(path)
+    # a read failure here is storage outage (retries exhausted) and
+    # propagates as OSError; only an unpicklable payload is corruption
+    data = storage.read_bytes(os.path.join(path, "metadata"))
+    try:
+        meta = pickle.loads(data)
+    except Exception as e:
+        raise CheckpointCorruptionError(
+            f"metadata file of checkpoint {path!r} is undecodable "
+            f"({type(e).__name__}: {e})") from e
+    files = _ShardFiles(path, getattr(meta, "file_checksums", {}) or {})
     flat, mapping = flatten_state_dict(state_dict)
 
     for key, leaf in flat.items():

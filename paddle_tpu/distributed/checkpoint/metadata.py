@@ -8,6 +8,11 @@ Mirrors the reference's mesh-independent format
 - ``storage_metadata``: :class:`LocalTensorIndex` (key, global_offset) →
   shard file name
 - ``flat_mapping``: flat key → original nested key path
+- ``file_checksums``: shard file name → CRC32 of its bytes, recorded at
+  save time and verified on every load (a bit-flipped or truncated shard
+  fails with a checksum error naming the file, not a pickle traceback);
+  absent in checkpoints written before the commit protocol — loaders use
+  ``getattr(meta, "file_checksums", {})``
 
 Because the schema speaks only in global offsets/shapes, a checkpoint saved
 under one mesh/parallelism config can be loaded under any other — the loader
@@ -43,3 +48,4 @@ class Metadata:
     state_dict_metadata: Dict[str, List[LocalTensorMetadata]] = field(default_factory=dict)
     storage_metadata: Dict[LocalTensorIndex, str] = field(default_factory=dict)
     flat_mapping: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+    file_checksums: Dict[str, int] = field(default_factory=dict)

@@ -8,46 +8,111 @@ no gather step — each process writes the shards it owns to its own
 describing every shard of every tensor. Replicated arrays are saved once (by
 the lowest-rank owner) rather than once per replica.
 
+Crash safety (commit protocol, see ``commit.py``): all files land in
+``<path>.staging``; after a cross-rank barrier the coordinator records each
+shard file's CRC32 in the metadata, renames staging → final, and writes the
+``COMMITTED`` marker *last*. A crash at any point leaves either a staging
+dir or an unmarked final dir — both refused by ``load_state_dict`` and
+skipped by ``latest_checkpoint``. Shard/metadata I/O goes through
+``storage.write_bytes`` (retry with exponential backoff + jitter, and the
+fault-injection seam).
+
 ``async_save=True`` snapshots shard data to host memory synchronously and
-writes files on a background thread (the reference's async checkpoint
-capability)."""
+runs the write+commit on a background thread (the reference's async
+checkpoint capability). A failed async writer does NOT vanish with its
+daemon thread: the exception is captured, recorded to the flight recorder
+as ``checkpoint_save_failed``, and re-raised on the main thread at the next
+``save_state_dict``/``_wait_pending``/``load_state_dict``."""
 
 from __future__ import annotations
 
 import atexit
 import os
 import pickle
+import sys
 import threading
 from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
 
+from . import commit as _commit
+from . import storage
+from .errors import AsyncSaveError
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
 from .utils import flatten_state_dict, shard_offsets, tensor_value
 
 __all__ = ["save_state_dict"]
 
+
+class _AsyncSave:
+    """One in-flight background save: the thread plus its error slot."""
+
+    __slots__ = ("thread", "path", "error")
+
+    def __init__(self, thread: threading.Thread, path: str):
+        self.thread = thread
+        self.path = path
+        self.error: Optional[BaseException] = None
+
+
 _pending: list = []
 
 
 def _wait_pending() -> None:
+    """Join all in-flight async saves; re-raise the first captured failure
+    on THIS (the calling) thread so an async-save error can never be
+    silently lost."""
+    errs = []
     while _pending:
-        _pending.pop().join()
+        p = _pending.pop()
+        p.thread.join()
+        if p.error is not None:
+            errs.append(p)
+    if errs:
+        first = errs[0]
+        raise AsyncSaveError(
+            f"async checkpoint save to {first.path!r} failed: "
+            f"{first.error!r} (raised at the next save/wait; the checkpoint "
+            f"was NOT committed)") from first.error
 
 
-# interpreter exit must not truncate an in-flight async checkpoint
-atexit.register(_wait_pending)
+def _drain_at_exit() -> None:
+    # interpreter exit must not truncate an in-flight async checkpoint —
+    # but atexit must not raise either, so surface failures on stderr
+    try:
+        _wait_pending()
+    except AsyncSaveError as e:
+        sys.stderr.write(f"[paddle_tpu.checkpoint] {e}\n")
+
+
+atexit.register(_drain_at_exit)
+
+
+def _barrier(tag: str) -> None:
+    """All ranks' staged files must be durable before the coordinator
+    commits. Single-process (CPU tests, one-host pods): no-op. A FAILED
+    barrier must propagate — committing without it could mark a checkpoint
+    that is missing other ranks' shards as COMMITTED."""
+    if jax.process_count() <= 1:
+        return
+    try:
+        from jax.experimental import multihost_utils
+    except ImportError:  # jax build without multihost support: best effort
+        return
+    multihost_utils.sync_global_devices(f"paddle_tpu_ckpt_{tag}")
 
 
 def save_state_dict(state_dict: Dict[str, Any], path: str,
                     process_group=None, coordinator_rank: int = 0,
-                    async_save: bool = False) -> None:
+                    async_save: bool = False,
+                    keep_n: Optional[int] = None) -> None:
     """Write ``state_dict`` (possibly nested; values may be sharded over any
     mesh) as per-rank shard files plus a global ``metadata`` file under
-    ``path``."""
+    ``path``, committed atomically (staging dir → rename → ``COMMITTED``
+    marker last). ``keep_n`` additionally runs keep-N retention GC over
+    ``dirname(path)`` after a successful commit."""
     _wait_pending()
-    os.makedirs(path, exist_ok=True)
     rank = jax.process_index()
     flat, mapping = flatten_state_dict(state_dict)
 
@@ -91,17 +156,57 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
             if seen_offsets.get(offset) == rank and (key, offset) not in local_shards:
                 local_shards[(key, offset)] = np.asarray(shard.data)
 
+    staging = _commit.staging_dir(path)
+    shard_name = f"rank_{rank}.distcp"
+
     def _write():
-        with open(os.path.join(path, f"rank_{rank}.distcp"), "wb") as f:
-            pickle.dump(local_shards, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.makedirs(staging, exist_ok=True)
+        payload = pickle.dumps(local_shards, protocol=pickle.HIGHEST_PROTOCOL)
+        crc = storage.write_bytes(os.path.join(staging, shard_name), payload)
+        # CRC sidecar: under multi-process the coordinator cannot see other
+        # ranks' payload bytes, so every rank publishes its checksum next to
+        # its shard file; the coordinator folds them into the metadata
+        storage.write_bytes(os.path.join(staging, shard_name + ".crc32"),
+                            str(crc).encode())
+        _barrier("staged")
         if rank == coordinator_rank:
-            with open(os.path.join(path, "metadata"), "wb") as f:
-                pickle.dump(meta, f, protocol=pickle.HIGHEST_PROTOCOL)
+            for f in sorted(os.listdir(staging)):
+                if f.endswith(".crc32"):
+                    meta.file_checksums[f[:-len(".crc32")]] = \
+                        int(storage.read_bytes(os.path.join(staging, f)))
+                    os.remove(os.path.join(staging, f))
+            storage.write_bytes(os.path.join(staging, "metadata"),
+                                pickle.dumps(meta,
+                                             protocol=pickle.HIGHEST_PROTOCOL))
+            _commit.commit_dir(staging, path,
+                               extra={"keys": len(flat),
+                                      "async_save": bool(async_save)})
+            if keep_n is not None:
+                _commit.gc_checkpoints(os.path.dirname(os.path.abspath(path))
+                                       or ".", keep=keep_n)
+        _barrier("committed")
 
     if async_save:
-        t = threading.Thread(target=_write, daemon=True)
-        t.start()
-        _pending.append(t)
+        def _write_captured(p: "_AsyncSave") -> None:
+            try:
+                _write()
+            except BaseException as e:  # surfaced at the next save/wait
+                p.error = e
+                try:
+                    from ... import telemetry
+
+                    telemetry.record_event("checkpoint_save_failed", path,
+                                           rank=rank, error=repr(e)[:300],
+                                           async_save=True)
+                except Exception:
+                    pass
+
+        pend = _AsyncSave(None, path)
+        pend.thread = threading.Thread(daemon=True,
+                                       name="paddle-tpu-ckpt-writer",
+                                       target=_write_captured, args=(pend,))
+        _pending.append(pend)
+        pend.thread.start()
     else:
         _write()
     try:  # flight recorder: checkpoints bound what a restart can lose

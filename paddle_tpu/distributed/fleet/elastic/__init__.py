@@ -14,7 +14,18 @@ when membership changes, resuming from checkpoint. TPU-native translation:
   RESTART on membership change, COMPLETED on a done-flag);
 - recovery composes with :mod:`paddle_tpu.distributed.checkpoint`: the
   ``pre_hook``/restart path saves a sharded checkpoint, the relaunched job
-  loads it under the NEW mesh (reshard-on-load makes scale in/out work).
+  loads it under the NEW mesh (reshard-on-load makes scale in/out work);
+- the **supervisor path** (``supervisor.py``) closes the loop on one host:
+  :class:`Supervisor` relaunches the job with bounded restarts + seeded
+  backoff whenever it exits :data:`ELASTIC_EXIT_CODE` (101). The child
+  side produces that exit from either direction — a
+  :class:`PreemptionGuard` SIGTERM (async checkpoint + flight-recorder
+  dump, then 101) or a :class:`~paddle_tpu.distributed.CommWatchdog` hang
+  (recorder dump, then :func:`emergency_handler` saves a committed
+  emergency checkpoint and exits 101) — and on relaunch resumes from
+  ``checkpoint.latest_checkpoint(root)``, which only ever returns a
+  checkpoint whose atomic commit finished. ``keep_n`` retention GC between
+  restarts stops a crash loop from filling the disk.
 """
 
 from __future__ import annotations
@@ -27,7 +38,8 @@ import time
 from typing import Callable, Dict, List, Optional
 
 __all__ = ["ElasticManager", "ElasticStatus", "ElasticLevel", "FileStore",
-           "ELASTIC_EXIT_CODE", "PreemptionGuard"]
+           "ELASTIC_EXIT_CODE", "PreemptionGuard", "Supervisor",
+           "RestartPolicy", "emergency_handler"]
 
 ELASTIC_EXIT_CODE = 101
 
@@ -222,3 +234,5 @@ class ElasticManager:
 
 
 from .preemption import PreemptionGuard  # noqa: E402
+from .supervisor import (RestartPolicy, Supervisor,  # noqa: E402
+                         emergency_handler)

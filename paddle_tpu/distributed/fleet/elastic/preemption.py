@@ -66,15 +66,41 @@ class PreemptionGuard:
     def checkpoint_and_exit(self, state_dict: Dict, path: str,
                             exit_code: int = ELASTIC_EXIT_CODE,
                             extra: Optional[Dict] = None) -> None:
-        """Async-save ``state_dict`` (synced before exit), deregister from
-        the elastic membership, and leave with the restart exit code."""
+        """Async-save ``state_dict`` (synced before exit), dump the flight
+        recorder next to the checkpoint, deregister from the elastic
+        membership, and leave with the restart exit code."""
+        import os
+
         from ...checkpoint import save_state_dict
         from ...checkpoint.save_state_dict import _wait_pending
 
         if extra:
             state_dict = {**state_dict, **extra}
-        save_state_dict(state_dict, path, async_save=True)
-        _wait_pending()  # the process is about to die: flush the writers
+        saved = False
+        try:
+            save_state_dict(state_dict, path, async_save=True)
+            _wait_pending()  # the process is about to die: flush the writers
+            saved = True
+        except Exception as e:
+            # a storage failure must not steal the restart exit code: the
+            # supervisor can still relaunch into the previous committed
+            # checkpoint, which beats dying "fatal" with no checkpoint at all
+            import sys as _sys
+
+            _sys.stderr.write(f"[preemption] checkpoint to {path!r} failed: "
+                              f"{e!r}; exiting {exit_code} anyway\n")
+        try:  # post-mortem beside the checkpoint: why did this pod leave?
+            from .... import telemetry
+
+            telemetry.record_event("preemption_exit", path,
+                                   exit_code=exit_code, saved=saved)
+            parent = os.path.dirname(os.path.abspath(path)) or "."
+            telemetry.dump_flight_recorder(
+                path=os.path.join(parent,
+                                  f"flight_preempt_pid{os.getpid()}.json"),
+                reason="preemption")
+        except Exception:
+            pass
         if self.manager is not None:
             try:
                 self.manager.exit(completed=False)

@@ -1,0 +1,207 @@
+"""Local restart supervisor: bounded relaunch loop with backoff.
+
+The reference restarts a pod from *outside* (etcd watch → agent relaunch);
+this module is the single-host analogue that makes the resilience pieces
+compose end to end without a cluster manager:
+
+    CommWatchdog timeout ──► flight-recorder dump (watchdog)
+                             └► emergency checkpoint (``emergency_handler``)
+                                 └► exit ``ELASTIC_EXIT_CODE`` (101)
+    PreemptionGuard SIGTERM ──► async checkpoint + dump ──► exit 101
+                                      │
+    Supervisor.run() ◄────────────────┘  sees 101 → backoff → relaunch
+                                         child resumes via
+                                         ``latest_checkpoint(root)``
+
+:class:`Supervisor` relaunches either a subprocess command (real isolation
+— a hung child is killed, a crashed child cannot corrupt the parent) or an
+in-process callable (unit tests) whenever it exits with a *restart code*
+(default: only 101). Restarts are bounded (``RestartPolicy.max_restarts``)
+and spaced by seeded exponential backoff + jitter; any other nonzero exit
+is treated as fatal and returned to the caller. Between restarts the
+supervisor optionally runs keep-N retention GC over the checkpoint root,
+so a crash-looping job cannot fill the disk with emergency checkpoints.
+
+:func:`emergency_handler` builds the child-side ``on_timeout`` callback for
+:class:`~paddle_tpu.distributed.CommWatchdog`: the watchdog has already
+dumped the flight recorder by the time it fires, so the handler saves a
+committed emergency checkpoint (best effort — the state provider runs on
+the monitor thread while the main thread is wedged) and exits 101 for the
+supervisor to catch.
+
+usage::
+
+    # parent
+    sup = Supervisor([sys.executable, "train.py", ckpt_root],
+                     policy=RestartPolicy(max_restarts=5),
+                     ckpt_root=ckpt_root, keep_n=3)
+    sys.exit(sup.run())
+
+    # child (train.py)
+    resume = latest_checkpoint(ckpt_root)
+    if resume:
+        load_state_dict(state, resume)
+    wd = CommWatchdog(timeout=300,
+                      on_timeout=emergency_handler(lambda: state, ckpt_root))
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import subprocess
+import sys
+import time
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Sequence, Union
+
+from . import ELASTIC_EXIT_CODE
+
+__all__ = ["RestartPolicy", "Supervisor", "emergency_handler"]
+
+
+@dataclass
+class RestartPolicy:
+    """Bounded restarts with seeded exponential backoff + jitter."""
+
+    max_restarts: int = 5
+    backoff_base: float = 1.0
+    backoff_cap: float = 60.0
+    jitter: float = 0.25
+    seed: int = 0
+
+    def delay(self, restart_num: int) -> float:
+        """Backoff before restart ``restart_num`` (1-based)."""
+        base = min(self.backoff_cap,
+                   self.backoff_base * (2 ** max(0, restart_num - 1)))
+        rng = random.Random(self.seed * 1_000_003 + restart_num)
+        return base * (1.0 + self.jitter * rng.random())
+
+
+class Supervisor:
+    """Relaunch loop around one training job.
+
+    ``target`` is either an argv list (subprocess mode) or a callable
+    (in-process mode — the callable's ``SystemExit`` code, or 0 on normal
+    return, plays the role of the exit status)."""
+
+    def __init__(self, target: Union[Sequence[str], Callable[[], None]],
+                 policy: Optional[RestartPolicy] = None,
+                 restart_codes: Sequence[int] = (ELASTIC_EXIT_CODE,),
+                 env: Optional[dict] = None,
+                 ckpt_root: Optional[str] = None,
+                 keep_n: Optional[int] = None,
+                 child_timeout: Optional[float] = None):
+        self.target = target
+        self.policy = policy or RestartPolicy()
+        self.restart_codes = tuple(restart_codes)
+        self.env = env
+        self.ckpt_root = ckpt_root
+        self.keep_n = keep_n
+        self.child_timeout = child_timeout
+        self.restarts = 0
+        self.exit_codes: List[int] = []
+
+    # -- one launch --------------------------------------------------------
+    def _launch_once(self) -> int:
+        if callable(self.target):
+            try:
+                self.target()
+                return 0
+            except SystemExit as e:
+                code = e.code
+                return code if isinstance(code, int) else (0 if code is None
+                                                           else 1)
+        try:
+            proc = subprocess.run(list(self.target), env=self.env,
+                                  timeout=self.child_timeout)
+            return proc.returncode
+        except subprocess.TimeoutExpired:
+            # a child the watchdog failed to kill: treat as restartable hang
+            return self.restart_codes[0] if self.restart_codes else 1
+
+    # -- loop --------------------------------------------------------------
+    def run(self) -> int:
+        """Launch; relaunch with backoff on restart codes; return the final
+        exit code (0 = completed, restart code = gave up after
+        ``max_restarts``, anything else = fatal child error)."""
+        self._event("supervisor_start")
+        while True:
+            rc = self._launch_once()
+            self.exit_codes.append(rc)
+            if rc == 0:
+                self._event("supervisor_done", restarts=self.restarts)
+                return 0
+            if rc not in self.restart_codes:
+                self._event("supervisor_fatal", exit_code=rc,
+                            restarts=self.restarts)
+                return rc
+            if self.restarts >= self.policy.max_restarts:
+                self._event("supervisor_giveup", exit_code=rc,
+                            restarts=self.restarts)
+                return rc
+            self.restarts += 1
+            delay = self.policy.delay(self.restarts)
+            self._event("supervisor_restart", attempt=self.restarts,
+                        exit_code=rc, backoff_s=round(delay, 3))
+            if self.ckpt_root and self.keep_n:
+                try:
+                    from ...checkpoint import gc_checkpoints
+
+                    gc_checkpoints(self.ckpt_root, keep=self.keep_n)
+                except Exception:
+                    pass
+            time.sleep(delay)
+
+    @staticmethod
+    def _event(name: str, **data) -> None:
+        try:  # flight recorder: the parent's ring narrates the restart story
+            from .... import telemetry
+
+            telemetry.record_event("supervisor", name, **data)
+        except Exception:
+            pass
+
+
+def emergency_handler(get_state: Callable[[], dict], ckpt_root: str,
+                      exit_code: int = ELASTIC_EXIT_CODE,
+                      hard_exit: bool = True) -> Callable[[dict], None]:
+    """Build a ``CommWatchdog`` ``on_timeout`` callback: save a committed
+    emergency checkpoint under ``ckpt_root`` and exit ``exit_code`` so a
+    :class:`Supervisor` relaunches into ``latest_checkpoint`` resume.
+
+    The watchdog dumps the flight recorder *before* invoking this (its
+    ``info`` already carries ``flight_recorder_dump``), so the ordering is
+    dump → checkpoint → exit. ``hard_exit=False`` skips the exit (tests;
+    callers that want to raise instead). Best effort by design: the save
+    runs on the watchdog's monitor thread while the main thread is wedged —
+    if it fails (e.g. the hang is in the storage layer too), the handler
+    records the failure and still exits, and resume falls back to the last
+    periodic checkpoint."""
+
+    def on_timeout(info: dict) -> None:
+        path = os.path.join(
+            ckpt_root, f"emergency_{int(time.time())}_pid{os.getpid()}")
+        saved = False
+        try:
+            from ...checkpoint import save_state_dict
+            from ...checkpoint.save_state_dict import _wait_pending
+
+            save_state_dict(get_state(), path)
+            _wait_pending()
+            saved = True
+        except Exception as e:
+            sys.stderr.write(f"[supervisor] emergency checkpoint to {path} "
+                             f"failed: {e!r}\n")
+        try:
+            from .... import telemetry
+
+            telemetry.record_event("emergency_checkpoint", path,
+                                   trigger=info.get("name"), saved=saved,
+                                   dump=info.get("flight_recorder_dump", ""))
+        except Exception:
+            pass
+        if hard_exit:
+            os._exit(exit_code)  # the main thread is wedged: no sys.exit
+
+    return on_timeout

@@ -67,6 +67,8 @@ class CommWatchdog:
         if self._thread is not None:
             self._thread.join(timeout=2)
             self._thread = None
+        with self._lock:  # fired-marks must not leak across start/stop cycles
+            self._fired.clear()
 
     # -- watches -----------------------------------------------------------
     def watch(self, name: str = "comm", timeout: Optional[float] = None):
@@ -109,6 +111,10 @@ class CommWatchdog:
             now = time.time()
             expired: List[tuple] = []
             with self._lock:
+                # a fired-mark only matters while its watch is armed; prune
+                # marks whose watch is gone so the set stays bounded even if
+                # a caller _arm()s directly and never _disarm()s
+                self._fired &= self._watches.keys()
                 for wid, w in self._watches.items():
                     if now > w.deadline and wid not in self._fired:
                         self._fired.add(wid)

@@ -9,7 +9,17 @@ writes them as JSON:
 - on demand (``paddle_tpu.telemetry.dump_flight_recorder()``),
 - on unhandled exception (a chaining ``sys.excepthook``, installed lazily on
   the first recorded event; disable via ``PADDLE_TPU_FLIGHT_RECORDER=0``),
-- from ``distributed/watchdog.py`` when a comm wait exceeds its timeout.
+- from ``distributed/watchdog.py`` when a comm wait exceeds its timeout,
+- from ``fleet/elastic`` on preemption exit (post-mortem dumped next to the
+  emergency checkpoint).
+
+The resilience stack narrates its lifecycle into the ring:
+``checkpoint_save`` / ``checkpoint_load`` / ``checkpoint_save_failed`` (a
+background async writer died — also re-raised at the next save/wait) /
+``checkpoint_io_retry`` / ``checkpoint_gc``, ``fault_injected`` (chaos
+tests), ``preemption_exit`` / ``emergency_checkpoint``, and ``supervisor``
+start/restart/giveup/done events — so a dump reads as the story of how the
+process got where it is.
 
 Ring size: ``PADDLE_TPU_FLIGHT_RECORDER_SIZE`` (default 512). Dump dir:
 ``PADDLE_TPU_FLIGHT_RECORDER_DIR`` (default ``flight_recorder/``).

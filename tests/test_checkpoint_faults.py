@@ -1,0 +1,427 @@
+"""Chaos suite: crash-safe checkpointing under injected faults.
+
+Deterministic fault injection (``distributed/checkpoint/faults.py``) drives
+the save→crash→resume cycle the elastic stack depends on: kills mid-write,
+kills between rename and commit marker, bit-flips after commit, storage
+flakes absorbed by retry, async-writer failures surfaced on the main
+thread. Everything here is tier-1-fast (``chaos`` marker, not ``slow``) —
+failure handling is exactly the code that must not rot."""
+
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.chaos
+
+import paddle_tpu as paddle
+from paddle_tpu import telemetry
+from paddle_tpu.distributed import ProcessMesh, Replicate, Shard, shard_tensor
+from paddle_tpu.distributed.checkpoint import (AsyncSaveError,
+                                               CheckpointCorruptionError,
+                                               CheckpointError, faults,
+                                               gc_checkpoints, is_committed,
+                                               latest_checkpoint,
+                                               load_state_dict,
+                                               save_state_dict)
+from paddle_tpu.distributed.checkpoint.commit import COMMITTED_MARKER
+from paddle_tpu.distributed.fleet.elastic import (ELASTIC_EXIT_CODE,
+                                                  PreemptionGuard)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.reset()
+
+
+def _mesh(shape, names):
+    return ProcessMesh(np.arange(8).reshape(shape), dim_names=list(names))
+
+
+def _sharded(src, mesh_shape=(8,), names="x", spec=None):
+    pm = _mesh(mesh_shape, names)
+    return shard_tensor(src, pm, spec or [Shard(0), Replicate()])
+
+
+def _src(seed=0, shape=(16, 8)):
+    return np.random.default_rng(seed).standard_normal(shape).astype("float32")
+
+
+class TestCommitProtocol:
+    def test_committed_layout(self, tmp_path):
+        path = str(tmp_path / "step_1")
+        save_state_dict({"w": _sharded(_src())}, path)
+        assert is_committed(path)
+        names = sorted(os.listdir(path))
+        assert COMMITTED_MARKER in names and "metadata" in names
+        assert "rank_0.distcp" in names
+        assert not os.path.exists(path + ".staging")
+        marker = json.load(open(os.path.join(path, COMMITTED_MARKER)))
+        assert "rank_0.distcp" in marker["files"]
+        assert marker["committed_at"] <= time.time()
+
+    def test_resave_same_path_overwrites_atomically(self, tmp_path):
+        path = str(tmp_path / "ck")
+        a, b = _src(1), _src(2)
+        save_state_dict({"w": _sharded(a)}, path)
+        save_state_dict({"w": _sharded(b)}, path)
+        dst = _sharded(np.zeros_like(b))
+        load_state_dict({"w": dst}, path)
+        np.testing.assert_array_equal(dst.numpy(), b)
+
+    def test_keep_n_on_save(self, tmp_path):
+        for i in range(5):
+            save_state_dict({"w": _sharded(_src(i))},
+                            str(tmp_path / f"step_{i}"), keep_n=2)
+        kept = sorted(d for d in os.listdir(tmp_path))
+        assert kept == ["step_3", "step_4"]
+
+
+class TestCrashMidSave:
+    def test_truncated_shard_leaves_staging_and_resume_lands_on_last_good(
+            self, tmp_path):
+        """The acceptance case: kill between shard write and commit marker;
+        latest_checkpoint + load restores the last committed step bit-exact
+        on a DIFFERENT mesh layout."""
+        root = str(tmp_path)
+        good = _src(3)
+        save_state_dict({"w": _sharded(good, (4, 2), ("a", "b"),
+                                       [Shard(0), Shard(1)])},
+                        os.path.join(root, "step_1"))
+        with pytest.raises(faults.InjectedCrash):
+            with faults.inject(op="write", pattern="*.distcp",
+                               mode="truncate"):
+                save_state_dict({"w": _sharded(_src(4))},
+                                os.path.join(root, "step_2"))
+        # died before rename: staging dir with a torn file, no final dir
+        assert os.path.isdir(os.path.join(root, "step_2.staging"))
+        assert not os.path.isdir(os.path.join(root, "step_2"))
+        assert latest_checkpoint(root) == os.path.join(root, "step_1")
+        # resume under a different mesh factoring
+        dst = _sharded(np.zeros_like(good), (2, 4), ("c", "d"),
+                       [Replicate(), Shard(1)])
+        load_state_dict({"w": dst}, latest_checkpoint(root))
+        np.testing.assert_array_equal(dst.numpy(), good)
+
+    def test_crash_between_rename_and_marker_refused(self, tmp_path):
+        root = str(tmp_path)
+        save_state_dict({"w": _sharded(_src(5))}, os.path.join(root, "ok"))
+        with pytest.raises(faults.InjectedCrash):
+            with faults.inject(op="commit", mode="crash"):
+                save_state_dict({"w": _sharded(_src(6))},
+                                os.path.join(root, "dead"))
+        # renamed but unmarked: present on disk, invisible to resume
+        assert os.path.isdir(os.path.join(root, "dead"))
+        assert not is_committed(os.path.join(root, "dead"))
+        assert latest_checkpoint(root) == os.path.join(root, "ok")
+        dst = _sharded(np.zeros((16, 8), "float32"))
+        with pytest.raises(CheckpointError, match="COMMITTED"):
+            load_state_dict({"w": dst}, os.path.join(root, "dead"))
+
+    def test_missing_dir_message_mentions_staging(self, tmp_path):
+        root = str(tmp_path)
+        with pytest.raises(faults.InjectedCrash):
+            with faults.inject(op="write", pattern="*.distcp", mode="crash"):
+                save_state_dict({"w": _sharded(_src())},
+                                os.path.join(root, "s"))
+        dst = _sharded(np.zeros((16, 8), "float32"))
+        with pytest.raises(FileNotFoundError, match="never finished"):
+            load_state_dict({"w": dst}, os.path.join(root, "s"))
+
+
+class TestCorruption:
+    def _flip_byte(self, path, at=20):
+        data = open(path, "rb").read()
+        open(path, "wb").write(data[:at] + bytes([data[at] ^ 0xFF])
+                               + data[at + 1:])
+
+    def test_bitflip_names_file_not_pickle(self, tmp_path):
+        path = str(tmp_path / "ck")
+        save_state_dict({"w": _sharded(_src(7))}, path)
+        self._flip_byte(os.path.join(path, "rank_0.distcp"))
+        dst = _sharded(np.zeros((16, 8), "float32"))
+        with pytest.raises(CheckpointCorruptionError, match="rank_0.distcp"):
+            load_state_dict({"w": dst}, path)
+
+    def test_truncation_after_commit_names_file(self, tmp_path):
+        path = str(tmp_path / "ck")
+        save_state_dict({"w": _sharded(_src(8))}, path)
+        shard = os.path.join(path, "rank_0.distcp")
+        data = open(shard, "rb").read()
+        open(shard, "wb").write(data[:len(data) // 2])
+        dst = _sharded(np.zeros((16, 8), "float32"))
+        with pytest.raises(CheckpointCorruptionError, match="rank_0.distcp"):
+            load_state_dict({"w": dst}, path)
+
+    def test_corrupt_metadata_clear_error(self, tmp_path):
+        path = str(tmp_path / "ck")
+        save_state_dict({"w": _sharded(_src(9))}, path)
+        open(os.path.join(path, "metadata"), "wb").write(b"\x80garbage")
+        dst = _sharded(np.zeros((16, 8), "float32"))
+        with pytest.raises(CheckpointCorruptionError, match="metadata"):
+            load_state_dict({"w": dst}, path)
+
+
+class TestRetry:
+    def test_flaky_writes_absorbed_by_backoff(self, tmp_path):
+        """Disk-full/GCS-flake model: first two write attempts fail, the
+        third lands; the save commits and the data round-trips."""
+        path = str(tmp_path / "ck")
+        src = _src(10)
+        with faults.inject(op="write", pattern="*.distcp", mode="error",
+                           times=2) as spec:
+            save_state_dict({"w": _sharded(src)}, path)
+        assert spec.fired == 2
+        assert is_committed(path)
+        dst = _sharded(np.zeros_like(src), (2, 4), ("c", "d"),
+                       [Shard(1), Shard(0)])
+        load_state_dict({"w": dst}, path)
+        np.testing.assert_array_equal(dst.numpy(), src)
+        kinds = [e["kind"] for e in telemetry.get_flight_recorder().events()]
+        assert "checkpoint_io_retry" in kinds
+
+    def test_exhausted_retries_raise(self, tmp_path):
+        with pytest.raises(OSError):
+            with faults.inject(op="write", pattern="*.distcp", mode="error",
+                               times=-1):
+                save_state_dict({"w": _sharded(_src())},
+                                str(tmp_path / "ck"))
+
+    def test_flaky_reads_absorbed(self, tmp_path):
+        path = str(tmp_path / "ck")
+        src = _src(11)
+        save_state_dict({"w": _sharded(src)}, path)
+        dst = _sharded(np.zeros_like(src))
+        with faults.inject(op="read", pattern="*.distcp", mode="error",
+                           times=1):
+            load_state_dict({"w": dst}, path)
+        np.testing.assert_array_equal(dst.numpy(), src)
+
+
+class TestAsyncFailureSurfaced:
+    def test_async_error_raises_at_next_save_and_hits_flight_recorder(
+            self, tmp_path):
+        """A failed daemon-thread writer must not vanish: the next
+        save_state_dict re-raises on the main thread, the failure is in the
+        ring, and a flight-recorder dump carries it."""
+        from paddle_tpu.distributed.checkpoint.save_state_dict import \
+            _wait_pending
+
+        scope = faults.scope(faults.FaultSpec(op="write", pattern="*.distcp",
+                                              mode="error", times=-1))
+        with scope:
+            save_state_dict({"w": _sharded(_src(12))},
+                            str(tmp_path / "doomed"), async_save=True)
+            with pytest.raises(AsyncSaveError, match="doomed"):
+                _wait_pending()
+        # drained: a later save must succeed and not re-raise
+        save_state_dict({"w": _sharded(_src(13))}, str(tmp_path / "ok"))
+        assert is_committed(str(tmp_path / "ok"))
+        kinds = [e["kind"] for e in telemetry.get_flight_recorder().events()]
+        assert "checkpoint_save_failed" in kinds
+        dump = telemetry.dump_flight_recorder(
+            path=str(tmp_path / "dump.json"), reason="test")
+        doc = json.load(open(dump))
+        assert any(e["kind"] == "checkpoint_save_failed"
+                   for e in doc["events"])
+
+    def test_async_error_raises_at_next_save_call(self, tmp_path):
+        with faults.inject(op="write", pattern="*.distcp", mode="error",
+                           times=-1):
+            save_state_dict({"w": _sharded(_src())},
+                            str(tmp_path / "doomed"), async_save=True)
+            with pytest.raises(AsyncSaveError):
+                # next save: _wait_pending runs first and re-raises
+                save_state_dict({"w": _sharded(_src())},
+                                str(tmp_path / "next"))
+
+    def test_async_success_commits(self, tmp_path):
+        path = str(tmp_path / "ck")
+        src = _src(14)
+        save_state_dict({"w": _sharded(src)}, path, async_save=True)
+        dst = _sharded(np.zeros_like(src))
+        load_state_dict({"w": dst}, path)  # waits, verifies, loads
+        np.testing.assert_array_equal(dst.numpy(), src)
+        assert is_committed(path)
+
+
+class TestInjector:
+    def test_seeded_probability_is_reproducible(self):
+        def campaign():
+            spec = faults.FaultSpec(op="write", pattern="*", mode="error",
+                                    times=-1, p=0.5, seed=42)
+            fired = []
+            with faults.scope(spec):
+                for i in range(20):
+                    try:
+                        faults.fire("write", f"f{i}")
+                        fired.append(0)
+                    except OSError:
+                        fired.append(1)
+            return fired
+
+        a, b = campaign(), campaign()
+        assert a == b
+        assert 0 < sum(a) < 20  # actually probabilistic, not all/none
+
+    def test_after_window_and_times(self):
+        spec = faults.FaultSpec(op="write", pattern="*", mode="error",
+                                after=2, times=1)
+        with faults.scope(spec):
+            faults.fire("write", "a")  # skipped (after)
+            faults.fire("write", "b")  # skipped (after)
+            with pytest.raises(OSError):
+                faults.fire("write", "c")
+            faults.fire("write", "d")  # budget exhausted
+        assert spec.fired == 1 and spec.matched == 4
+
+    def test_delay_mode_sleeps(self):
+        spec = faults.FaultSpec(op="read", pattern="*", mode="delay",
+                                delay_s=0.05, times=1)
+        t0 = time.perf_counter()
+        with faults.scope(spec):
+            faults.fire("read", "x")
+        assert time.perf_counter() - t0 >= 0.05
+
+    def test_sigterm_mode_drives_preemption_guard(self, tmp_path):
+        guard = PreemptionGuard()
+        try:
+            assert not guard.preempted
+            with faults.inject(op="write", pattern="*.distcp",
+                               mode="sigterm"):
+                save_state_dict({"w": _sharded(_src())},
+                                str(tmp_path / "ck"))
+            assert guard.preempted  # synthetic notice delivered mid-save
+            assert is_committed(str(tmp_path / "ck"))  # save still finished
+        finally:
+            guard.uninstall()
+
+
+class TestResaveRotationRecovery:
+    """Crash windows of the re-save-into-same-path rotation: at every
+    instant at least one committed copy must survive, and recovery
+    (latest_checkpoint / gc) must restore it to the canonical name."""
+
+    def _committed(self, root, name, seed):
+        path = os.path.join(root, name)
+        save_state_dict({"w": _sharded(_src(seed))}, path)
+        return path
+
+    def _assert_loads(self, path, expect_seed):
+        dst = _sharded(np.zeros((16, 8), "float32"))
+        load_state_dict({"w": dst}, path)
+        np.testing.assert_array_equal(dst.numpy(), _src(expect_seed))
+
+    def test_died_between_rotation_renames(self, tmp_path):
+        # old committed rotated to trash, staging never renamed in
+        root = str(tmp_path)
+        path = self._committed(root, "latest", seed=20)
+        os.rename(path, path + ".trash.12345")
+        assert latest_checkpoint(root) == path  # recovered in place
+        assert is_committed(path)
+        self._assert_loads(path, 20)
+
+    def test_died_before_new_marker(self, tmp_path):
+        # new data renamed to final but never marked; old copy in trash
+        root = str(tmp_path)
+        path = self._committed(root, "latest", seed=21)
+        os.rename(path, path + ".trash.12345")
+        newer = self._committed(root, "incoming", seed=22)
+        os.remove(os.path.join(newer, COMMITTED_MARKER))  # marker never landed
+        os.rename(newer, path)
+        assert latest_checkpoint(root) == path
+        self._assert_loads(path, 21)  # unmarked new data discarded, old wins
+
+    def test_died_before_trash_sweep(self, tmp_path):
+        # both copies committed: the new final supersedes the trash
+        root = str(tmp_path)
+        old = self._committed(root, "old_copy", seed=23)
+        path = self._committed(root, "latest", seed=24)
+        os.rename(old, path + ".trash.12345")
+        assert latest_checkpoint(root) == path
+        assert not os.path.exists(path + ".trash.12345")
+        self._assert_loads(path, 24)  # newer committed copy kept
+
+    def test_resave_crash_end_to_end(self, tmp_path):
+        # drive the real code path: re-save into the same path with the
+        # marker write crashing; resume must land on the ORIGINAL copy
+        root = str(tmp_path)
+        path = self._committed(root, "latest", seed=25)
+        with pytest.raises(faults.InjectedCrash):
+            with faults.inject(op="commit", mode="crash"):
+                save_state_dict({"w": _sharded(_src(26))}, path)
+        assert latest_checkpoint(root) == path
+        self._assert_loads(path, 25)
+
+
+class TestPreemptionPostMortem:
+    def test_checkpoint_and_exit_dumps_flight_recorder(self, tmp_path):
+        """Satellite: a preempted pod leaves a post-mortem next to its
+        checkpoint before exiting 101."""
+        guard = PreemptionGuard(signals=(signal.SIGUSR2,))
+        try:
+            guard.trigger()
+            path = str(tmp_path / "ckpts" / "preempt")
+            with pytest.raises(SystemExit) as exc:
+                guard.checkpoint_and_exit({"w": _sharded(_src(15))}, path)
+            assert exc.value.code == ELASTIC_EXIT_CODE
+            assert is_committed(path)
+            dumps = [f for f in os.listdir(tmp_path / "ckpts")
+                     if f.startswith("flight_preempt")]
+            assert len(dumps) == 1
+            doc = json.load(open(tmp_path / "ckpts" / dumps[0]))
+            assert doc["reason"] == "preemption"
+            assert any(e["kind"] == "preemption_exit"
+                       for e in doc["events"])
+        finally:
+            guard.uninstall()
+
+    def test_exit_code_survives_save_failure(self, tmp_path):
+        """A storage failure during the preemption save must not steal the
+        restart exit code — the supervisor can still resume from the
+        previous committed checkpoint."""
+        guard = PreemptionGuard(signals=(signal.SIGUSR2,))
+        try:
+            guard.trigger()
+            with faults.inject(op="write", pattern="*.distcp", mode="error",
+                               times=-1):
+                with pytest.raises(SystemExit) as exc:
+                    guard.checkpoint_and_exit({"w": _sharded(_src())},
+                                              str(tmp_path / "doomed"))
+            assert exc.value.code == ELASTIC_EXIT_CODE  # still restartable
+            assert not is_committed(str(tmp_path / "doomed"))
+        finally:
+            guard.uninstall()
+
+
+class TestLatestAndGC:
+    def test_latest_orders_by_commit_time(self, tmp_path):
+        root = str(tmp_path)
+        for name in ("b", "a", "c"):  # lexical order != commit order
+            save_state_dict({"w": _sharded(_src())},
+                            os.path.join(root, name))
+        assert latest_checkpoint(root) == os.path.join(root, "c")
+
+    def test_latest_none_and_root_itself(self, tmp_path):
+        assert latest_checkpoint(str(tmp_path)) is None
+        path = str(tmp_path / "solo")
+        save_state_dict({"w": _sharded(_src())}, path)
+        assert latest_checkpoint(path) == path  # a committed dir IS one
+
+    def test_gc_keeps_newest_and_sweeps_leftovers(self, tmp_path):
+        root = str(tmp_path)
+        for i in range(4):
+            save_state_dict({"w": _sharded(_src(i))},
+                            os.path.join(root, f"step_{i}"))
+        with pytest.raises(faults.InjectedCrash):
+            with faults.inject(op="write", pattern="*.distcp", mode="crash"):
+                save_state_dict({"w": _sharded(_src())},
+                                os.path.join(root, "step_9"))
+        removed = gc_checkpoints(root, keep=2)
+        assert sorted(os.path.basename(p) for p in removed) == \
+            ["step_0", "step_1", "step_9.staging"]
+        assert latest_checkpoint(root) == os.path.join(root, "step_3")

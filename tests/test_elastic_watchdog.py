@@ -154,6 +154,35 @@ class TestCommWatchdog:
         wd.stop()
         assert len(fired) == 1
 
+    def test_fired_marks_pruned_on_disarm_and_stop(self):
+        """_fired must not grow without bound across watches: each disarm
+        prunes its mark, and stop() resets the set."""
+        wd = CommWatchdog(timeout=0.05, poll_interval=0.01,
+                          on_timeout=lambda info: None)
+        for i in range(5):
+            with wd.watch(f"op{i}"):
+                time.sleep(0.15)  # every watch expires and fires
+            assert wd._fired == set()  # pruned at disarm
+        assert wd.timeout_count == 5
+        with wd.watch("last"):
+            time.sleep(0.15)
+        wd.stop()
+        assert wd._fired == set()
+
+    def test_fired_swept_when_watch_vanishes_without_disarm(self):
+        """Direct _arm misuse (no context manager): once the watch is gone
+        the monitor loop sweeps the stale fired-mark."""
+        wd = CommWatchdog(timeout=0.05, poll_interval=0.01,
+                          on_timeout=lambda info: None)
+        wid = wd._arm("orphan", None)
+        time.sleep(0.15)
+        assert wid in wd._fired  # fired while armed: mark held (no refire)
+        with wd._lock:
+            wd._watches.pop(wid)  # watch vanishes without _disarm
+        time.sleep(0.1)
+        assert wd._fired == set()  # loop sweep pruned it
+        wd.stop()
+
 
 class TestMemoryStats:
     def test_cpu_counters_read_zero(self):
