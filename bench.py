@@ -98,11 +98,16 @@ def _meter_detail(meter) -> dict:
 
 def _llama_measure(cfg, batch, seq, steps, warmup):
     """Shared llama bench recipe: AMP-O2 fused train step, fresh random
-    batch per step, host-read sync; returns (tok/s, first, final, params)."""
+    batch per step, host-read sync; returns (tok/s, first, final, params).
+    The step runs GUARDED (health probe fused into the compiled program,
+    lagged verdict resolution — no per-step host sync) so the bench
+    trajectory both prices the guard and proves a healthy run reports
+    ``steps_skipped == 0``."""
     import numpy as np
 
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.health import HealthGuard, HealthPolicy
     from paddle_tpu.models import LlamaForCausalLM
 
     paddle.seed(0)
@@ -111,7 +116,10 @@ def _llama_measure(cfg, batch, seq, steps, warmup):
     opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters(),
                                  grad_clip=nn.ClipGradByGlobalNorm(1.0))
     model, opt = paddle.amp.decorate(model, opt, level="O2", dtype="bfloat16")
-    step = paddle.jit.TrainStep(model, lambda m, x, y: m(x, labels=y)[0], opt)
+    guard = HealthGuard(HealthPolicy(), name="bench_llama",
+                        on_escalate="raise")  # in-memory ledger, no exits
+    step = paddle.jit.TrainStep(model, lambda m, x, y: m(x, labels=y)[0], opt,
+                                health_guard=guard)
     rng = np.random.default_rng(0)
     batches = []
     for _ in range(warmup + steps):
@@ -121,7 +129,9 @@ def _llama_measure(cfg, batch, seq, steps, warmup):
     meter = _make_meter("bench_llama", tokens_per_step=batch * seq,
                         model_params=n_params)
     dt, first_loss, final_loss = _time_steps(step, batches, warmup, meter)
-    return batch * seq * steps / dt, first_loss, final_loss, n_params, meter
+    guard.flush()  # resolve lagged probes so the counters are final
+    return batch * seq * steps / dt, first_loss, final_loss, n_params, \
+        meter, guard
 
 
 def bench_llama(on_accel: bool, peak: float):
@@ -139,8 +149,8 @@ def bench_llama(on_accel: bool, peak: float):
                           num_key_value_heads=8, max_position_embeddings=512)
         batch, seq, steps, warmup = 2, 256, 4, 1
 
-    tokens_per_sec, first_loss, final_loss, n_params, meter = _llama_measure(
-        cfg, batch, seq, steps, warmup)
+    tokens_per_sec, first_loss, final_loss, n_params, meter, guard = \
+        _llama_measure(cfg, batch, seq, steps, warmup)
     achieved = tokens_per_sec * 6 * n_params / 1e12
     mfu = achieved / peak
     import math
@@ -158,6 +168,11 @@ def bench_llama(on_accel: bool, peak: float):
             "ln_vocab": round(math.log(cfg.vocab_size), 4),
             "mfu": round(mfu, 4),
             "achieved_tflops": round(achieved, 2),
+            # health-guarded run: a healthy bench must report 0 skips and
+            # 0 rewinds — a nonzero here is a silent-skip regression the
+            # bench trajectory catches
+            "steps_skipped": guard.steps_skipped,
+            "rewinds": guard.rewinds,
             **_meter_detail(meter),
         },
     }
@@ -854,8 +869,8 @@ def bench_llama_longctx(on_accel: bool, peak: float):
     for bq, bk in sweep:
         paddle.set_flags({"flash_block_q": bq, "flash_block_k": bk})
         try:
-            tps, first_loss, final_loss, n_params, meter = _llama_measure(
-                cfg, batch, seq, steps, warmup)
+            tps, first_loss, final_loss, n_params, meter, _guard = \
+                _llama_measure(cfg, batch, seq, steps, warmup)
         except Exception as e:  # one bad config must not kill the point
             failed.append({"blocks": [bq, bk], "error": repr(e)[:200]})
             continue
@@ -1078,7 +1093,7 @@ _COMPACT_KEYS = (
     "pipeline_efficiency", "tp_derate", "flash_blocks", "steps_per_sec",
     "slice_tokens_per_sec", "virtual_stages", "micro_batches",
     "cache_gb_read_per_step", "norm_target", "device", "hbm_peak_gb",
-    "resume_ok",
+    "resume_ok", "steps_skipped", "rewinds",
 )
 
 

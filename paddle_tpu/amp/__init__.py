@@ -29,6 +29,21 @@ __all__ = ["auto_cast", "amp_guard", "GradScaler", "AmpScaler", "decorate",
 _state = threading.local()
 
 
+@jax.jit
+def _fused_unscale(arrays, inv):
+    """Unscale every grad and fold per-grad finiteness into ONE device-side
+    flag — a single compiled program per grad-pytree structure, ONE host
+    sync for the whole parameter list (the per-grad ``bool(jnp.all(...))``
+    it replaces cost one sync per parameter)."""
+    finite = jnp.array(True)
+    out = []
+    for a in arrays:
+        f = a.astype(jnp.float32) * inv
+        finite &= jnp.all(jnp.isfinite(f))
+        out.append(f.astype(a.dtype))
+    return out, finite
+
+
 def _amp_state():
     if not hasattr(_state, "stack"):
         _state.stack = []
@@ -153,6 +168,13 @@ class AmpScaler:
         self._bad_steps = 0
         self._found_inf = False
         self._already_unscaled = False
+        self._health_guard = None
+
+    def attach_health_guard(self, guard) -> None:
+        """Route found-inf skips into a
+        :class:`~paddle_tpu.distributed.health.HealthGuard`'s skip counter
+        and anomaly window (the eager-path twin of the TrainStep probe)."""
+        self._health_guard = guard
 
     def is_enable(self) -> bool:
         return self._enable
@@ -176,17 +198,20 @@ class AmpScaler:
         if not self._enable or getattr(self, "_already_unscaled", False):
             return
         self._already_unscaled = True
-        found = False
-        inv = 1.0 / self._scale
-        for p in optimizer._parameter_list:
-            if p._grad is None:
-                continue
-            g = p._grad._value.astype(jnp.float32) * inv
-            finite = bool(jnp.all(jnp.isfinite(g)))
-            if not finite:
-                found = True
-            p._grad = Tensor(g.astype(p._grad._value.dtype))
+        with_grad = [p for p in optimizer._parameter_list
+                     if p._grad is not None]
+        if not with_grad:
+            self._found_inf = self._maybe_allreduce_found_inf(False)
+            return
+        inv = jnp.float32(1.0 / self._scale)
+        new_grads, finite = _fused_unscale([p._grad._value
+                                            for p in with_grad], inv)
+        for p, g in zip(with_grad, new_grads):
+            p._grad = Tensor(g)
+        found = not bool(finite)  # the ONE host sync of the unscale
         self._found_inf = self._maybe_allreduce_found_inf(found)
+        if self._found_inf and self._health_guard is not None:
+            self._health_guard.note_scaler_skip(scale=self._scale)
 
     def _maybe_allreduce_found_inf(self, found: bool) -> bool:
         """Hybrid-parallel hook: subclassed/overridden to allreduce across

@@ -106,12 +106,16 @@ def _barrier(tag: str) -> None:
 def save_state_dict(state_dict: Dict[str, Any], path: str,
                     process_group=None, coordinator_rank: int = 0,
                     async_save: bool = False,
-                    keep_n: Optional[int] = None) -> None:
+                    keep_n: Optional[int] = None,
+                    commit_extra: Optional[Dict[str, Any]] = None) -> None:
     """Write ``state_dict`` (possibly nested; values may be sharded over any
     mesh) as per-rank shard files plus a global ``metadata`` file under
     ``path``, committed atomically (staging dir → rename → ``COMMITTED``
     marker last). ``keep_n`` additionally runs keep-N retention GC over
-    ``dirname(path)`` after a successful commit."""
+    ``dirname(path)`` after a successful commit. ``commit_extra`` is folded
+    into the ``COMMITTED`` marker JSON (e.g. the health guard's
+    skip/anomaly/rewind counters via ``guard.commit_extra()``) so a
+    post-mortem reads the checkpoint's story without any other file."""
     _wait_pending()
     rank = jax.process_index()
     flat, mapping = flatten_state_dict(state_dict)
@@ -180,7 +184,8 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
                                              protocol=pickle.HIGHEST_PROTOCOL))
             _commit.commit_dir(staging, path,
                                extra={"keys": len(flat),
-                                      "async_save": bool(async_save)})
+                                      "async_save": bool(async_save),
+                                      **(commit_extra or {})})
             if keep_n is not None:
                 _commit.gc_checkpoints(os.path.dirname(os.path.abspath(path))
                                        or ".", keep=keep_n)

@@ -82,7 +82,7 @@ class DistributedTrainStep(TrainStep):
                  hcg: HybridCommunicateGroup, sharding_stage: Optional[int] = None,
                  batch_specs: Optional[Sequence[P]] = None, donate: bool = True,
                  offload: Optional[bool] = None,
-                 gradient_merge: Optional[int] = None):
+                 gradient_merge: Optional[int] = None, health_guard=None):
         self.hcg = hcg
         self.mesh = hcg.mesh
         if sharding_stage is None:
@@ -102,27 +102,46 @@ class DistributedTrainStep(TrainStep):
                 "memory", jax.devices()[0].platform)
         self._batch_specs = batch_specs
         super().__init__(model, loss_fn, optimizer, donate=donate,
-                         gradient_merge=gradient_merge)
+                         gradient_merge=gradient_merge,
+                         health_guard=health_guard)
         self._place_state()
+        # every compiled variant must pin the SAME shardings (else XLA is
+        # free to re-lay state out and the next differently-compiled step
+        # rejects it) — one source of truth for the pinning tuples
+        import functools as _ft
+
         self._compiled = jax.jit(
             self._step,
             donate_argnums=(0, 1) if donate else (),
-            in_shardings=(self._param_shardings, self._state_shardings,
-                          self._buffer_shardings, None, None, self._batch_shardings_holder),
-            out_shardings=(None, self._param_shardings, self._state_shardings,
-                           self._buffer_shardings),
+            **self._sharding_pins(),
         )
-        # the check_nan_inf variant must pin the SAME shardings (else XLA is
-        # free to re-lay state out and the next unchecked step rejects it);
-        # still no donation — state must survive a raise
-        import functools as _ft
-
+        # check_nan_inf variant: no donation — state must survive a raise
         self._compiled_checked = jax.jit(
             _ft.partial(self._step, check_numerics=True),
-            in_shardings=(self._param_shardings, self._state_shardings,
-                          self._buffer_shardings, None, None, self._batch_shardings_holder),
-            out_shardings=(None, self._param_shardings, self._state_shardings,
-                           self._buffer_shardings, None),
+            **self._sharding_pins(extra_out=True),
+        )
+
+    def _sharding_pins(self, extra_out: bool = False) -> dict:
+        """in/out sharding kwargs shared by every compiled step variant;
+        ``extra_out`` appends the unpinned slot for a flags/probe output."""
+        out = (None, self._param_shardings, self._state_shardings,
+               self._buffer_shardings)
+        return {
+            "in_shardings": (self._param_shardings, self._state_shardings,
+                             self._buffer_shardings, None, None,
+                             self._batch_shardings_holder),
+            "out_shardings": out + ((None,) if extra_out else ()),
+        }
+
+    def _make_guarded_jit(self):
+        """Health-guarded variant, same pinned shardings; donation stays
+        on — skips are selected in-program, never recovered host-side."""
+        import functools as _ft
+
+        return jax.jit(
+            _ft.partial(self._step, health_probe=True),
+            donate_argnums=(0, 1) if self._donate else (),
+            **self._sharding_pins(extra_out=True),
         )
 
     @staticmethod

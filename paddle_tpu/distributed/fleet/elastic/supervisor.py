@@ -8,10 +8,25 @@ compose end to end without a cluster manager:
                              └► emergency checkpoint (``emergency_handler``)
                                  └► exit ``ELASTIC_EXIT_CODE`` (101)
     PreemptionGuard SIGTERM ──► async checkpoint + dump ──► exit 101
+    HealthGuard escalation ──► RewindLedger entry + dump ──► exit 101
                                       │
     Supervisor.run() ◄────────────────┘  sees 101 → backoff → relaunch
                                          child resumes via
                                          ``latest_checkpoint(root)``
+
+The third arrow is the numerical-health rewind path
+(:mod:`paddle_tpu.distributed.health`): when a
+:class:`~paddle_tpu.distributed.health.HealthGuard` sees K anomalies
+(NaN/Inf steps it already skipped device-side, or finite loss/grad-norm
+spikes) inside its window, it records the poisoned data window in the
+``RewindLedger`` next to the checkpoints, dumps the flight recorder, and
+exits 101 — the relaunch resumes from ``latest_checkpoint(root)``, calls
+``guard.on_restart(step, sampler)`` to fast-forward PAST the poisoned
+batches, and a run that keeps rewinding to the same step raises
+``HealthError`` (a non-101 exit this supervisor treats as fatal rather
+than burning the restart budget on a divergence loop). When ``ckpt_root``
+is set, restart events carry the ledger's rewind count so the parent's
+flight recorder narrates health rewinds distinctly from crash restarts.
 
 :class:`Supervisor` relaunches either a subprocess command (real isolation
 — a hung child is killed, a crashed child cannot corrupt the parent) or an
@@ -143,7 +158,8 @@ class Supervisor:
             self.restarts += 1
             delay = self.policy.delay(self.restarts)
             self._event("supervisor_restart", attempt=self.restarts,
-                        exit_code=rc, backoff_s=round(delay, 3))
+                        exit_code=rc, backoff_s=round(delay, 3),
+                        health_rewinds=self._rewind_count())
             if self.ckpt_root and self.keep_n:
                 try:
                     from ...checkpoint import gc_checkpoints
@@ -152,6 +168,19 @@ class Supervisor:
                 except Exception:
                     pass
             time.sleep(delay)
+
+    def _rewind_count(self) -> Optional[int]:
+        """Health rewinds recorded under ``ckpt_root`` (None without one):
+        lets a restart event distinguish 'child crashed' from 'child asked
+        to rewind past poisoned data'."""
+        if not self.ckpt_root:
+            return None
+        try:
+            from ...health import RewindLedger
+
+            return len(RewindLedger(self.ckpt_root))
+        except Exception:
+            return None
 
     @staticmethod
     def _event(name: str, **data) -> None:

@@ -1,0 +1,32 @@
+"""paddle_tpu.distributed.health — training health guard.
+
+PR-2 made crashes survivable; this package defends against the failure
+mode that dominates long LLM pretraining runs: the process stays ALIVE
+while the optimizer state gets poisoned — NaN/Inf gradients, loss spikes,
+grad-norm blowups — and the run silently diverges for hours (reference:
+``FLAGS_check_nan_inf`` / ``nan_inf_utils_detail`` per-kernel checks; the
+north-star 7B run needs the full detect → skip → rewind loop).
+
+- :class:`SpikeDetector` — host-side statistical detector (rolling
+  median/MAD or EMA z-score over loss and grad-norm).
+- :class:`HealthPolicy` / :class:`HealthGuard` — the decide/recover state
+  machine; plugs into ``jit.TrainStep(health_guard=...)`` (device-side
+  fused isfinite probe + in-program skip), ``AmpScaler`` found-inf skips,
+  and ``StepMeter`` host feeds.
+- :class:`RewindLedger` / :class:`HealthError` — persistent record of
+  which data window triggered each rewind, so the supervisor-relaunched
+  run skips past the poisoned batches; repeated rewinds at the same step
+  fail loudly.
+
+Flight-recorder event kinds: ``health_skip`` (step withheld),
+``health_anomaly`` (finite spike), ``health_rewind`` (escalation → dump →
+exit 101), ``health_fast_forward`` (restart skipped the poisoned window).
+Env: ``PADDLE_TPU_HEALTH=0`` disables the guard.
+"""
+
+from .detector import SpikeDetector  # noqa: F401
+from .guard import REWIND_EXIT_CODE, HealthGuard, HealthPolicy  # noqa: F401
+from .ledger import LEDGER_NAME, HealthError, RewindLedger  # noqa: F401
+
+__all__ = ["SpikeDetector", "HealthGuard", "HealthPolicy", "HealthError",
+           "RewindLedger", "LEDGER_NAME", "REWIND_EXIT_CODE"]

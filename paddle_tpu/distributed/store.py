@@ -14,7 +14,15 @@ prefix), blocking commands (get/wait/barrier) parked on a condition
 variable server-side so clients need no polling. Values are bytes
 (base64-framed); the store also tracks per-key mtime so the elastic
 heartbeat layer can ask key ages without a shared filesystem (the gap
-called out in round-2 verdict missing #3: FileStore was NFS-bound)."""
+called out in round-2 verdict missing #3: FileStore was NFS-bound).
+
+Transport resilience: a socket error mid-call reconnects, and for
+idempotent commands (get/wait/set/compare_set and the reads) the
+in-flight request is transparently resent ONCE — a master blip during
+rendezvous no longer kills the job. Only CONNECTION failures retry; a
+recv deadline against a wedged-but-listening master surfaces immediately
+(retrying would double the detection latency), and add/barrier always
+surface the failure rather than risk a double count."""
 
 from __future__ import annotations
 
@@ -238,27 +246,50 @@ class TCPStore:
                         f"could not reach TCPStore at {host}:{port}")
                 time.sleep(0.1)
 
+    # commands safe to transparently resend after a transport failure: the
+    # reads, plus set (last-writer-wins) and compare_set (a retry after an
+    # applied first attempt observes cur == desired and applies nothing).
+    # add/barrier are NOT here — a replay double-counts.
+    _IDEMPOTENT = frozenset({"get", "wait", "set", "compare_set",
+                             "keys", "num_keys", "age"})
+
     def _call(self, **req) -> dict:
         # the socket's recv deadline must EXCEED the server-side command
         # window (get/wait/barrier block up to their own timeout before the
         # server replies); if it fired first the reply would stay queued and
         # desync the framed protocol for every later call
         cmd_timeout = float(req.get("timeout") or self.timeout)
+        # one bounded transparent retry for idempotent commands: a master
+        # blip (restart, dropped connection) mid-rendezvous reconnects and
+        # resends instead of killing the job; non-idempotent commands
+        # (add/barrier/delete) still fail fast after reconnecting
+        attempts = 2 if req.get("cmd") in self._IDEMPOTENT else 1
         with self._lock:
-            try:
-                self._sock.settimeout(cmd_timeout + 10.0)
-                _send_msg(self._sock, req)
-                resp = _recv_msg(self._sock)
-            except (socket.timeout, OSError):
-                # connection state unknown — reconnect so later calls see a
-                # clean stream instead of a stale reply
+            resp = None
+            for attempt in range(attempts):
                 try:
-                    self._sock.close()
-                except OSError:
-                    pass
-                self._sock = self._connect(self._connect_host, self.port,
-                                           self.timeout)
-                raise TimeoutError(f"store call {req.get('cmd')} timed out")
+                    self._sock.settimeout(cmd_timeout + 10.0)
+                    _send_msg(self._sock, req)
+                    resp = _recv_msg(self._sock)
+                    break
+                except (socket.timeout, OSError) as e:
+                    # connection state unknown — reconnect so later calls
+                    # see a clean stream instead of a stale reply
+                    # (_connect polls the address up to self.timeout, so a
+                    # restarting master has that long to come back)
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = self._connect(self._connect_host, self.port,
+                                               self.timeout)
+                    # retry only CONNECTION failures (the master-blip case);
+                    # a recv deadline against a listening-but-wedged master
+                    # (socket.timeout) would just wait the full window again
+                    if isinstance(e, socket.timeout) or \
+                            attempt == attempts - 1:
+                        raise TimeoutError(
+                            f"store call {req.get('cmd')} timed out")
         if "error" in resp:
             if resp["error"] == "timeout":
                 raise TimeoutError(resp.get("detail", ""))

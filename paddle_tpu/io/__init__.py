@@ -210,6 +210,18 @@ class WeightedRandomSampler(Sampler):
 
 
 class BatchSampler(Sampler):
+    """Index batcher with resumable position (health-guard rewind support):
+    ``state_dict()``/``set_state_dict()`` capture ``(epoch, position)`` —
+    position = batches already yielded this epoch — so a checkpoint can
+    pin the data stream and a restart resumes mid-epoch deterministically
+    (the index stream must itself be deterministic: seeded shuffle, or the
+    epoch-seeded :class:`DistributedBatchSampler`). ``fast_forward(n)``
+    additionally skips the next ``n`` batches — how a supervisor-restarted
+    run steps past a poisoned data window instead of replaying it.
+    Prefetching DataLoader paths materialize the epoch's indices up front
+    and re-track position per DELIVERED batch instead (see
+    ``DataLoader._track_position``), so snapshots are exact there too."""
+
     def __init__(self, dataset=None, sampler=None, shuffle: bool = False, batch_size: int = 1,
                  drop_last: bool = False):
         super().__init__(dataset)
@@ -221,8 +233,41 @@ class BatchSampler(Sampler):
             self.sampler = SequenceSampler(dataset)
         self.batch_size = batch_size
         self.drop_last = drop_last
+        self.epoch = 0
+        self._position = 0
+        self._resume_from = 0
 
-    def __iter__(self):
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = int(epoch)
+
+    # -- resumable-position protocol ---------------------------------------
+    def state_dict(self) -> dict:
+        return {"epoch": int(self.epoch), "position": int(self._position)}
+
+    def set_state_dict(self, state: dict) -> None:
+        self.set_epoch(state.get("epoch", 0))
+        self._resume_from = int(state.get("position", 0))
+        self._position = self._resume_from
+
+    def fast_forward(self, n_batches: int) -> None:
+        """Skip ``n_batches`` beyond the current/restored position when the
+        next epoch iteration starts."""
+        self._resume_from = self._position + int(n_batches)
+        self._position = self._resume_from
+
+    def _positioned(self, gen):
+        """Skip up to the resume point, then track yielded-batch count."""
+        start, self._resume_from = self._resume_from, 0
+        n = 0
+        for batch in gen:
+            n += 1
+            if n <= start:
+                continue
+            self._position = n
+            yield batch
+        self._position = 0  # epoch exhausted; caller owns set_epoch
+
+    def _gen_batches(self):
         batch = []
         for idx in self.sampler:
             batch.append(idx)
@@ -231,6 +276,9 @@ class BatchSampler(Sampler):
                 batch = []
         if batch and not self.drop_last:
             yield batch
+
+    def __iter__(self):
+        return self._positioned(self._gen_batches())
 
     def __len__(self):
         n = len(self.sampler)
@@ -261,13 +309,18 @@ class DistributedBatchSampler(BatchSampler):
         self.shuffle = shuffle
         self.drop_last = drop_last
         self.epoch = 0
+        self._position = 0
+        self._resume_from = 0
         self.num_samples = int(np.ceil(len(dataset) / num_replicas))
         self.total_size = self.num_samples * num_replicas
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
 
-    def __iter__(self):
+    def _gen_batches(self):
+        # epoch-seeded shuffle: the index stream is a pure function of
+        # (epoch, rank), which is what makes the inherited state_dict /
+        # fast_forward resume deterministic across a restart
         n = len(self.dataset)
         indices = np.arange(n)
         if self.shuffle:
@@ -283,6 +336,9 @@ class DistributedBatchSampler(BatchSampler):
                 batch = []
         if batch and not self.drop_last:
             yield batch
+
+    def __iter__(self):
+        return self._positioned(self._gen_batches())
 
     def __len__(self):
         if self.drop_last:
@@ -561,11 +617,36 @@ class DataLoader:
             raise TypeError("IterableDataset DataLoader has no len()")
         return len(self.batch_sampler)
 
+    def state_dict(self) -> dict:
+        """Resumable data-stream position (delegates to the batch
+        sampler) — include it in the training checkpoint payload so a
+        post-rewind resume is deterministic in the data stream.
+        IterableDataset loaders have no position: empty dict. Position
+        counts batches DELIVERED to the consumer — exact for the sync
+        path, and re-tracked per delivery under prefetching workers
+        (batches a worker computed ahead but never handed over do not
+        count as consumed)."""
+        bs = self.batch_sampler
+        if bs is None or not hasattr(bs, "state_dict"):
+            return {}
+        return bs.state_dict()
+
+    def set_state_dict(self, state: dict) -> None:
+        bs = self.batch_sampler
+        if state and bs is not None and hasattr(bs, "set_state_dict"):
+            bs.set_state_dict(state)
+
     def __iter__(self) -> Iterator:
         if self._iterable_mode:
             return self._iter_iterable()
         if self.num_workers == 0:
             return self._iter_sync()
+        # the prefetching paths materialize the epoch's index list up
+        # front, which runs the sampler's own position tracking to
+        # exhaustion — re-track position at DELIVERY granularity so
+        # state_dict() stays exact (and rewind fast-forward lands on the
+        # right batch) under workers too
+        start = getattr(self.batch_sampler, "_resume_from", 0)
         if self.use_process_workers:
             try:
                 gen = self._iter_processes()  # spawn failures surface HERE
@@ -576,9 +657,27 @@ class DataLoader:
                 logging.getLogger("paddle_tpu.io").warning(
                     "process workers unavailable (%s); falling back to "
                     "threads", e)
+                # the failed process path already consumed the sampler's
+                # resume offset when it materialized the index list —
+                # restore it so the threaded re-list resumes at the same
+                # batch instead of replaying the epoch head
+                if hasattr(self.batch_sampler, "_resume_from"):
+                    self.batch_sampler._resume_from = start
             else:
-                return self._wrap_process_iter(gen)
-        return self._iter_threaded()
+                return self._track_position(self._wrap_process_iter(gen),
+                                            start)
+        return self._track_position(self._iter_threaded(), start)
+
+    def _track_position(self, gen, start: int):
+        """Mirror delivered-batch count into the batch sampler's position
+        (its own counter was exhausted by the up-front materialization)."""
+        bs = self.batch_sampler
+        n = start
+        for item in gen:
+            n += 1
+            bs._position = n
+            yield item
+        bs._position = 0  # epoch delivered in full
 
     def _wrap_process_iter(self, gen):
         """Mid-iteration escape hatch: a worker that produced an

@@ -261,13 +261,21 @@ class TrainStep:
 
         step = TrainStep(model, lambda model, x, y: loss_fn(model(x), y), opt)
         loss = step(x, y)   # Tensor; model/optimizer state updated in place
+
+    ``health_guard=`` (a :class:`~paddle_tpu.distributed.health.HealthGuard`)
+    arms the fused anomaly probe: one in-program isfinite + grad-norm
+    reduction, and a non-finite step is SKIPPED in-program (old params /
+    opt-state / buffers selected back) instead of applied — the detect
+    layer of the detect → skip → rewind loop.
     """
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer, donate: bool = True,
-                 gradient_merge: Optional[int] = None):
+                 gradient_merge: Optional[int] = None, health_guard=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
+        self._donate = donate
+        self._health_guard = health_guard
         # gradient merge (reference `auto_parallel_gradient_merge.py`): run k
         # micro-steps accumulating grads IN-JIT, update once; k defaults from
         # the fleet strategy tag stamped by distributed_optimizer
@@ -296,6 +304,26 @@ class TrainStep:
         # the old params/opt-state must still be alive.
         self._compiled_checked = jax.jit(
             functools.partial(self._step, check_numerics=True))
+
+    # -- health guard ------------------------------------------------------
+    def attach_health_guard(self, guard) -> None:
+        """Arm a :class:`~paddle_tpu.distributed.health.HealthGuard` on an
+        already-built step (the ``health_guard=`` ctor arg is equivalent).
+        The next call traces the guarded program variant."""
+        self._health_guard = guard
+
+    def _make_guarded_jit(self):
+        """Compiled variant with the fused health probe. Donation is safe:
+        a skipped step's old state feeds the in-program select, never a
+        post-hoc host decision (DistributedTrainStep pins shardings)."""
+        return jax.jit(functools.partial(self._step, health_probe=True),
+                       donate_argnums=(0, 1) if self._donate else ())
+
+    def _get_guarded(self):
+        c = getattr(self, "_compiled_guarded", None)
+        if c is None:
+            c = self._compiled_guarded = self._make_guarded_jit()
+        return c
 
     # -- functional pieces -------------------------------------------------
     def _clip_grads(self, grads):
@@ -330,7 +358,7 @@ class TrainStep:
         return arrays
 
     def _step(self, param_arrays, opt_states, buffer_arrays, key, lr, batch_arrays,
-              check_numerics: bool = False):
+              check_numerics: bool = False, health_probe: bool = False):
         if getattr(self, "offload", False):
             # offloaded states arrive in host memory; TPU arithmetic cannot
             # mix memory spaces, so stream them to device here — the update's
@@ -380,6 +408,17 @@ class TrainStep:
         if check_numerics:
             finite = jnp.stack([jnp.isfinite(loss)] +
                                [jnp.all(jnp.isfinite(g)) for g in grads])
+        ok = gnorm = None
+        if health_probe:
+            # fused device-side anomaly probe (health guard): ONE isfinite
+            # reduction over loss + raw (pre-clip) grads, plus the global
+            # grad norm the host-side SpikeDetector consumes — all inside
+            # this program, no host sync added
+            ok = jnp.isfinite(loss)
+            for g in grads:
+                ok &= jnp.all(jnp.isfinite(g))
+            gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                                 for g in grads))
         grads = self._clip_grads(grads)
         new_params, new_states = [], []
         for i, (p_arr, g, st) in enumerate(zip(compute_params, grads, opt_states)):
@@ -407,6 +446,26 @@ class TrainStep:
             new_states.append(ns)
         if check_numerics:
             return loss, new_params, new_states, new_buf, finite
+        if health_probe:
+            # skip-and-count: a non-finite step must not poison ANY state —
+            # select old params/opt-states/buffers in-program (scalar-pred
+            # selects fuse to ~free); the probe rides back as 3 floats
+            def _sel(new, old):
+                return jnp.where(ok, new, old)
+
+            new_params = [_sel(n, o) for n, o in zip(new_params, param_arrays)]
+            sel_states = []
+            for st_new, st_old, m in zip(new_states, opt_states, masters):
+                old = dict(st_old)
+                if m is not None:
+                    old["@master"] = m
+                sel_states.append({k: _sel(v, old[k])
+                                   for k, v in st_new.items()})
+            new_states = sel_states
+            new_buf = [_sel(n, o) for n, o in zip(new_buf, buffer_arrays)]
+            probe = jnp.stack([loss.astype(jnp.float32),
+                               ok.astype(jnp.float32), gnorm])
+            return loss, new_params, new_states, new_buf, probe
         return loss, new_params, new_states, new_buf
 
     # -- state marshalling -------------------------------------------------
@@ -444,7 +503,15 @@ class TrainStep:
                         f"gradient_merge k={self._merge_k} needs every batch "
                         f"arg's dim0 divisible by k, got shape {a.shape}")
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        if get_flags("check_nan_inf")["check_nan_inf"]:
+        guard = self._health_guard
+        probe = None
+        if guard is not None and guard.active:
+            # guarded path wins over check_nan_inf: it subsumes the check
+            # (detects the same non-finites) and recovers instead of raising
+            loss, new_params, new_states, new_buf, probe = self._get_guarded()(
+                param_arrays, states, buffer_arrays, next_key(), lr,
+                batch_arrays)
+        elif get_flags("check_nan_inf")["check_nan_inf"]:
             loss, new_params, new_states, new_buf, finite = self._compiled_checked(
                 param_arrays, states, buffer_arrays, next_key(), lr, batch_arrays)
             flags = list(map(bool, finite))
@@ -468,6 +535,11 @@ class TrainStep:
             b._value = arr
             b._producer = None
         self.optimizer._step_count += 1
+        if probe is not None:
+            # state is already rebound (skips selected in-program); the
+            # guard resolves the probe max_lag steps late and may raise
+            # SystemExit(101) here to hand control to the Supervisor
+            guard.on_step(probe, step=self.optimizer._step_count)
         try:  # telemetry: step event for the flight recorder + prometheus.
             # No host sync here — loss stays a device value.
             from .. import telemetry

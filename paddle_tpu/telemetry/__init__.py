@@ -9,8 +9,9 @@ host side is first-class because XLA owns the device):
   engines register :class:`TracedProgram` profiles with execution counters;
   collectives traced inside someone else's jit are tagged ``trace_time``.
 - **step metrics** — :class:`StepMeter`: tokens/s, achieved MFU/MBU from a
-  FLOP/byte model, loss/grad-norm, JSONL emission, Prometheus text export
-  via :func:`prometheus_text`.
+  FLOP/byte model, loss/grad-norm, skipped-step counters (health guard /
+  AMP found-inf), JSONL emission, Prometheus text export via
+  :func:`prometheus_text`.
 - **memory watermarks** — :func:`hbm_watermarks` / :func:`hbm_stats`:
   per-device live/peak/limit HBM from PJRT memory stats (CPU: graceful
   zeros).

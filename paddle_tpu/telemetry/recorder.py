@@ -17,9 +17,13 @@ The resilience stack narrates its lifecycle into the ring:
 ``checkpoint_save`` / ``checkpoint_load`` / ``checkpoint_save_failed`` (a
 background async writer died — also re-raised at the next save/wait) /
 ``checkpoint_io_retry`` / ``checkpoint_gc``, ``fault_injected`` (chaos
-tests), ``preemption_exit`` / ``emergency_checkpoint``, and ``supervisor``
-start/restart/giveup/done events — so a dump reads as the story of how the
-process got where it is.
+tests), ``preemption_exit`` / ``emergency_checkpoint``, ``supervisor``
+start/restart/giveup/done events, and the numerical-health kinds —
+``health_skip`` (update withheld for a NaN/Inf step), ``health_anomaly``
+(finite loss/grad-norm spike), ``health_rewind`` (escalation: the dump you
+are reading may BE that dump), ``health_fast_forward`` (restart skipped a
+poisoned data window) — so a dump reads as the story of how the process
+got where it is.
 
 Ring size: ``PADDLE_TPU_FLIGHT_RECORDER_SIZE`` (default 512). Dump dir:
 ``PADDLE_TPU_FLIGHT_RECORDER_DIR`` (default ``flight_recorder/``).

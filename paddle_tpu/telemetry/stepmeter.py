@@ -1,5 +1,7 @@
 """StepMeter: per-step training metrics — tokens/s, achieved MFU/MBU from a
-FLOP/byte model, loss/grad-norm, HBM watermarks, per-step collective bytes.
+FLOP/byte model, loss/grad-norm, HBM watermarks, per-step collective bytes,
+and training-health columns (``skipped`` / ``steps_skipped``; an optional
+``health_guard`` feeds the spike detector from the same values).
 
 Driven by the training loop (and bench.py)::
 
@@ -55,8 +57,15 @@ class StepMeter:
                  bytes_per_step: Optional[float] = None,
                  jsonl_path: Optional[str] = None,
                  peak_tflops: Optional[float] = None,
-                 peak_hbm_gbps: Optional[float] = None):
+                 peak_hbm_gbps: Optional[float] = None,
+                 health_guard=None):
         self.name = name
+        # optional training-health feed: when set, every step(loss=...,
+        # grad_norm=...) also drives the guard's host-side SpikeDetector —
+        # the eager-loop twin of the TrainStep device probe (attach the
+        # guard to ONE of the two, not both, or anomalies double-count)
+        self.health_guard = health_guard
+        self.steps_skipped = 0
         self.tokens_per_step = tokens_per_step
         self.samples_per_step = samples_per_step
         if flops_per_step is None and model_params and tokens_per_step:
@@ -116,10 +125,14 @@ class StepMeter:
              grad_norm: Optional[float] = None,
              tokens: Optional[float] = None,
              samples: Optional[float] = None,
+             skipped: Optional[bool] = None,
              **extra) -> Dict[str, Any]:
         """Close the current step: compute rates since the previous call and
         emit one record. ``tokens``/``samples`` override the per-step
-        defaults for variable-size batches."""
+        defaults for variable-size batches. ``skipped=True`` marks a step
+        whose update was withheld (health guard / AMP found-inf) — counted
+        into ``steps_skipped`` so a silent-skip regression is visible in
+        the JSONL trail and the summary."""
         now = time.perf_counter()
         dt = now - self._t_last
         self._t_last = now
@@ -145,6 +158,19 @@ class StepMeter:
             rec["loss"] = float(loss)
         if grad_norm is not None:
             rec["grad_norm"] = float(grad_norm)
+        if skipped is not None:
+            rec["skipped"] = bool(skipped)
+            if skipped:
+                self.steps_skipped += 1
+                runtime.bump("steps_skipped_total")
+        if self.health_guard is not None and loss is not None:
+            # NOT wrapped in the telemetry never-raises shield: the guard
+            # is training control, and an escalation raised here
+            # (SystemExit(101), HealthError, a custom on_escalate) must
+            # reach the training loop, not vanish into a metrics call
+            self.health_guard.observe_host(self.step_num, float(loss),
+                                           grad_norm)
+        rec["steps_skipped"] = self.steps_skipped
 
         wm = hbm_watermarks()
         rec["hbm_live_gb"] = wm["live_gb"]
@@ -219,6 +245,7 @@ class StepMeter:
         out["hbm_peak_gb"] = self._hbm_peak_gb
         out["hbm_live_max_gb"] = self._hbm_live_max_gb
         out["collective_bytes"] = dict(self._coll_agg)
+        out["steps_skipped"] = self.steps_skipped
         if self._first_loss is not None:
             out["first_loss"] = self._first_loss
             out["final_loss"] = self._last_loss

@@ -1,0 +1,586 @@
+"""Training health guard: detect → skip → rewind (chaos suite).
+
+The loop PR 2 left open: NaN/Inf grads and loss spikes no longer poison a
+live run. Covers the device-side fused probe in ``jit.TrainStep`` (skip =
+in-program select, params untouched), the host-side ``SpikeDetector``,
+the ``HealthPolicy`` escalation window, the persisted ``RewindLedger``
+(skip-past-poisoned-window on restart, ``HealthError`` on a rewind loop),
+the fused ``AmpScaler`` unscale feeding the same counters, resumable
+samplers, and the end-to-end NaN-batch → skip → escalate → exit 101 →
+Supervisor relaunch → resume-past-the-bad-window run under real process
+isolation."""
+
+import json
+import math
+import os
+import sys
+import textwrap
+
+import jax
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.chaos
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed.health import (LEDGER_NAME, HealthError,
+                                           HealthGuard, HealthPolicy,
+                                           RewindLedger, SpikeDetector)
+from paddle_tpu.distributed.fleet.elastic import (ELASTIC_EXIT_CODE,
+                                                  RestartPolicy, Supervisor)
+from paddle_tpu.io import BatchSampler, DistributedBatchSampler
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _policy(**kw):
+    kw.setdefault("escalate_after", 3)
+    kw.setdefault("window", 20)
+    kw.setdefault("cooldown", 5)
+    kw.setdefault("max_lag", 0)
+    kw.setdefault("min_history", 10 ** 6)  # statistical detector off
+    return HealthPolicy(**kw)
+
+
+class TestSpikeDetector:
+    def test_flags_spike_after_warmup_and_recovers(self):
+        det = SpikeDetector(window=64, min_history=8, loss_zmax=6.0)
+        for i in range(8):
+            assert det.observe(loss=1.0 + 0.01 * (i % 3)) is None
+        reason = det.observe(loss=50.0)
+        assert reason is not None and reason.startswith("loss_spike")
+        # the spike was not absorbed: normal losses stay healthy after it
+        assert det.observe(loss=1.01) is None
+
+    def test_grad_norm_series_is_independent(self):
+        det = SpikeDetector(window=64, min_history=4, grad_zmax=6.0)
+        for _ in range(6):
+            assert det.observe(loss=2.0, grad_norm=1.0) is None
+        r = det.observe(loss=2.0, grad_norm=1e6)
+        assert r is not None and r.startswith("grad_norm_spike")
+
+    def test_nonfinite_and_warmup_samples_never_flag(self):
+        det = SpikeDetector(min_history=4)
+        assert det.observe(loss=float("nan")) is None  # probe's job, not ours
+        assert det.observe(loss=1e9) is None  # still warming up
+
+    def test_flat_history_does_not_explode_z(self):
+        det = SpikeDetector(min_history=4, loss_zmax=6.0)
+        for _ in range(6):
+            det.observe(loss=1.0)  # MAD == 0
+        assert det.observe(loss=1.001) is None  # scale floor absorbs noise
+
+
+class TestHealthPolicyStateMachine:
+    def test_escalates_after_k_anomalies_in_window(self):
+        hits = []
+        g = HealthGuard(_policy(escalate_after=3, window=10),
+                        on_escalate=hits.append)
+        for s in range(1, 3):
+            g.observe_host(s, float("nan"))
+        assert not hits
+        g.observe_host(3, float("nan"))
+        assert len(hits) == 1 and hits[0]["window"] == [0, 3]
+
+    def test_old_anomalies_age_out_of_window(self):
+        hits = []
+        g = HealthGuard(_policy(escalate_after=2, window=3, cooldown=100),
+                        on_escalate=hits.append)
+        g.observe_host(1, float("nan"))
+        for s in range(2, 8):
+            g.observe_host(s, 1.0)
+        g.observe_host(8, float("nan"))  # step 1 aged out: count is 1
+        assert not hits
+
+    def test_cooldown_clears_the_anomaly_record(self):
+        hits = []
+        g = HealthGuard(_policy(escalate_after=2, window=100, cooldown=3),
+                        on_escalate=hits.append)
+        g.observe_host(1, float("nan"))
+        for s in range(2, 6):
+            g.observe_host(s, 1.0)  # >= cooldown clean steps
+        g.observe_host(6, float("nan"))
+        assert not hits and g.anomalies == 2
+
+    def test_step_domain_stays_monotonic_after_restart(self, tmp_path):
+        """A relaunched run whose meter/optimizer counters restart at 1
+        must not produce backward step jumps: stale anomalies age out of
+        the window and ledger windows start at the resume anchor."""
+        hits = []
+        g = HealthGuard(_policy(escalate_after=3, window=5, cooldown=100),
+                        root=str(tmp_path), on_escalate=hits.append)
+        g.on_restart(100)
+        g.observe_host(1, float("nan"))  # fresh counter: normalized 101
+        for s in range(2, 100):
+            g.observe_host(s, 1.0)  # crosses the raw==anchor boundary
+        assert g._last_step == 199  # no backward jump at raw step 100
+        g.observe_host(100, float("nan"))
+        g.observe_host(101, float("nan"))
+        # the step-101 anomaly aged out of window=5 long ago: no escalation
+        assert not hits and len(g._anomaly_steps) == 2
+        g.observe_host(102, float("nan"))
+        assert len(hits) == 1
+        assert hits[0]["window"] == [100, 202]  # anchored at the resume step
+
+    def test_env_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_HEALTH", "0")
+        g = HealthGuard(_policy(escalate_after=1), on_escalate="raise")
+        assert not g.active
+        g.observe_host(1, float("nan"))
+        assert g.steps_seen == 0 and g.anomalies == 0
+
+
+class TestRewindLedger:
+    def test_record_persist_reload(self, tmp_path):
+        root = str(tmp_path)
+        led = RewindLedger(root)
+        led.record(step=7, resume_step=4, reason="non_finite")
+        doc = json.load(open(os.path.join(root, LEDGER_NAME)))
+        assert doc["rewinds"][0]["window"] == [4, 7]
+        led2 = RewindLedger(root)
+        assert len(led2) == 1 and led2.skip_ahead(4) == 3
+        assert led2.skip_ahead(9) == 0
+
+    def test_check_restart_fails_loudly_on_rewind_loop(self, tmp_path):
+        led = RewindLedger(str(tmp_path))
+        led.record(step=7, resume_step=4, reason="non_finite")
+        assert led.check_restart(4, max_rewinds=2) == 3
+        led.record(step=6, resume_step=4, reason="loss_spike z=9.0")
+        with pytest.raises(HealthError) as ei:
+            led.check_restart(4, max_rewinds=2)
+        assert "[4, 6]" in str(ei.value) and "step 4" in str(ei.value)
+
+    def test_unreadable_ledger_degrades_to_empty(self, tmp_path):
+        p = tmp_path / LEDGER_NAME
+        p.write_text("{not json")
+        led = RewindLedger(str(tmp_path))
+        assert led.entries() == [] and led.check_restart(0) == 0
+
+    def test_in_memory_mode_needs_no_filesystem(self):
+        led = RewindLedger(None)
+        led.record(step=3, resume_step=0, reason="x")
+        assert len(led) == 1 and led.skip_ahead(0) == 3
+
+
+def _tiny_step(guard, lr=1e-2):
+    paddle.seed(0)
+    model = nn.Linear(8, 4)
+    opt = paddle.optimizer.SGD(lr, parameters=model.parameters())
+    step = paddle.jit.TrainStep(model, lambda m, x, y: F.mse_loss(m(x), y),
+                                opt, health_guard=guard)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((4, 8)).astype("float32")
+    y = rng.standard_normal((4, 4)).astype("float32")
+    return model, step, x, y
+
+
+class TestTrainStepProbe:
+    def test_nan_batch_skipped_in_program_then_recovers(self):
+        guard = HealthGuard(_policy(escalate_after=10), on_escalate="raise")
+        model, step, x, y = _tiny_step(guard)
+        step(paddle.to_tensor(x), paddle.to_tensor(y))
+        w1 = np.asarray(model.weight.numpy()).copy()
+        xn = x.copy()
+        xn[0, 0] = np.nan
+        loss = step(paddle.to_tensor(xn), paddle.to_tensor(y))
+        assert math.isnan(float(loss))  # loss reported honestly
+        # params, opt state, buffers untouched by the poisoned step
+        np.testing.assert_array_equal(w1, np.asarray(model.weight.numpy()))
+        assert guard.steps_skipped == 1 and guard.anomalies == 1
+        # healthy step after the skip trains again
+        step(paddle.to_tensor(x), paddle.to_tensor(y))
+        assert not np.allclose(w1, np.asarray(model.weight.numpy()))
+        assert guard.steps_skipped == 1
+
+    def test_healthy_run_counts_zero_skips(self):
+        guard = HealthGuard(_policy(), on_escalate="raise")
+        model, step, x, y = _tiny_step(guard)
+        losses = [float(step(paddle.to_tensor(x), paddle.to_tensor(y)))
+                  for _ in range(5)]
+        guard.flush()
+        assert guard.steps_skipped == 0 and guard.anomalies == 0
+        assert all(math.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]  # it actually trains
+
+    def test_escalation_raises_for_inprocess_callers(self):
+        guard = HealthGuard(_policy(escalate_after=2), on_escalate="raise")
+        model, step, x, y = _tiny_step(guard)
+        xn = x.copy()
+        xn[:] = np.inf
+        with pytest.raises(HealthError, match="escalated"):
+            for _ in range(4):
+                step(paddle.to_tensor(xn), paddle.to_tensor(y))
+        assert guard.rewinds == 1 and len(guard.ledger) == 1
+
+    def test_lagged_probe_defers_but_never_loses_verdicts(self):
+        guard = HealthGuard(_policy(escalate_after=100, max_lag=3),
+                            on_escalate="raise")
+        model, step, x, y = _tiny_step(guard)
+        xn = x.copy()
+        xn[0, 0] = np.nan
+        step(paddle.to_tensor(xn), paddle.to_tensor(y))
+        assert guard.steps_skipped == 0  # verdict still pending (lag 3)
+        w = np.asarray(model.weight.numpy()).copy()
+        for _ in range(3):
+            step(paddle.to_tensor(x), paddle.to_tensor(y))
+        assert guard.steps_skipped == 1  # resolved once it aged past the lag
+        guard.flush()
+        assert guard.steps_seen == 4
+        # the skip itself was immediate (in-program): weights at the NaN
+        # step equal the pre-step weights regardless of host lag
+        assert not np.allclose(w, np.asarray(model.weight.numpy()))
+
+    def test_distributed_step_probe_pins_shardings(self):
+        """The guarded variant of DistributedTrainStep must compile with
+        the SAME pinned state shardings as the plain step: skip a NaN
+        batch in-program under dp2 x sharding4, then keep training."""
+        from paddle_tpu.distributed import DistributedTrainStep, topology
+        from paddle_tpu.distributed.fleet import DistributedStrategy, Fleet
+
+        saved = topology.get_hybrid_communicate_group()
+        try:
+            strategy = DistributedStrategy()
+            strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                                       "pp_degree": 1, "sharding_degree": 4}
+            f = Fleet()
+            f.init(is_collective=True, strategy=strategy)
+            paddle.seed(0)
+            model = nn.Linear(16, 8)
+            opt = paddle.optimizer.AdamW(1e-2,
+                                         parameters=model.parameters())
+            guard = HealthGuard(_policy(escalate_after=10),
+                                on_escalate="raise")
+            step = DistributedTrainStep(
+                model, lambda m, x, y: F.mse_loss(m(x), y), opt, f._hcg,
+                sharding_stage=1, health_guard=guard)
+            rng = np.random.default_rng(0)
+            x = rng.standard_normal((16, 16)).astype("float32")
+            y = rng.standard_normal((16, 8)).astype("float32")
+            step(paddle.to_tensor(x), paddle.to_tensor(y))
+            w = np.asarray(jax.device_get(model.weight._value)).copy()
+            xn = x.copy()
+            xn[3, 3] = np.inf
+            step(paddle.to_tensor(xn), paddle.to_tensor(y))
+            np.testing.assert_array_equal(
+                w, np.asarray(jax.device_get(model.weight._value)))
+            assert guard.steps_skipped == 1
+            loss = step(paddle.to_tensor(x), paddle.to_tensor(y))
+            assert math.isfinite(float(loss))
+            assert not np.allclose(
+                w, np.asarray(jax.device_get(model.weight._value)))
+        finally:
+            topology._hcg = saved
+
+    def test_check_nan_inf_flag_still_raises_without_guard(self):
+        model, step, x, y = _tiny_step(None)
+        xn = x.copy()
+        xn[:] = np.nan
+        paddle.set_flags({"check_nan_inf": True})
+        try:
+            with pytest.raises(RuntimeError, match="check_nan_inf"):
+                step(paddle.to_tensor(xn), paddle.to_tensor(y))
+        finally:
+            paddle.set_flags({"check_nan_inf": False})
+
+
+class TestAmpScalerFusedUnscale:
+    def test_single_reduction_skip_feeds_guard(self):
+        guard = HealthGuard(_policy(escalate_after=100), on_escalate="raise")
+        paddle.seed(0)
+        m = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(0.1, parameters=m.parameters())
+        sc = paddle.amp.AmpScaler(enable=True, init_loss_scaling=4.0)
+        sc.attach_health_guard(guard)
+        x = paddle.to_tensor(np.ones((2, 4), "float32"))
+        loss = sc.scale(m(x).sum())
+        loss.backward()
+        m.weight._grad = paddle.to_tensor(
+            np.full((4, 4), np.inf, "float32"))
+        w = np.asarray(m.weight.numpy()).copy()
+        sc.step(opt)
+        np.testing.assert_array_equal(w, np.asarray(m.weight.numpy()))
+        assert guard.steps_skipped == 1
+        assert sc.get_loss_scaling() == 2.0  # dynamic scale halved
+
+    def test_healthy_unscale_division_exact(self):
+        paddle.seed(0)
+        m = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(0.1, parameters=m.parameters())
+        sc = paddle.amp.GradScaler(enable=True, init_loss_scaling=8.0)
+        x = paddle.to_tensor(np.ones((2, 4), "float32"))
+        loss = sc.scale(m(x).sum())
+        loss.backward()
+        g_scaled = np.asarray(m.weight._grad.numpy()).copy()
+        sc.unscale_(opt)
+        np.testing.assert_allclose(np.asarray(m.weight._grad.numpy()),
+                                   g_scaled / 8.0, rtol=1e-6)
+
+
+class TestSamplerStateDict:
+    def test_batch_sampler_mid_epoch_resume(self):
+        class DS:
+            def __len__(self):
+                return 20
+
+            def __getitem__(self, i):
+                return i
+
+        bs = BatchSampler(DS(), batch_size=4, drop_last=True)
+        full = list(bs)
+        it = iter(bs)
+        next(it), next(it)
+        st = bs.state_dict()
+        assert st == {"epoch": 0, "position": 2}
+        res = BatchSampler(DS(), batch_size=4, drop_last=True)
+        res.set_state_dict(st)
+        assert list(res) == full[2:]
+
+    def test_fast_forward_skips_poisoned_window(self):
+        class DS:
+            def __len__(self):
+                return 20
+
+            def __getitem__(self, i):
+                return i
+
+        bs = BatchSampler(DS(), batch_size=4, drop_last=True)
+        full = list(bs)
+        res = BatchSampler(DS(), batch_size=4, drop_last=True)
+        res.set_state_dict({"epoch": 0, "position": 1})
+        res.fast_forward(2)
+        assert list(res) == full[3:]
+
+    def test_distributed_sampler_epoch_seeded_resume(self):
+        class DS:
+            def __len__(self):
+                return 17
+
+            def __getitem__(self, i):
+                return i
+
+        a = DistributedBatchSampler(DS(), batch_size=2, num_replicas=2,
+                                    rank=1, shuffle=True)
+        a.set_epoch(5)
+        full = list(a)
+        b = DistributedBatchSampler(DS(), batch_size=2, num_replicas=2,
+                                    rank=1, shuffle=True)
+        b.set_state_dict({"epoch": 5, "position": 3})
+        assert list(b) == full[3:]
+
+    def test_worker_loader_tracks_delivered_position(self):
+        """Prefetching loaders materialize the epoch up front; position
+        must still count batches DELIVERED to the trainer, so a mid-epoch
+        snapshot + fast-forward under workers lands on the right batch."""
+        from paddle_tpu.io import DataLoader
+
+        class DS:
+            def __len__(self):
+                return 24
+
+            def __getitem__(self, i):
+                return np.float32(i)
+
+        def mk():
+            return DataLoader(DS(), batch_size=4, num_workers=2,
+                              use_process_workers=False)
+
+        full = [b.numpy().tolist() for b in mk()]
+        dl = mk()
+        it = iter(dl)
+        next(it), next(it), next(it)
+        assert dl.state_dict() == {"epoch": 0, "position": 3}
+        res = mk()
+        res.set_state_dict({"epoch": 0, "position": 3})
+        res.batch_sampler.fast_forward(1)  # skip one poisoned batch
+        assert [b.numpy().tolist() for b in res] == full[4:]
+        assert res.state_dict()["position"] == 0  # epoch delivered in full
+
+    def test_thread_fallback_preserves_resume_position(self):
+        """A process-worker spawn failure after the index materialization
+        must not lose the restored position: the threaded fallback resumes
+        at the same batch (Tensor-item datasets force exactly this path)."""
+        from paddle_tpu.io import DataLoader
+
+        class TensorDS:
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                return paddle.to_tensor(np.float32(i))  # forces fallback
+
+        def mk():
+            return DataLoader(TensorDS(), batch_size=4, num_workers=2,
+                              use_process_workers=True)
+
+        full = [b.numpy().tolist() for b in mk()]
+        res = mk()
+        res.set_state_dict({"epoch": 0, "position": 2})
+        assert [b.numpy().tolist() for b in res] == full[2:]
+
+    def test_state_rides_the_checkpoint_payload(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint import (latest_checkpoint,
+                                                       load_state_dict,
+                                                       save_state_dict)
+
+        state = {"w": paddle.to_tensor(np.arange(4, dtype="float32")),
+                 "sampler": {"epoch": 2, "position": 7}}
+        save_state_dict(state, str(tmp_path / "ck"),
+                        commit_extra={"health": {"steps_skipped": 1}})
+        dst = {"w": paddle.to_tensor(np.zeros(4, "float32")),
+               "sampler": {"epoch": 0, "position": 0}}
+        load_state_dict(dst, latest_checkpoint(str(tmp_path)))
+        assert dst["sampler"] == {"epoch": 2, "position": 7}
+        marker = json.load(open(tmp_path / "ck" / "COMMITTED"))
+        assert marker["health"] == {"steps_skipped": 1}
+
+
+CHILD_SCRIPT = """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed.checkpoint import (latest_checkpoint,
+    load_state_dict, save_state_dict)
+from paddle_tpu.distributed.health import HealthGuard, HealthPolicy
+from paddle_tpu.io import BatchSampler
+
+root, total, log = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+
+# deterministic dataset: 16 batches of 4; samples 12..19 (batches 3 and 4)
+# are the poisoned window
+rng = np.random.default_rng(7)
+X = rng.standard_normal((64, 8)).astype("float32")
+Y = rng.standard_normal((64, 4)).astype("float32")
+X[12:20] = np.nan
+
+class DS:
+    def __len__(self): return 64
+    def __getitem__(self, i): return i
+
+paddle.seed(0)
+model = nn.Linear(8, 4)
+opt = paddle.optimizer.SGD(1e-2, parameters=model.parameters())
+guard = HealthGuard(HealthPolicy(escalate_after=2, window=8, cooldown=4,
+                                 max_lag=0, min_history=10**6), root=root)
+step = paddle.jit.TrainStep(model, lambda m, x, y: F.mse_loss(m(x), y), opt,
+                            health_guard=guard)
+sampler = BatchSampler(DS(), batch_size=4, drop_last=True)
+
+cur = 0
+resume = latest_checkpoint(root)
+if resume:
+    state = {**model.state_dict(),
+             "step": paddle.to_tensor(np.int64(0)),
+             "sampler": {"epoch": 0, "position": 0}}
+    load_state_dict(state, resume)
+    cur = int(np.asarray(state["step"].numpy()))
+    sampler.set_state_dict(state["sampler"])
+    skipped = guard.on_restart(cur, sampler=sampler)  # HealthError on loop
+    with open(log, "a") as f:
+        f.write(f"resumed:{cur}:skip{skipped}\\n")
+
+for batch_idx in sampler:
+    if cur >= total:
+        break
+    xb, yb = X[batch_idx], Y[batch_idx]
+    loss = step(paddle.to_tensor(xb), paddle.to_tensor(yb))  # may exit 101
+    cur += 1
+    with open(log, "a") as f:
+        f.write(f"{cur}:{batch_idx[0]}:{float(loss.numpy()):.4f}\\n")
+    if cur % 2 == 0:
+        save_state_dict({**model.state_dict(),
+                         "step": paddle.to_tensor(np.int64(cur)),
+                         "sampler": sampler.state_dict()},
+                        os.path.join(root, f"step_{cur}"), keep_n=4,
+                        commit_extra=guard.commit_extra())
+        guard.note_checkpoint(cur)
+"""
+
+
+class TestEndToEndRewind:
+    def test_nan_window_skip_escalate_relaunch_resume_past(self, tmp_path):
+        """The acceptance loop under real process isolation: batches 3-4
+        are NaN; the child skips them in-program, escalates on the second
+        anomaly (exit 101 + ledger entry + recorder dump), the Supervisor
+        relaunches, and the relaunch resumes from the step-4 checkpoint
+        with the sampler fast-forwarded PAST the poisoned window — batch 4
+        is never replayed and the run completes with finite loss."""
+        script = tmp_path / "child.py"
+        script.write_text(textwrap.dedent(CHILD_SCRIPT))
+        root, log = str(tmp_path / "ckpts"), str(tmp_path / "log.txt")
+        os.makedirs(root)
+        env = {**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+               "PADDLE_TPU_FLIGHT_RECORDER_DIR": str(tmp_path / "fr")}
+        sup = Supervisor([sys.executable, str(script), root, "10", log],
+                         policy=RestartPolicy(max_restarts=3,
+                                              backoff_base=0.01,
+                                              backoff_cap=0.02),
+                         env=env, ckpt_root=root, keep_n=4,
+                         child_timeout=300)
+        assert sup.run() == 0
+        assert sup.restarts == 1
+        assert sup.exit_codes == [ELASTIC_EXIT_CODE, 0]
+
+        lines = [l for l in open(log).read().splitlines() if l]
+        resumed = [l for l in lines if l.startswith("resumed")]
+        assert resumed == ["resumed:4:skip1"]  # ckpt step 4, window [4,5]
+        steps = [(int(l.split(":")[0]), int(l.split(":")[1]))
+                 for l in lines if not l.startswith("resumed")]
+        # run 1: steps 1..4 over batches 0,4,8,12 (batch 3 = sample 12 is
+        # the first NaN batch; step 5 / batch 4 escalated before logging);
+        # run 2 resumes at step 5 on batch 5 (sample 20) — the poisoned
+        # batch 4 (sample 16) appears NOWHERE
+        assert steps[:4] == [(1, 0), (2, 4), (3, 8), (4, 12)]
+        assert steps[4:] == [(5, 20), (6, 24), (7, 28), (8, 32), (9, 36),
+                             (10, 40)]
+        assert all(s != 16 for _, s in steps), "poisoned batch replayed"
+        # run 1's NaN step logged an honest nan loss; every post-resume
+        # loss is finite to completion
+        assert math.isnan(float(lines[3].split(":")[2]))
+        post = [float(l.split(":")[2]) for l in lines
+                if not l.startswith("resumed")][4:]
+        assert all(math.isfinite(v) for v in post)
+
+        # the ledger tells the story: one rewind, window [4, 5], both NaN
+        # steps counted as skips
+        doc = json.load(open(os.path.join(root, "rewind_ledger.json")))
+        assert len(doc["rewinds"]) == 1
+        entry = doc["rewinds"][0]
+        assert entry["window"] == [4, 5]
+        assert entry["reason"] == "non_finite"
+        assert entry["steps_skipped"] == 2
+        # escalation dumped the flight recorder
+        dumps = os.listdir(tmp_path / "fr")
+        assert any("health_rewind" in d for d in dumps)
+        # the final checkpoint's COMMITTED marker carries the counters
+        latest = max((d for d in os.listdir(root) if d.startswith("step_")),
+                     key=lambda d: int(d.split("_")[1]))
+        marker = json.load(open(os.path.join(root, latest, "COMMITTED")))
+        assert marker["health"]["rewinds"] == 1
+        assert marker["health"]["steps_skipped"] == 0  # run 2 was clean
+
+    def test_rewind_loop_fails_loudly_not_101(self, tmp_path):
+        """Two rewinds anchored at the same step: the restarted child's
+        on_restart raises HealthError → a non-101 exit the supervisor
+        treats as fatal (no restart-budget burn on a divergence loop)."""
+        root = str(tmp_path)
+        led = RewindLedger(root)
+        led.record(step=7, resume_step=4, reason="non_finite")
+        led.record(step=9, resume_step=4, reason="non_finite")
+
+        def job():
+            guard = HealthGuard(_policy(), root=root)
+            guard.on_restart(4)
+
+        sup = Supervisor(job, policy=RestartPolicy(max_restarts=3,
+                                                   backoff_base=0.01))
+        with pytest.raises(HealthError, match="rewound to step 4"):
+            job()
+        # via the supervisor: HealthError is not SystemExit(101) — it
+        # propagates out of the in-process target as a fatal error
+        with pytest.raises(HealthError):
+            sup.run()
