@@ -96,13 +96,16 @@ def _meter_detail(meter) -> dict:
                 {k: v // steps for k, v in s["collective_bytes"].items()}}
 
 
-def _llama_measure(cfg, batch, seq, steps, warmup):
+def _llama_measure(cfg, batch, seq, steps, warmup, compile_cache=None):
     """Shared llama bench recipe: AMP-O2 fused train step, fresh random
     batch per step, host-read sync; returns (tok/s, first, final, params).
     The step runs GUARDED (health probe fused into the compiled program,
     lagged verdict resolution — no per-step host sync) so the bench
     trajectory both prices the guard and proves a healthy run reports
-    ``steps_skipped == 0``."""
+    ``steps_skipped == 0``. ``compile_cache`` (an
+    ``paddle_tpu.compile.ExecutableCache``) routes compilation through the
+    AOT service so the bench can report measured compile_time_s /
+    compile_mode and prove the warm path on a second run."""
     import numpy as np
 
     import paddle_tpu as paddle
@@ -119,7 +122,8 @@ def _llama_measure(cfg, batch, seq, steps, warmup):
     guard = HealthGuard(HealthPolicy(), name="bench_llama",
                         on_escalate="raise")  # in-memory ledger, no exits
     step = paddle.jit.TrainStep(model, lambda m, x, y: m(x, labels=y)[0], opt,
-                                health_guard=guard)
+                                health_guard=guard,
+                                persistent_cache=compile_cache)
     rng = np.random.default_rng(0)
     batches = []
     for _ in range(warmup + steps):
@@ -131,7 +135,7 @@ def _llama_measure(cfg, batch, seq, steps, warmup):
     dt, first_loss, final_loss = _time_steps(step, batches, warmup, meter)
     guard.flush()  # resolve lagged probes so the counters are final
     return batch * seq * steps / dt, first_loss, final_loss, n_params, \
-        meter, guard
+        meter, guard, step
 
 
 def bench_llama(on_accel: bool, peak: float):
@@ -149,8 +153,47 @@ def bench_llama(on_accel: bool, peak: float):
                           num_key_value_heads=8, max_position_embeddings=512)
         batch, seq, steps, warmup = 2, 256, 4, 1
 
-    tokens_per_sec, first_loss, final_loss, n_params, meter, guard = \
-        _llama_measure(cfg, batch, seq, steps, warmup)
+    import gc
+    import shutil
+    import tempfile
+
+    from paddle_tpu.compile import (ExecutableCache, compile_info_detail,
+                                    crosscheck_stepmeter)
+
+    # AOT compile service: a private cache root (never the user's
+    # PADDLE_TPU_COMPILE_CACHE — bench runs must not cross-pollinate), a
+    # measured cold compile on the primary, then a second in-process build
+    # of the SAME program that must hit the warm (deserialize) path
+    cache_root = tempfile.mkdtemp(prefix="paddle_tpu_bench_xla_")
+    try:
+        cache = ExecutableCache(cache_root)
+        tokens_per_sec, first_loss, final_loss, n_params, meter, guard, \
+            step = _llama_measure(cfg, batch, seq, steps, warmup,
+                                  compile_cache=cache)
+        info = dict(step.compile_info or {})
+        compile_detail = compile_info_detail(info)
+        ratio = crosscheck_stepmeter(meter, info.get("flops"))
+        if ratio is not None:
+            compile_detail["flops_model_ratio"] = round(ratio, 4)
+        if info.get("persisted"):
+            del step
+            gc.collect()  # free the first model before building the second
+            warm = _llama_measure(cfg, batch, seq, 1, 0,
+                                  compile_cache=cache)[-1]
+            modes = [e["mode"] for e in warm.compile_events]
+            if not modes or any(m != "warm" for m in modes):
+                raise RuntimeError(
+                    f"AOT warm path not hit on second run (modes={modes}) — "
+                    "persistent executable cache regression")
+            compile_detail["warm_ok"] = True
+            compile_detail["warm_compile_time_s"] = round(
+                warm.compile_info["seconds"], 4)
+        else:
+            # backend without executable serialization: cold numbers still
+            # measured, warm assertion not applicable
+            compile_detail["warm_ok"] = None
+    finally:
+        shutil.rmtree(cache_root, ignore_errors=True)
     achieved = tokens_per_sec * 6 * n_params / 1e12
     mfu = achieved / peak
     import math
@@ -173,6 +216,7 @@ def bench_llama(on_accel: bool, peak: float):
             # bench trajectory catches
             "steps_skipped": guard.steps_skipped,
             "rewinds": guard.rewinds,
+            **compile_detail,
             **_meter_detail(meter),
         },
     }
@@ -869,7 +913,7 @@ def bench_llama_longctx(on_accel: bool, peak: float):
     for bq, bk in sweep:
         paddle.set_flags({"flash_block_q": bq, "flash_block_k": bk})
         try:
-            tps, first_loss, final_loss, n_params, meter, _guard = \
+            tps, first_loss, final_loss, n_params, meter, _guard, _step = \
                 _llama_measure(cfg, batch, seq, steps, warmup)
         except Exception as e:  # one bad config must not kill the point
             failed.append({"blocks": [bq, bk], "error": repr(e)[:200]})
@@ -1093,7 +1137,8 @@ _COMPACT_KEYS = (
     "pipeline_efficiency", "tp_derate", "flash_blocks", "steps_per_sec",
     "slice_tokens_per_sec", "virtual_stages", "micro_batches",
     "cache_gb_read_per_step", "norm_target", "device", "hbm_peak_gb",
-    "resume_ok", "steps_skipped", "rewinds",
+    "resume_ok", "steps_skipped", "rewinds", "compile_time_s",
+    "compile_mode", "warm_ok",
 )
 
 

@@ -40,6 +40,7 @@ from . import vision  # noqa: E402
 from . import incubate  # noqa: E402
 from . import profiler  # noqa: E402
 from . import telemetry  # noqa: E402
+from . import compile  # noqa: E402  (AOT compile service; shadows no global)
 from . import hapi  # noqa: E402
 from .hapi import Model  # noqa: E402
 from .hapi import callbacks  # noqa: E402

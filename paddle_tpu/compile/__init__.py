@@ -1,0 +1,41 @@
+"""paddle_tpu.compile — the ahead-of-time compile service.
+
+Compile time is recoverable wall-clock: a supervisor relaunch (exit 101)
+or a cold bench run re-traces and re-compiles the fused train step that an
+earlier process already paid XLA for. This subsystem amortizes it to disk:
+
+- :mod:`.aot` — :class:`AOTFunction` wraps ``jax.jit(...)`` with the
+  ``lower() → fingerprint → (deserialize | compile + serialize)``
+  pipeline; :func:`fingerprint` keys programs by StableHLO text + mesh +
+  device kind/count + jax/jaxlib versions + donation/sharding spec.
+- :mod:`.cache` — :class:`ExecutableCache`, the corruption-safe on-disk
+  store (payload + CRC32 sidecar committed last, checkpoint-storage retry
+  seam, LRU keep-N): any corrupt/stale/unreadable entry degrades to a
+  clean recompile, never a crash.
+- :mod:`.metrics` — ``compile_begin``/``compile_end`` flight-recorder
+  events (cold|warm, seconds, fingerprint), prometheus counters/gauges,
+  and the ``cost_analysis()`` FLOP cross-check against StepMeter's
+  analytic MFU model.
+
+Wired through ``jit.TrainStep(persistent_cache=...)`` /
+``DistributedTrainStep`` and ``fleet.elastic.Supervisor(compile_cache=...)``
+so a relaunched child's first step deserializes its executable instead of
+re-invoking XLA (checkpoint load + trace time, not compile time).
+
+Env: ``PADDLE_TPU_COMPILE_CACHE`` (root, default ``~/.cache/paddle_tpu/xla``),
+``PADDLE_TPU_COMPILE_CACHE_MAX`` (disk LRU entries, default 32),
+``PADDLE_TPU_JIT_CACHE_MAX`` (in-process LRU entries, default 64).
+"""
+
+from .aot import (AOTFunction, fingerprint, resolve_cache,  # noqa: F401
+                  serialization_safe)
+from .cache import ExecutableCache, default_root  # noqa: F401
+from .metrics import (compile_begin, compile_end,  # noqa: F401
+                      compile_info_detail, crosscheck_stepmeter, flops_of)
+
+__all__ = [
+    "AOTFunction", "fingerprint", "resolve_cache", "serialization_safe",
+    "ExecutableCache", "default_root",
+    "flops_of", "compile_begin", "compile_end", "crosscheck_stepmeter",
+    "compile_info_detail",
+]

@@ -1,0 +1,128 @@
+"""Measured compile telemetry: flight-recorder events + runtime gauges for
+every AOT compile, and the ``cost_analysis()`` FLOP cross-check.
+
+Event protocol (the flight recorder narrates compile time the same way it
+narrates checkpoints):
+
+- ``compile_begin``  — fingerprint known, wall-clock starts; covers both
+  the XLA compile and a persistent-cache deserialize.
+- ``compile_end``    — ``mode`` ∈ ``cold`` (XLA compiled) | ``warm``
+  (deserialized from the :class:`~paddle_tpu.compile.cache.ExecutableCache`),
+  seconds, fingerprint, cost-analysis FLOPs, and whether the cold result
+  was persisted.
+
+Gauges/counters exported through ``telemetry.prometheus_text()``:
+``compile_cold_total`` / ``compile_warm_total``, ``compile_seconds_last``,
+``compile_seconds_total`` (the recoverable wall-clock the cache exists to
+amortize), ``compile_cost_flops_last``.
+
+:func:`flops_of` pulls XLA's own executed-FLOP estimate off a compiled
+executable; :func:`crosscheck_stepmeter` compares it against a
+:class:`~paddle_tpu.telemetry.StepMeter`'s analytic ``flops_per_step``
+model (6·N·tokens) so a drifting MFU model is visible as a ratio gauge
+instead of a silently wrong headline number.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+__all__ = ["flops_of", "compile_begin", "compile_end",
+           "crosscheck_stepmeter", "bump_counter", "cache_event"]
+
+
+def flops_of(compiled) -> Optional[float]:
+    """XLA ``cost_analysis()`` FLOPs of a compiled executable (one call =
+    one train step for TrainStep programs); None when the backend has no
+    cost model. Works on deserialized executables too."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = ca.get("flops")
+        return float(flops) if flops and flops > 0 else None
+    except Exception:
+        return None
+
+
+def _telemetry():
+    from .. import telemetry
+
+    return telemetry
+
+
+def bump_counter(name: str, value: float = 1.0) -> None:
+    """Swallow-all counter bump — the one shared 'telemetry never breaks
+    the compile path' seam for the whole package."""
+    try:
+        _telemetry().bump(name, value)
+    except Exception:
+        pass
+
+
+def cache_event(name: str, **data) -> None:
+    """Swallow-all ``compile_cache`` flight-recorder event (drops,
+    evictions, orphan sweeps, serialize-unsupported, unsafe-topology)."""
+    try:
+        _telemetry().record_event("compile_cache", name, **data)
+    except Exception:
+        pass
+
+
+def compile_begin(name: str, fingerprint: str) -> None:
+    try:
+        _telemetry().record_event("compile_begin", name,
+                                  fingerprint=fingerprint)
+    except Exception:
+        pass
+
+
+def compile_end(name: str, fingerprint: str, mode: str, seconds: float,
+                flops: Optional[float] = None,
+                persisted: Optional[bool] = None) -> None:
+    """Record one finished compile (``mode`` = ``cold`` | ``warm``)."""
+    try:
+        t = _telemetry()
+        t.record_event("compile_end", name, fingerprint=fingerprint,
+                       mode=mode, seconds=round(seconds, 4), flops=flops,
+                       persisted=persisted)
+        t.bump(f"compile_{mode}_total")
+        t.bump("compile_seconds_total", seconds)
+        t.set_gauge("compile_seconds_last", seconds)
+        if flops:
+            t.set_gauge("compile_cost_flops_last", flops)
+    except Exception:
+        pass
+
+
+def crosscheck_stepmeter(meter, flops_per_step: Optional[float]) -> Optional[float]:
+    """Ratio of XLA's cost-analysis FLOPs/step to the meter's analytic
+    ``flops_per_step`` model (1.0 = the MFU accounting matches what XLA
+    says it executes). Returns None — and exports no gauge — when either
+    side is unknown; otherwise exports the ratio as the
+    ``compile_flops_model_ratio`` gauge and records a crosscheck event."""
+    model = getattr(meter, "flops_per_step", None)
+    if not flops_per_step or not model:
+        return None
+    ratio = float(flops_per_step) / float(model)
+    try:
+        t = _telemetry()
+        t.set_gauge("compile_flops_model_ratio", ratio)
+        t.record_event("compile_flops_crosscheck", getattr(meter, "name", "?"),
+                       cost_flops=flops_per_step, model_flops=model,
+                       ratio=round(ratio, 4))
+    except Exception:
+        pass
+    return ratio
+
+
+def compile_info_detail(info: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Flatten an AOT compile-info dict into bench/telemetry detail fields
+    (empty when no compile has happened, e.g. a pre-warmed process)."""
+    if not info:
+        return {}
+    out = {"compile_mode": info.get("mode"),
+           "compile_time_s": round(float(info.get("seconds", 0.0)), 4)}
+    if info.get("flops"):
+        out["cost_flops_per_step"] = info["flops"]
+    return out

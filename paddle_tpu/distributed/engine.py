@@ -82,7 +82,8 @@ class DistributedTrainStep(TrainStep):
                  hcg: HybridCommunicateGroup, sharding_stage: Optional[int] = None,
                  batch_specs: Optional[Sequence[P]] = None, donate: bool = True,
                  offload: Optional[bool] = None,
-                 gradient_merge: Optional[int] = None, health_guard=None):
+                 gradient_merge: Optional[int] = None, health_guard=None,
+                 persistent_cache=None):
         self.hcg = hcg
         self.mesh = hcg.mesh
         if sharding_stage is None:
@@ -103,18 +104,19 @@ class DistributedTrainStep(TrainStep):
         self._batch_specs = batch_specs
         super().__init__(model, loss_fn, optimizer, donate=donate,
                          gradient_merge=gradient_merge,
-                         health_guard=health_guard)
+                         health_guard=health_guard,
+                         persistent_cache=persistent_cache)
         self._place_state()
         # every compiled variant must pin the SAME shardings (else XLA is
         # free to re-lay state out and the next differently-compiled step
         # rejects it) — one source of truth for the pinning tuples
         import functools as _ft
 
-        self._compiled = jax.jit(
+        self._compiled = self._maybe_aot(jax.jit(
             self._step,
             donate_argnums=(0, 1) if donate else (),
             **self._sharding_pins(),
-        )
+        ), "step")
         # check_nan_inf variant: no donation — state must survive a raise
         self._compiled_checked = jax.jit(
             _ft.partial(self._step, check_numerics=True),
@@ -138,11 +140,28 @@ class DistributedTrainStep(TrainStep):
         on — skips are selected in-program, never recovered host-side."""
         import functools as _ft
 
-        return jax.jit(
+        return self._maybe_aot(jax.jit(
             _ft.partial(self._step, health_probe=True),
             donate_argnums=(0, 1) if self._donate else (),
             **self._sharding_pins(extra_out=True),
-        )
+        ), "guarded_step")
+
+    def _fingerprint_extras(self, tag):
+        """AOT fingerprint identity for the sharded step: mesh shape +
+        axis names, ZeRO stage, offload, and every state/param sharding
+        pin — two programs with identical StableHLO but different pinned
+        layouts must never share an executable."""
+        ex = super()._fingerprint_extras(tag)
+        ex["mesh"] = {k: int(v) for k, v in self.mesh.shape.items()}
+        ex["sharding_stage"] = int(self.sharding_stage)
+        ex["offload"] = bool(self.offload)
+        ex["param_shardings"] = [repr(s.spec) for s in self._param_shardings]
+        ex["state_shardings"] = [
+            sorted((k, repr(getattr(v, "spec", None))) for k, v in sh.items())
+            for sh in self._state_shardings]
+        ex["batch_specs"] = None if self._batch_specs is None else \
+            [repr(s) for s in self._batch_specs]
+        return ex
 
     @staticmethod
     def _offload_supported() -> bool:

@@ -37,6 +37,17 @@ is treated as fatal and returned to the caller. Between restarts the
 supervisor optionally runs keep-N retention GC over the checkpoint root,
 so a crash-looping job cannot fill the disk with emergency checkpoints.
 
+``compile_cache=`` plugs in the AOT compile service
+(:mod:`paddle_tpu.compile`): every launch inherits
+``PADDLE_TPU_COMPILE_CACHE``, so the relaunched child's first train step
+deserializes the executable the first launch persisted instead of
+re-invoking XLA (the load is lazy — it happens inside the first
+``step(x, y)`` trace, so a restart pays checkpoint load + trace time,
+not the compile) — and every child-exit event carries
+``time_to_first_step_s`` (relaunch → first completed step, via the
+``PADDLE_TPU_FIRST_STEP_STAMP`` protocol with ``jit.TrainStep``) so the
+warm-start win is measured, not assumed.
+
 :func:`emergency_handler` builds the child-side ``on_timeout`` callback for
 :class:`~paddle_tpu.distributed.CommWatchdog`: the watchdog has already
 dumped the flight recorder by the time it fires, so the handler saves a
@@ -64,8 +75,10 @@ from __future__ import annotations
 
 import os
 import random
+import shutil
 import subprocess
 import sys
+import tempfile
 import time
 from dataclasses import dataclass
 from typing import Callable, List, Optional, Sequence, Union
@@ -98,7 +111,20 @@ class Supervisor:
 
     ``target`` is either an argv list (subprocess mode) or a callable
     (in-process mode — the callable's ``SystemExit`` code, or 0 on normal
-    return, plays the role of the exit status)."""
+    return, plays the role of the exit status).
+
+    ``compile_cache`` names an AOT executable-cache root
+    (:mod:`paddle_tpu.compile`) exported to every launch as
+    ``PADDLE_TPU_COMPILE_CACHE``: the first child cold-compiles and
+    persists the train-step executable; every relaunch after exit 101
+    warm-loads it at its first step's trace instead of re-invoking XLA —
+    the restart pays checkpoint load + trace time, not the compile that
+    dominates at scale. Each launch also gets a
+    fresh ``PADDLE_TPU_FIRST_STEP_STAMP`` path that ``jit.TrainStep``
+    stamps on the first completed step; the supervisor reads it back and
+    reports ``time_to_first_step_s`` in its restart/done events, so
+    warm-start wins are visible in the goodput trail next to
+    ``health_rewinds``."""
 
     def __init__(self, target: Union[Sequence[str], Callable[[], None]],
                  policy: Optional[RestartPolicy] = None,
@@ -106,7 +132,8 @@ class Supervisor:
                  env: Optional[dict] = None,
                  ckpt_root: Optional[str] = None,
                  keep_n: Optional[int] = None,
-                 child_timeout: Optional[float] = None):
+                 child_timeout: Optional[float] = None,
+                 compile_cache: Optional[str] = None):
         self.target = target
         self.policy = policy or RestartPolicy()
         self.restart_codes = tuple(restart_codes)
@@ -114,12 +141,48 @@ class Supervisor:
         self.ckpt_root = ckpt_root
         self.keep_n = keep_n
         self.child_timeout = child_timeout
+        self.compile_cache = compile_cache
         self.restarts = 0
         self.exit_codes: List[int] = []
+        self.time_to_first_step_s: Optional[float] = None
+        self._stamp_dir: Optional[str] = None
+
+    # -- first-step goodput probe ------------------------------------------
+    def _next_stamp_path(self) -> str:
+        if self._stamp_dir is None:
+            self._stamp_dir = tempfile.mkdtemp(prefix="paddle_tpu_sup_")
+        return os.path.join(self._stamp_dir,
+                            f"first_step_{len(self.exit_codes)}.stamp")
+
+    @staticmethod
+    def _read_stamp(stamp: str, launch_wall: float) -> Optional[float]:
+        """relaunch → first completed TrainStep, from the child's stamp
+        file (None when the child never finished a step — crashed during
+        compile/load, or runs no TrainStep)."""
+        try:
+            with open(stamp) as f:
+                t = float(f.read().strip())
+            os.remove(stamp)
+            return max(0.0, t - launch_wall)
+        except (OSError, ValueError):
+            return None
 
     # -- one launch --------------------------------------------------------
     def _launch_once(self) -> int:
+        stamp = self._next_stamp_path()
+        extra_env = {"PADDLE_TPU_FIRST_STEP_STAMP": stamp}
+        if self.compile_cache:
+            extra_env["PADDLE_TPU_COMPILE_CACHE"] = self.compile_cache
+        launch_wall = time.time()
+        try:
+            return self._launch_raw(extra_env)
+        finally:
+            self.time_to_first_step_s = self._read_stamp(stamp, launch_wall)
+
+    def _launch_raw(self, extra_env: dict) -> int:
         if callable(self.target):
+            saved = {k: os.environ.get(k) for k in extra_env}
+            os.environ.update(extra_env)
             try:
                 self.target()
                 return 0
@@ -127,8 +190,16 @@ class Supervisor:
                 code = e.code
                 return code if isinstance(code, int) else (0 if code is None
                                                            else 1)
+            finally:
+                for k, v in saved.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+        env = dict(self.env) if self.env is not None else dict(os.environ)
+        env.update(extra_env)
         try:
-            proc = subprocess.run(list(self.target), env=self.env,
+            proc = subprocess.run(list(self.target), env=env,
                                   timeout=self.child_timeout)
             return proc.returncode
         except subprocess.TimeoutExpired:
@@ -141,33 +212,44 @@ class Supervisor:
         exit code (0 = completed, restart code = gave up after
         ``max_restarts``, anything else = fatal child error)."""
         self._event("supervisor_start")
-        while True:
-            rc = self._launch_once()
-            self.exit_codes.append(rc)
-            if rc == 0:
-                self._event("supervisor_done", restarts=self.restarts)
-                return 0
-            if rc not in self.restart_codes:
-                self._event("supervisor_fatal", exit_code=rc,
-                            restarts=self.restarts)
-                return rc
-            if self.restarts >= self.policy.max_restarts:
-                self._event("supervisor_giveup", exit_code=rc,
-                            restarts=self.restarts)
-                return rc
-            self.restarts += 1
-            delay = self.policy.delay(self.restarts)
-            self._event("supervisor_restart", attempt=self.restarts,
-                        exit_code=rc, backoff_s=round(delay, 3),
-                        health_rewinds=self._rewind_count())
-            if self.ckpt_root and self.keep_n:
-                try:
-                    from ...checkpoint import gc_checkpoints
+        try:
+            while True:
+                rc = self._launch_once()
+                self.exit_codes.append(rc)
+                ttfs = None if self.time_to_first_step_s is None else \
+                    round(self.time_to_first_step_s, 3)
+                if rc == 0:
+                    self._event("supervisor_done", restarts=self.restarts,
+                                time_to_first_step_s=ttfs)
+                    return 0
+                if rc not in self.restart_codes:
+                    self._event("supervisor_fatal", exit_code=rc,
+                                restarts=self.restarts,
+                                time_to_first_step_s=ttfs)
+                    return rc
+                if self.restarts >= self.policy.max_restarts:
+                    self._event("supervisor_giveup", exit_code=rc,
+                                restarts=self.restarts,
+                                time_to_first_step_s=ttfs)
+                    return rc
+                self.restarts += 1
+                delay = self.policy.delay(self.restarts)
+                self._event("supervisor_restart", attempt=self.restarts,
+                            exit_code=rc, backoff_s=round(delay, 3),
+                            health_rewinds=self._rewind_count(),
+                            time_to_first_step_s=ttfs)
+                if self.ckpt_root and self.keep_n:
+                    try:
+                        from ...checkpoint import gc_checkpoints
 
-                    gc_checkpoints(self.ckpt_root, keep=self.keep_n)
-                except Exception:
-                    pass
-            time.sleep(delay)
+                        gc_checkpoints(self.ckpt_root, keep=self.keep_n)
+                    except Exception:
+                        pass
+                time.sleep(delay)
+        finally:
+            if self._stamp_dir is not None:
+                shutil.rmtree(self._stamp_dir, ignore_errors=True)
+                self._stamp_dir = None
 
     def _rewind_count(self) -> Optional[int]:
         """Health rewinds recorded under ``ckpt_root`` (None without one):

@@ -20,7 +20,10 @@ un-traceable simply stays eager (call the layer directly). Two entry points:
 
 from __future__ import annotations
 
+import collections
 import functools
+import os
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -39,6 +42,98 @@ __all__ = ["to_static", "TrainStep", "not_to_static", "ignore_module", "save",
 
 def _is_tensor(x) -> bool:
     return isinstance(x, Tensor)
+
+
+class _CompileCache:
+    """Bounded per-process compile cache (LRU): the KernelKey-style dict
+    every StaticFunction / AOTFunction keys compiled programs by, capped
+    at ``PADDLE_TPU_JIT_CACHE_MAX`` entries (default 64) so shape churn —
+    ragged batches, sweep loops — cannot grow it without limit. Evictions
+    bump the ``compile_cache_evictions`` telemetry counter: a hot loop
+    that keeps evicting (cache thrash = recompile storm) is visible in
+    prometheus instead of silent.
+
+    ``persistent`` optionally names an on-disk
+    :class:`~paddle_tpu.compile.cache.ExecutableCache` backing layer —
+    the in-memory cache is the first level of the AOT compile service's
+    lookup (:class:`~paddle_tpu.compile.AOTFunction` consults it before
+    the disk store)."""
+
+    _DEFAULT_MAX = 64
+
+    def __init__(self, max_entries: Optional[int] = None, persistent=None):
+        if max_entries is None:
+            try:
+                max_entries = int(os.environ.get("PADDLE_TPU_JIT_CACHE_MAX",
+                                                 self._DEFAULT_MAX))
+            except ValueError:
+                max_entries = self._DEFAULT_MAX
+        self.max_entries = max(1, max_entries)
+        self.persistent = persistent
+        self.evictions = 0
+        self._entries: "collections.OrderedDict[Any, Any]" = \
+            collections.OrderedDict()
+
+    def get(self, key, default=None):
+        try:
+            value = self._entries[key]
+        except KeyError:
+            return default
+        self._entries.move_to_end(key)
+        return value
+
+    def put(self, key, value) -> None:
+        self._entries[key] = value
+        self._entries.move_to_end(key)
+        while len(self._entries) > self.max_entries:
+            self._entries.popitem(last=False)
+            self.evictions += 1
+            try:
+                from .. import telemetry
+
+                telemetry.bump("compile_cache_evictions")
+            except Exception:
+                pass
+
+    __setitem__ = put
+
+    def __getitem__(self, key):
+        value = self.get(key, default=_MISSING)
+        if value is _MISSING:
+            raise KeyError(key)
+        return value
+
+    def __contains__(self, key) -> bool:
+        return key in self._entries
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def clear(self) -> None:
+        self._entries.clear()
+
+
+_MISSING = object()
+
+
+_stamped_paths: set = set()
+
+
+def _stamp_first_step() -> None:
+    """Goodput probe for the restart supervisor: the first COMPLETED train
+    step of this process writes a wall-clock stamp to the path named by
+    ``PADDLE_TPU_FIRST_STEP_STAMP`` (the Supervisor sets a fresh path per
+    launch and reads it back as ``time_to_first_step_s``). One write per
+    stamp path, nothing without the env var."""
+    path = os.environ.get("PADDLE_TPU_FIRST_STEP_STAMP")
+    if not path or path in _stamped_paths:
+        return
+    _stamped_paths.add(path)
+    try:
+        with open(path, "w") as f:
+            f.write(repr(time.time()))
+    except OSError:
+        pass
 
 
 class _StateSwap:
@@ -68,7 +163,7 @@ class StaticFunction:
                  full_graph: bool = True, backend=None):
         self._fn = fn
         self._layer = layer
-        self._cache: Dict[Any, Dict[str, Any]] = {}
+        self._cache = _CompileCache()  # bounded: shape churn can't leak
         try:
             functools.update_wrapper(self, fn)
         except Exception:
@@ -267,15 +362,38 @@ class TrainStep:
     reduction, and a non-finite step is SKIPPED in-program (old params /
     opt-state / buffers selected back) instead of applied — the detect
     layer of the detect → skip → rewind loop.
+
+    ``persistent_cache=`` routes compilation through the AOT compile
+    service (:mod:`paddle_tpu.compile`): True for the default on-disk
+    executable cache (``PADDLE_TPU_COMPILE_CACHE``), a path, or an
+    :class:`~paddle_tpu.compile.ExecutableCache`. The first process to
+    compile this step serializes the executable; a supervisor relaunch
+    (or a fresh bench run) with the same program fingerprint warm-loads
+    it instead of re-invoking XLA — ``compile_info`` reports what
+    happened (``mode`` cold|warm, seconds, fingerprint, cost FLOPs).
     """
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer, donate: bool = True,
-                 gradient_merge: Optional[int] = None, health_guard=None):
+                 gradient_merge: Optional[int] = None, health_guard=None,
+                 persistent_cache=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self._donate = donate
         self._health_guard = health_guard
+        if persistent_cache is not None:
+            from ..compile import resolve_cache
+
+            self._persistent_cache = resolve_cache(persistent_cache)
+        else:
+            self._persistent_cache = None
+        # AOT bookkeeping: compile_info = the FIRST compile of this step
+        # (the expensive one a warm restart amortizes); compile_events =
+        # every (mode, seconds, fingerprint, flops) the service reported —
+        # re-traces (e.g. an optimizer counter going python-int → int32
+        # after step 1) land here too, typically as warm loads
+        self.compile_info: Optional[Dict[str, Any]] = None
+        self.compile_events: List[Dict[str, Any]] = []
         # gradient merge (reference `auto_parallel_gradient_merge.py`): run k
         # micro-steps accumulating grads IN-JIT, update once; k defaults from
         # the fleet strategy tag stamped by distributed_optimizer
@@ -295,8 +413,9 @@ class TrainStep:
         from ..incubate.asp import ASPHelper
 
         self._asp_masks = [ASPHelper._masks.get(id(p)) for p in self._params]
-        self._compiled = jax.jit(self._step,
-                                 donate_argnums=(0, 1) if donate else ())
+        self._compiled = self._maybe_aot(
+            jax.jit(self._step, donate_argnums=(0, 1) if donate else ()),
+            "step")
         # FLAGS_check_nan_inf variant: same step + per-grad finite flags
         # (covers the compiled path the eager apply_op hook can't see —
         # reference nan_inf_utils_detail checks inside every kernel launch).
@@ -316,8 +435,40 @@ class TrainStep:
         """Compiled variant with the fused health probe. Donation is safe:
         a skipped step's old state feeds the in-program select, never a
         post-hoc host decision (DistributedTrainStep pins shardings)."""
-        return jax.jit(functools.partial(self._step, health_probe=True),
-                       donate_argnums=(0, 1) if self._donate else ())
+        return self._maybe_aot(
+            jax.jit(functools.partial(self._step, health_probe=True),
+                    donate_argnums=(0, 1) if self._donate else ()),
+            "guarded_step")
+
+    # -- AOT compile service ----------------------------------------------
+    def _maybe_aot(self, jitted, tag: str):
+        """Route a compiled variant through the persistent executable cache
+        when one is configured (ctor ``persistent_cache=``); otherwise the
+        plain jit object. The checked (``check_nan_inf``) debug variant
+        stays un-cached on purpose — it is a diagnosis path, not a restart
+        hot path."""
+        if self._persistent_cache is None:
+            return jitted
+        from ..compile import AOTFunction
+
+        # extras resolve lazily (at first compile): DistributedTrainStep's
+        # sharding pins are placed after the base ctor builds this wrapper
+        return AOTFunction(jitted, cache=self._persistent_cache,
+                           name=f"{type(self).__name__}.{tag}",
+                           extras=lambda: self._fingerprint_extras(tag),
+                           on_compile=self._note_compile)
+
+    def _fingerprint_extras(self, tag: str) -> Dict[str, Any]:
+        """Program identity beyond the StableHLO text: anything that could
+        make the 'same' HLO compile to an incompatible executable must be
+        in here (DistributedTrainStep adds mesh + sharding pins)."""
+        return {"tag": tag, "donate": bool(self._donate),
+                "merge_k": self._merge_k}
+
+    def _note_compile(self, info: Dict[str, Any]) -> None:
+        self.compile_events.append(info)
+        if self.compile_info is None:
+            self.compile_info = info
 
     def _get_guarded(self):
         c = getattr(self, "_compiled_guarded", None)
@@ -540,6 +691,9 @@ class TrainStep:
             # guard resolves the probe max_lag steps late and may raise
             # SystemExit(101) here to hand control to the Supervisor
             guard.on_step(probe, step=self.optimizer._step_count)
+        # supervisor goodput probe: first completed step of this process
+        # (relaunch → here is time_to_first_step_s in restart events)
+        _stamp_first_step()
         try:  # telemetry: step event for the flight recorder + prometheus.
             # No host sync here — loss stays a device value.
             from .. import telemetry

@@ -18,7 +18,12 @@ The resilience stack narrates its lifecycle into the ring:
 background async writer died — also re-raised at the next save/wait) /
 ``checkpoint_io_retry`` / ``checkpoint_gc``, ``fault_injected`` (chaos
 tests), ``preemption_exit`` / ``emergency_checkpoint``, ``supervisor``
-start/restart/giveup/done events, and the numerical-health kinds —
+start/restart/giveup/done events (restart/done carry
+``time_to_first_step_s``, the warm-start goodput probe), the AOT compile
+service kinds — ``compile_begin`` / ``compile_end`` (``mode`` cold|warm,
+seconds, fingerprint — a warm restart shows a ``compile_end`` with
+``mode=warm`` and no cold compile) and ``compile_cache`` (drops,
+evictions, serialize-unsupported) — and the numerical-health kinds —
 ``health_skip`` (update withheld for a NaN/Inf step), ``health_anomaly``
 (finite loss/grad-norm spike), ``health_rewind`` (escalation: the dump you
 are reading may BE that dump), ``health_fast_forward`` (restart skipped a

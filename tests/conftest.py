@@ -19,6 +19,13 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 if "PADDLE_TPU_FLIGHT_RECORDER_DIR" not in os.environ:
     os.environ["PADDLE_TPU_FLIGHT_RECORDER_DIR"] = \
         tempfile.mkdtemp(prefix="paddle_tpu_flightrec_")
+# the AOT executable cache defaults to a per-run tmpdir under pytest so test
+# runs never cross-pollinate each other (or the developer's real
+# ~/.cache/paddle_tpu/xla); subprocess-spawning tests inherit it, which is
+# exactly what the warm-restart e2e wants
+if "PADDLE_TPU_COMPILE_CACHE" not in os.environ:
+    os.environ["PADDLE_TPU_COMPILE_CACHE"] = \
+        tempfile.mkdtemp(prefix="paddle_tpu_xla_cache_")
 
 import jax  # noqa: E402
 
@@ -38,3 +45,13 @@ def pytest_configure(config):
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture
+def compile_cache_dir(tmp_path, monkeypatch):
+    """A fresh, test-local AOT executable-cache root: points
+    PADDLE_TPU_COMPILE_CACHE at tmp_path so caches built inside the test
+    (and in its subprocesses) stay isolated from the session default."""
+    d = str(tmp_path / "xla_cache")
+    monkeypatch.setenv("PADDLE_TPU_COMPILE_CACHE", d)
+    return d
