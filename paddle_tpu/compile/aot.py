@@ -65,6 +65,17 @@ def fingerprint(stablehlo_text: str, extras: Optional[Dict[str, Any]] = None,
         "jax": jax.__version__,
         "jaxlib": jaxlib.__version__,
     }
+    try:
+        # comm/compute-overlap identity (TP ring decomposition, grad
+        # bucket size, applied latency-hiding XLA flags): two processes
+        # with identical StableHLO but a different overlap regime compile
+        # different schedules — toggling PADDLE_TPU_TP_OVERLAP or
+        # PADDLE_TPU_BUCKET_MB must never warm-load a stale executable
+        from ..distributed.overlap import overlap_fingerprint
+
+        env["overlap"] = overlap_fingerprint()
+    except Exception:
+        pass
     if extras:
         env["extras"] = extras
     h = hashlib.sha256()

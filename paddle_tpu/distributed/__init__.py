@@ -9,6 +9,7 @@ from . import checkpoint  # noqa: F401
 from . import fleet  # noqa: F401
 from . import health  # noqa: F401
 from . import meta_parallel  # noqa: F401
+from . import overlap  # noqa: F401
 from .auto_parallel import (Partial, Placement, ProcessMesh, Replicate, Shard,  # noqa: F401
                             dtensor_from_fn, get_mesh, reshard, set_mesh, shard_layer,
                             shard_optimizer, shard_tensor)

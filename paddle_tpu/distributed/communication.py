@@ -34,6 +34,7 @@ from .topology import CommGroup, build_mesh, get_hybrid_communicate_group
 __all__ = ["ReduceOp", "all_reduce", "all_gather", "reduce_scatter", "all_to_all",
            "broadcast", "reduce", "scatter", "barrier", "new_group", "get_group",
            "scatter_stack", "ppermute", "wait", "stream",
+           "coalesced_reduce_scatter",
            "send", "recv", "isend", "irecv", "P2POp", "batch_isend_irecv"]
 
 
@@ -232,6 +233,92 @@ def reduce_scatter(tensor: Tensor, tensor_list=None, op: str = ReduceOp.SUM,
                    group: Optional[CommGroup] = None, sync_op: bool = True) -> Tensor:
     return _run("reduce_scatter", tensor if tensor_list is None else tensor_list,
                 group, op)
+
+
+_coalesced_rs_cache = None  # bounded jit._CompileCache, built lazily
+
+
+def _coalesced_rs_fn(mesh: Mesh, axes, n: int, shapes, dtype_str: str):
+    """One jitted shard_map program: concat this bucket's local slices
+    flat, pad to n·k, ONE psum_scatter — the wire-side fusion the overlap
+    layer's GradientBucketer plans. Cached per (mesh, axes, shapes,
+    dtype) in a BOUNDED LRU (jit._CompileCache): bucket shapes churn with
+    batch/param-set changes, and an unbounded cache here would leak
+    compiled programs exactly the way PADDLE_TPU_JIT_CACHE_MAX exists to
+    prevent."""
+    global _coalesced_rs_cache
+    if _coalesced_rs_cache is None:
+        from ..jit import _CompileCache
+
+        _coalesced_rs_cache = _CompileCache()
+    key = (mesh, axes, n, shapes, dtype_str)
+    cached = _coalesced_rs_cache.get(key)
+    if cached is not None:
+        return cached
+    ax = axes if len(axes) > 1 else axes[0]
+
+    def body(*locals_):
+        flat = jnp.concatenate([x.reshape(-1) for x in locals_])
+        total = flat.shape[0]
+        k = -(-total // n)
+        if n * k != total:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((n * k - total,), flat.dtype)])
+        return jax.lax.psum_scatter(flat.reshape(n, k), ax,
+                                    scatter_dimension=0, tiled=False)
+
+    from ..framework.jax_compat import shard_map
+
+    fn = shard_map(body, mesh, tuple(P(axes) for _ in shapes), P(axes),
+                   check_vma=False)
+    jitted = jax.jit(fn)
+    _coalesced_rs_cache.put(key, jitted)
+    return jitted
+
+
+def coalesced_reduce_scatter(tensor_list, group: Optional[CommGroup] = None,
+                             bucket_bytes: Optional[int] = None) -> List[Tensor]:
+    """Bucketed reduce-scatter: like ``[reduce_scatter(t) for t in ts]``
+    (each input stacked [g·m, ...], each output the summed [m, ...]) but
+    executed as ONE collective per size-targeted bucket
+    (``bucket_bytes`` override, else ``PADDLE_TPU_BUCKET_MB``), planned
+    reverse-topologically by :class:`~paddle_tpu.distributed.overlap.
+    GradientBucketer` — the eager twin of the engine's in-jit bucketing.
+    Output residency is bucket-contiguous rather than per-tensor-sliced;
+    global values match the per-tensor calls exactly."""
+    from .overlap.bucketer import GradientBucketer
+
+    g = _resolve_group(group)
+    n = g.nranks
+    vals = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
+            for t in tensor_list]
+    for v in vals:
+        if v.ndim < 1 or v.shape[0] % n:
+            raise ValueError(
+                f"coalesced_reduce_scatter needs dim0 divisible by the "
+                f"group size {n}, got shape {tuple(v.shape)}")
+    sizes = [v.size * v.dtype.itemsize for v in vals]
+    keys = [str(v.dtype) for v in vals]
+    bucketer = GradientBucketer(sizes, bucket_bytes=bucket_bytes, keys=keys,
+                                reverse=True)
+    out: List[Optional[Tensor]] = [None] * len(vals)
+    for b in bucketer.buckets:
+        members = [vals[i] for i in b]
+        local_shapes = tuple((v.shape[0] // n,) + tuple(v.shape[1:])
+                             for v in members)
+        fn = _coalesced_rs_fn(g.mesh, g.axes, n,
+                              tuple(tuple(v.shape) for v in members),
+                              str(members[0].dtype))
+        summed = fn(*members)  # global [n*k]: the summed flat bucket
+        off = 0
+        for i, shp in zip(b, local_shapes):
+            cnt = int(np.prod(shp)) if shp else 1
+            out[i] = Tensor(summed[off:off + cnt].reshape(shp),
+                            stop_gradient=True)
+            off += cnt
+        _telemetry_record("reduce_scatter",
+                          Tensor(summed[:off]), g)
+    return [t for t in out]
 
 
 def all_to_all(out_tensor_list, in_tensor_list=None, group: Optional[CommGroup] = None,

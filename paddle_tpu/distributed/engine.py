@@ -102,10 +102,12 @@ class DistributedTrainStep(TrainStep):
                 "host-memory placements; optimizer states stay in device "
                 "memory", jax.devices()[0].platform)
         self._batch_specs = batch_specs
+        self._grad_bucketer = None  # built after state placement (sizes)
         super().__init__(model, loss_fn, optimizer, donate=donate,
                          gradient_merge=gradient_merge,
                          health_guard=health_guard,
                          persistent_cache=persistent_cache)
+        self._grad_bucketer = self._build_bucketer()
         self._place_state()
         # every compiled variant must pin the SAME shardings (else XLA is
         # free to re-lay state out and the next differently-compiled step
@@ -146,6 +148,49 @@ class DistributedTrainStep(TrainStep):
             **self._sharding_pins(extra_out=True),
         ), "guarded_step")
 
+    def _build_bucketer(self):
+        """Bucketed gradient comm for the sharded-optimizer stages: grads
+        are routed (value-identically) through size-targeted buckets
+        ordered reverse-topologically, so XLA emits one reduce-scatter per
+        bucket and the first buckets fire while the tail of backward still
+        computes (``PADDLE_TPU_BUCKET_MB``, 0 disables; reference
+        capability: EagerReducer's fused comm groups, reducer.h:88)."""
+        from .overlap import GradientBucketer, grad_bucket_bytes
+
+        n_red = self.mesh.shape.get("data", 1) * \
+            self.mesh.shape.get("sharding", 1)
+        if self.sharding_stage < 1 or n_red <= 1:
+            return None
+        bb = grad_bucket_bytes(
+            getattr(self.optimizer, "_grad_bucket_bytes", None))
+        if bb <= 0:
+            return None
+        sizes, keys = [], []
+        for p in self._params:
+            sizes.append(p._value.size * p._value.dtype.itemsize)
+            keys.append(str(p._value.dtype))
+        bucketer = GradientBucketer(sizes, bucket_bytes=bb, keys=keys,
+                                    reverse=True)
+        try:
+            from .. import telemetry
+
+            telemetry.record_event(
+                "overlap", "grad_bucketer",
+                buckets=bucketer.num_buckets, bucket_bytes=bb,
+                total_bytes=int(sum(sizes)), stage=self.sharding_stage)
+        except Exception:
+            pass
+        return bucketer
+
+    def _comm_grads(self, grads):
+        b = self._grad_bucketer
+        if b is None:
+            return grads
+        # grads pair with compute_params (fp32 masters for bf16 params):
+        # the bucket plan keyed per-param dtype still applies bucket
+        # boundaries; coalescing uses each grad's actual dtype
+        return b.constrain(grads, self.mesh, axes=("data", "sharding"))
+
     def _fingerprint_extras(self, tag):
         """AOT fingerprint identity for the sharded step: mesh shape +
         axis names, ZeRO stage, offload, and every state/param sharding
@@ -161,6 +206,9 @@ class DistributedTrainStep(TrainStep):
             for sh in self._state_shardings]
         ex["batch_specs"] = None if self._batch_specs is None else \
             [repr(s) for s in self._batch_specs]
+        b = self._grad_bucketer
+        ex["grad_buckets"] = None if b is None else \
+            {"bucket_bytes": b.bucket_bytes, "buckets": b.buckets}
         return ex
 
     @staticmethod
@@ -241,10 +289,20 @@ class DistributedTrainStep(TrainStep):
                                         and n_shard > 1) else "all_reduce"
             axes = [a for a, n in (("data", n_data), ("sharding", n_shard))
                     if n > 1]
+            if self._grad_bucketer is not None:
+                # bucketed: one reduce-scatter per bucket (reverse-
+                # topological firing order) instead of a monolithic one
+                collectives = [
+                    {"kind": kind, "nbytes": int(nb), "group_size": n_red,
+                     "count": 1, "axes": axes}
+                    for nb in self._grad_bucketer.bucket_nbytes()]
+            else:
+                collectives = [{"kind": kind, "nbytes": int(grad_bytes),
+                                "group_size": n_red, "count": 1,
+                                "axes": axes}]
             return telemetry.register_traced_program(
                 f"DistributedTrainStep_stage{self.sharding_stage}",
-                [{"kind": kind, "nbytes": int(grad_bytes),
-                  "group_size": n_red, "count": 1, "axes": axes}])
+                collectives)
         except Exception:
             return None
 

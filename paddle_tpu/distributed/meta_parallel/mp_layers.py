@@ -73,6 +73,30 @@ def _last_dim_spec(ndim: int, axis_or_none) -> P:
     return P(*([_U] * (ndim - 1) + [axis_or_none]))
 
 
+def _overlap_linear(kind: str, x: Tensor, weight: Tensor, bias, mesh) -> Tensor:
+    """Collective-matmul path for one parallel-linear call: flatten the
+    token dims, run the ring-decomposed primitive (the all-gather /
+    reduce-scatter hides under the partial matmuls — see
+    ``distributed/overlap/collective_matmul.py``), add bias outside the
+    manual region. Caller has already decided via ``should_decompose``."""
+    from ...amp import maybe_autocast_tensors
+    from ..overlap import all_gather_matmul, matmul_reduce_scatter
+
+    x, weight = maybe_autocast_tensors("linear", x, weight)
+    if bias is not None:
+        (bias,) = maybe_autocast_tensors("linear", bias)
+    prim = all_gather_matmul if kind == "column" else matmul_reduce_scatter
+
+    def fn(xv, wv, *bv):
+        lead = xv.shape[:-1]
+        out2 = prim(xv.reshape(-1, xv.shape[-1]), wv, mesh)
+        out = out2.reshape(lead + (wv.shape[-1],))
+        return out + bv[0] if bv else out
+
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return apply_op(f"collective_matmul_{kind}", fn, args)
+
+
 class VocabParallelEmbedding(Layer):
     """Embedding with the vocab dim sharded over "model" (reference :46).
     GSPMD turns the lookup into shard-local gathers + psum of the masked
@@ -125,7 +149,16 @@ class ColumnParallelLinear(Layer):
         self._mesh = mesh
 
     def forward(self, x):
-        out = F.linear(x, self.weight, self.bias)
+        from ..overlap import should_decompose
+
+        if should_decompose(tuple(x.shape), self._mesh):
+            # ring-decomposed gather(X)@W: the input all-gather hides under
+            # the partial matmuls (PADDLE_TPU_TP_OVERLAP; fused-GSPMD kept
+            # below the shape threshold where the fused path wins)
+            out = _overlap_linear("column", x, self.weight, self.bias,
+                                  self._mesh)
+        else:
+            out = F.linear(x, self.weight, self.bias)
         if self.gather_output:
             return _constrain(out, _last_dim_spec(out.ndim, None), self._mesh)
         return _constrain(out, _last_dim_spec(out.ndim, "model"), self._mesh)
@@ -158,9 +191,20 @@ class RowParallelLinear(Layer):
         self._mesh = mesh
 
     def forward(self, x):
+        from ..overlap import should_decompose
+
         if not self.input_is_parallel:
             x = _constrain(x, _last_dim_spec(x.ndim, "model"), self._mesh)
-        out = F.linear(x, self.weight, self.bias)
+        if should_decompose(tuple(x.shape), self._mesh):
+            # ring-decomposed reduce_scatter(X@W): the partial-sum ring
+            # hides under the producing matmuls; the final constraint
+            # re-gathers the row shards (reduce-scatter + all-gather ==
+            # the fused path's all-reduce in wire bytes, but only the
+            # cheap gather half stays exposed)
+            out = _overlap_linear("row", x, self.weight, self.bias,
+                                  self._mesh)
+        else:
+            out = F.linear(x, self.weight, self.bias)
         return _constrain(out, _last_dim_spec(out.ndim, None), self._mesh)
 
 
@@ -204,10 +248,18 @@ class ParallelCrossEntropy(Layer):
             # then psums (GSPMD)
             mx = jax.lax.stop_gradient(jnp.max(lgf, axis=-1, keepdims=True))
             lse = jnp.log(jnp.sum(jnp.exp(lgf - mx), axis=-1)) + mx[..., 0]
-            # masked gold-logit pick: one_hot keeps the class dim sharded
+            # masked gold-logit pick: one_hot keeps the class dim sharded.
+            # The one_hot output itself must carry the "model" constraint
+            # BEFORE it meets the logits — unconstrained, GSPMD is free to
+            # materialize it replicated and then all-gather the [..., V]
+            # logits row to match, exactly the gather this layer exists to
+            # avoid (asserted by tests/test_overlap.py's HLO byte counter).
             safe = jnp.where(lab == ignore, 0, lab)
-            gold = jnp.sum(lgf * jax.nn.one_hot(safe, lgf.shape[-1],
-                                                dtype=lgf.dtype), axis=-1)
+            oh = jax.nn.one_hot(safe, lgf.shape[-1], dtype=lgf.dtype)
+            if "model" in mesh.axis_names:
+                oh = _constrain_value(oh, _last_dim_spec(oh.ndim, "model"),
+                                      mesh)
+            gold = jnp.sum(lgf * oh, axis=-1)
             loss = lse - gold
             loss = jnp.where(lab == ignore, 0.0, loss)
             return loss[..., None]

@@ -1,0 +1,57 @@
+"""paddle_tpu.distributed.overlap — the comm/compute latency-hiding layer.
+
+Three legs (see the per-module docstrings):
+
+- :mod:`.collective_matmul` — ring-decomposed ``all_gather_matmul`` /
+  ``matmul_reduce_scatter`` (ppermute steps interleaved with partial
+  matmuls, mirrored custom_vjp backward) wired into the tensor-parallel
+  layers behind ``PADDLE_TPU_TP_OVERLAP``;
+- :mod:`.bucketer` — size-targeted, reverse-topological gradient comm
+  buckets (``PADDLE_TPU_BUCKET_MB``) for the sharded-optimizer stages;
+- :mod:`.xla_flags` + :mod:`.measure` — the XLA latency-hiding scheduler
+  flags (one entry point, applied before backend init, folded into the
+  AOT fingerprint) and the measured ``overlap_fraction`` (chrome-trace
+  interval intersection, or the HLO-bytes analytic bound).
+
+:func:`overlap_fingerprint` is the config identity every compiled-program
+fingerprint folds in, so toggling any of the above can never hit a stale
+cached executable.
+"""
+
+from .bucketer import (DEFAULT_BUCKET_MB, GradientBucketer,  # noqa: F401
+                       grad_bucket_bytes)
+from .collective_matmul import (MODEL_AXIS, all_gather_matmul,  # noqa: F401
+                                matmul_reduce_scatter, overlap_min_rows,
+                                should_decompose, tp_overlap_enabled)
+from .measure import (hidden_comm_seconds,  # noqa: F401
+                      overlap_fraction_from_trace)
+from .xla_flags import (OVERLAP_TPU_FLAGS, apply_overlap_xla_flags,  # noqa: F401
+                        applied_overlap_flags, effective_overlap_flags,
+                        overlap_xla_flags)
+
+__all__ = [
+    "all_gather_matmul", "matmul_reduce_scatter", "should_decompose",
+    "tp_overlap_enabled", "overlap_min_rows", "MODEL_AXIS",
+    "GradientBucketer", "grad_bucket_bytes", "DEFAULT_BUCKET_MB",
+    "overlap_xla_flags", "apply_overlap_xla_flags", "applied_overlap_flags",
+    "effective_overlap_flags", "OVERLAP_TPU_FLAGS",
+    "overlap_fraction_from_trace", "hidden_comm_seconds",
+    "overlap_fingerprint",
+]
+
+
+def overlap_fingerprint() -> dict:
+    """Deterministic identity of the overlap configuration — folded into
+    the AOT executable fingerprint (:func:`paddle_tpu.compile.fingerprint`
+    and ``TrainStep._fingerprint_extras``): same HLO text under a
+    different decomposition/bucketing/scheduler-flag regime must never
+    share a cached executable."""
+    return {
+        "tp_overlap": bool(tp_overlap_enabled()),
+        "min_rows": int(overlap_min_rows()),
+        "bucket_bytes": int(grad_bucket_bytes()),
+        # env-derived, not process-local: a relaunched child inheriting
+        # XLA_FLAGS must fingerprint identically to the parent that set
+        # them, and a user override of one key must fingerprint apart
+        "xla_flags": list(effective_overlap_flags()),
+    }

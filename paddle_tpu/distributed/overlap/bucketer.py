@@ -1,0 +1,148 @@
+"""Bucketed gradient communication (reference capability: EagerReducer's
+fused comm buckets, ``reducer.h:88`` — group grads into ~size-targeted
+buffers so the first reduction fires while the tail of backward still
+computes, instead of one collective per parameter or one monolithic one
+at the end).
+
+:class:`GradientBucketer` is the planning + coalescing core, shared by
+
+- :class:`~paddle_tpu.distributed.engine.DistributedTrainStep` — inside
+  the compiled step, each bucket's grads are concatenated and pinned with
+  a sharding constraint over the reduction axes, so XLA emits ONE
+  reduce-scatter per bucket at bucket granularity (the latency-hiding
+  scheduler then overlaps the early buckets with the remaining backward);
+- :func:`paddle_tpu.distributed.communication.coalesced_reduce_scatter` —
+  the eager bucketed collective for hand-rolled loops.
+
+Buckets are planned REVERSE-topologically (last parameter first): the
+backward pass produces the last layer's grads first, so the reversed
+order lets bucket 0 fire while earlier layers still differentiate.
+``PADDLE_TPU_BUCKET_MB`` (default 25) sets the target payload per bucket;
+0 disables bucketing. A bucket never mixes dtypes (concat constraint) and
+a single oversize tensor gets its own bucket.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, List, Optional, Sequence, Tuple
+
+__all__ = ["GradientBucketer", "grad_bucket_bytes", "DEFAULT_BUCKET_MB"]
+
+DEFAULT_BUCKET_MB = 25.0
+
+
+def grad_bucket_bytes(override: Optional[float] = None) -> int:
+    """Resolve the bucket-size target in bytes: explicit override (bytes)
+    wins, else ``PADDLE_TPU_BUCKET_MB`` (MB, default 25). <= 0 disables."""
+    if override is not None:
+        return max(0, int(override))
+    try:
+        mb = float(os.environ.get("PADDLE_TPU_BUCKET_MB", DEFAULT_BUCKET_MB))
+    except ValueError:
+        mb = DEFAULT_BUCKET_MB
+    return max(0, int(mb * 2 ** 20))
+
+
+class GradientBucketer:
+    """Plan and apply size-targeted comm buckets over an ordered tensor
+    list.
+
+    ``sizes``: per-tensor payload bytes (plan order = model/topological
+    order). ``keys``: optional per-tensor coalescing key (dtype); tensors
+    with different keys never share a bucket. ``reverse=True`` (default)
+    plans buckets over the REVERSED list — reverse-topological firing
+    order (see module docstring)."""
+
+    def __init__(self, sizes: Sequence[int], bucket_bytes: Optional[int] = None,
+                 keys: Optional[Sequence[Any]] = None, reverse: bool = True):
+        self.sizes = [int(s) for s in sizes]
+        self.bucket_bytes = grad_bucket_bytes(bucket_bytes)
+        self.reverse = bool(reverse)
+        keys = list(keys) if keys is not None else [None] * len(self.sizes)
+        if len(keys) != len(self.sizes):
+            raise ValueError("keys and sizes must have equal length")
+        self.keys = keys
+        self.buckets: List[List[int]] = self._plan()
+
+    def _plan(self) -> List[List[int]]:
+        order = range(len(self.sizes))
+        if self.reverse:
+            order = reversed(order)
+        buckets: List[List[int]] = []
+        cur: List[int] = []
+        cur_bytes = 0
+        cur_key = None
+        target = self.bucket_bytes
+        for i in order:
+            sz, key = self.sizes[i], self.keys[i]
+            if cur and (cur_key != key or
+                        (target > 0 and cur_bytes + sz > target)):
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(i)
+            cur_bytes += sz
+            cur_key = key
+        if cur:
+            buckets.append(cur)
+        return buckets
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self.buckets)
+
+    def bucket_nbytes(self) -> List[int]:
+        return [sum(self.sizes[i] for i in b) for b in self.buckets]
+
+    def bucket_of(self, index: int) -> int:
+        for bi, b in enumerate(self.buckets):
+            if index in b:
+                return bi
+        raise IndexError(index)
+
+    # -- array coalescing (jax arrays or anything numpy-like) --------------
+    def coalesce(self, arrays: Sequence[Any]) -> List[Any]:
+        """Per bucket, flatten members to 1-D and concatenate (firing
+        order). Shapes are recovered by :meth:`split`."""
+        import jax.numpy as jnp
+
+        if len(arrays) != len(self.sizes):
+            raise ValueError(
+                f"bucketer planned over {len(self.sizes)} tensors, "
+                f"got {len(arrays)}")
+        return [jnp.concatenate([arrays[i].reshape(-1) for i in b])
+                for b in self.buckets]
+
+    def split(self, bucket_arrays: Sequence[Any],
+              shapes: Sequence[Tuple[int, ...]]) -> List[Any]:
+        """Inverse of :meth:`coalesce`: recover the original list (original
+        order and shapes) from the per-bucket flats."""
+        out: List[Any] = [None] * len(self.sizes)
+        for b, flat in zip(self.buckets, bucket_arrays):
+            off = 0
+            for i in b:
+                n = 1
+                for d in shapes[i]:
+                    n *= int(d)
+                out[i] = flat[off:off + n].reshape(shapes[i])
+                off += n
+        return out
+
+    def constrain(self, grads: Sequence[Any], mesh, axes=("data", "sharding")):
+        """Trace-time application inside a compiled step: route each
+        bucket's grads through a concat pinned to shard over ``axes`` —
+        value-identity, but XLA now reduces grads at bucket granularity
+        (one reduce-scatter per bucket, reverse-topological emission order)
+        instead of per-parameter or whole-model. Returns grads with the
+        same values/shapes/order."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        live = tuple(a for a in axes if mesh.shape.get(a, 1) > 1)
+        if not live or self.num_buckets == 0:
+            return list(grads)
+        spec = live if len(live) > 1 else live[0]
+        sharding = NamedSharding(mesh, P(spec))
+        flats = self.coalesce(grads)
+        flats = [jax.lax.with_sharding_constraint(f, sharding) for f in flats]
+        return self.split(flats, [tuple(g.shape) for g in grads])

@@ -27,12 +27,24 @@ def group_sharded_parallel(model: Layer, optimizer, level: str, scaler=None,
         raise ValueError(f"level must be one of {sorted(_LEVELS)}, got {level!r}")
     optimizer._sharding_stage = _LEVELS[level]
     model._sharding_stage = _LEVELS[level]
+    # gradient comm buckets (reference: GroupShardedStage2's comm buffers,
+    # `group_sharded_stage2.py` _redefine_opt_step grouping): a non-default
+    # ``buffer_max_size`` is an explicit per-call override of the bucket
+    # target; otherwise PADDLE_TPU_BUCKET_MB (default 25) decides. The
+    # engine reads ``optimizer._grad_bucket_bytes`` when it builds its
+    # reverse-topological GradientBucketer (distributed/overlap).
+    from .overlap import grad_bucket_bytes
+
+    bucket_bytes = int(buffer_max_size) if buffer_max_size != 2 ** 23 \
+        else grad_bucket_bytes()
+    optimizer._grad_bucket_bytes = bucket_bytes
     try:  # telemetry: the stage decides which grad collective the engine
         # registers (all_reduce vs reduce_scatter) — record the transition
         from .. import telemetry
 
         telemetry.record_event("sharding", f"group_sharded_{level}",
-                               stage=_LEVELS[level], offload=bool(offload))
+                               stage=_LEVELS[level], offload=bool(offload),
+                               grad_bucket_bytes=bucket_bytes)
     except Exception:
         pass
     # offload (reference `group_sharded_stage3.py:85`): optimizer-state /

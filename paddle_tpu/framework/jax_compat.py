@@ -22,7 +22,7 @@ from typing import Optional, Set
 
 import jax
 
-__all__ = ["shard_map", "pcast"]
+__all__ = ["shard_map", "pcast", "bound_axis_names"]
 
 
 def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False,
@@ -46,3 +46,30 @@ def pcast(x, axes, to: str = "varying"):
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, axes, to=to)
     return x  # pre-VMA jax: nothing to cast
+
+
+def bound_axis_names() -> Set[str]:
+    """Mesh axis names currently bound as MANUAL by an enclosing shard_map
+    (empty when tracing/running outside one). The overlap layer uses this to
+    refuse a nested shard_map — e.g. a TP layer invoked inside the compiled
+    pipeline engine's manual "pipe" region, where opening a second manual
+    region would fail at trace time. Probes are version-layered like the
+    rest of this module; an unknown jax surface reports *no* axes (the
+    caller then behaves as it did before this seam existed)."""
+    try:  # jax >= 0.5 keeps an axis-env accessor on the public core
+        env = jax.core.get_axis_env()
+        return set(getattr(env, "axis_sizes", {}).keys())
+    except Exception:
+        pass
+    try:  # jax 0.4.x
+        from jax._src.core import get_axis_env
+
+        return set(get_axis_env().axis_sizes.keys())
+    except Exception:
+        pass
+    try:
+        from jax._src.core import unsafe_get_axis_names
+
+        return set(unsafe_get_axis_names())
+    except Exception:
+        return set()

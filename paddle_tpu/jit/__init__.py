@@ -461,9 +461,19 @@ class TrainStep:
     def _fingerprint_extras(self, tag: str) -> Dict[str, Any]:
         """Program identity beyond the StableHLO text: anything that could
         make the 'same' HLO compile to an incompatible executable must be
-        in here (DistributedTrainStep adds mesh + sharding pins)."""
-        return {"tag": tag, "donate": bool(self._donate),
-                "merge_k": self._merge_k}
+        in here (DistributedTrainStep adds mesh + sharding pins). The
+        overlap config (TP decomposition, grad buckets, scheduler flags)
+        rides along so toggling PADDLE_TPU_TP_OVERLAP / bucket size can
+        never warm-load a stale decomposition."""
+        extras = {"tag": tag, "donate": bool(self._donate),
+                  "merge_k": self._merge_k}
+        try:
+            from ..distributed.overlap import overlap_fingerprint
+
+            extras["overlap"] = overlap_fingerprint()
+        except Exception:
+            pass
+        return extras
 
     def _note_compile(self, info: Dict[str, Any]) -> None:
         self.compile_events.append(info)
@@ -507,6 +517,13 @@ class TrainStep:
         reshape (DistributedTrainStep overrides to keep the batch axes on
         the data mesh dims)."""
         return arrays
+
+    def _comm_grads(self, grads):
+        """Hook: gradient-communication shaping between backward and clip
+        (value-identity). DistributedTrainStep overrides to route grads
+        through reverse-topological comm buckets so XLA emits one
+        reduce-scatter per bucket instead of a monolithic one."""
+        return grads
 
     def _step(self, param_arrays, opt_states, buffer_arrays, key, lr, batch_arrays,
               check_numerics: bool = False, health_probe: bool = False):
@@ -570,6 +587,7 @@ class TrainStep:
                 ok &= jnp.all(jnp.isfinite(g))
             gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
                                  for g in grads))
+        grads = self._comm_grads(grads)
         grads = self._clip_grads(grads)
         new_params, new_states = [], []
         for i, (p_arr, g, st) in enumerate(zip(compute_params, grads, opt_states)):

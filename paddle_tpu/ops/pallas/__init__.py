@@ -28,6 +28,19 @@ def sds_like(shape, dtype, like):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
+def tpu_compiler_params(**kwargs):
+    """Version seam for the pallas TPU compiler-params class: jax >= 0.5
+    calls it ``pltpu.CompilerParams``; 0.4.x named it
+    ``TPUCompilerParams`` (same fields). Every kernel's pallas_call routes
+    through here so one probe decides the dialect (the jax_compat
+    pattern)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or \
+        getattr(pltpu, "TPUCompilerParams")
+    return cls(**kwargs)
+
+
 from .flash_attention import flash_attention, flash_attention_supported
 from .fused_norm import fused_rms_norm
 from .rope import fused_rope

@@ -13,7 +13,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from . import sds_like
+from . import sds_like, tpu_compiler_params
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -127,7 +127,7 @@ def _rms_bwd(eps, interpret, res, dy):
             sds_like((1, h), weight.dtype, x),
         ],
         scratch_shapes=[pltpu.VMEM((1, h), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(x2, weight.reshape(1, h), rstd, dy.reshape(n, h))

@@ -195,6 +195,13 @@ class TracedProgram:
         self.tag = tag
         self.collectives = [dict(c) for c in collectives]
         self.executions = 0
+        # measured comm/compute overlap: the fraction of this program's
+        # collective wall-time hidden under concurrent compute. None until
+        # someone MEASURES it (chrome-trace interval intersection or the
+        # HLO-bytes analytic bound — distributed/overlap/measure.py);
+        # never guessed here.
+        self.overlap_fraction: Optional[float] = None
+        self._overlap_source: Optional[str] = None
         # profile is static: price it once, not per step (and never under
         # the aggregate lock — ici_cost_estimate may resolve jax.devices())
         self._per_exec = []
@@ -205,6 +212,23 @@ class TracedProgram:
             self._per_exec.append(
                 (c["kind"], n, int(c["nbytes"]) * n,
                  cost["wire_bytes"] * n, cost["est_s"] * n))
+
+    def set_overlap_fraction(self, fraction: float,
+                             source: str = "measured") -> None:
+        """Attach a MEASURED comm/compute overlap fraction (collective
+        time ∧ compute time over collective time) to this program —
+        exported through StepMeter summaries, the prometheus gauge, and
+        bench detail. ``source`` names the measurement path
+        ("chrome_trace" | "hlo_bytes" | custom)."""
+        self.overlap_fraction = max(0.0, min(1.0, float(fraction)))
+        self._overlap_source = source
+        runtime.set_gauge("overlap_fraction_last", self.overlap_fraction)
+        record_event("overlap", self.tag,
+                     overlap_fraction=round(self.overlap_fraction, 4),
+                     source=source)
+
+    def wire_bytes_per_execution(self) -> float:
+        return sum(w for _, _, _, w, _ in self._per_exec)
 
     def record_execution(self) -> None:
         if not runtime.enabled():

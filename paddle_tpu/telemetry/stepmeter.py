@@ -114,6 +114,22 @@ class StepMeter:
     def _coll_totals() -> Dict[str, float]:
         return {k: v["bytes"] for k, v in collective_stats().items()}
 
+    @staticmethod
+    def _overlap_fraction():
+        """Wire-byte-weighted mean of the MEASURED overlap fractions
+        attached to registered TracedPrograms (None when nothing measured
+        one — the meter never guesses)."""
+        from .collectives import traced_programs
+
+        num = den = 0.0
+        for prog in traced_programs().values():
+            if prog.overlap_fraction is None:
+                continue
+            w = max(prog.wire_bytes_per_execution(), 1.0)
+            num += prog.overlap_fraction * w
+            den += w
+        return (num / den) if den else None
+
     def begin(self) -> None:
         """Re-arm the step timer (e.g. after a pause); optional — the
         constructor arms it."""
@@ -246,6 +262,9 @@ class StepMeter:
         out["hbm_live_max_gb"] = self._hbm_live_max_gb
         out["collective_bytes"] = dict(self._coll_agg)
         out["steps_skipped"] = self.steps_skipped
+        frac = self._overlap_fraction()
+        if frac is not None:
+            out["overlap_fraction"] = round(frac, 4)
         if self._first_loss is not None:
             out["first_loss"] = self._first_loss
             out["final_loss"] = self._last_loss

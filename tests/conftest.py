@@ -26,6 +26,11 @@ if "PADDLE_TPU_FLIGHT_RECORDER_DIR" not in os.environ:
 if "PADDLE_TPU_COMPILE_CACHE" not in os.environ:
     os.environ["PADDLE_TPU_COMPILE_CACHE"] = \
         tempfile.mkdtemp(prefix="paddle_tpu_xla_cache_")
+# the overlap layer's latency-hiding XLA flags are TPU-only (--xla_tpu_*
+# aborts the CPU backend on unknown flags) and would change compiled
+# schedules between runs — pin them to a no-op so tier-1 stays
+# deterministic regardless of what any test calls
+os.environ["PADDLE_TPU_XLA_OVERLAP_FLAGS"] = "0"
 
 import jax  # noqa: E402
 

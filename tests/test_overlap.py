@@ -1,0 +1,566 @@
+"""Comm/compute overlap layer (distributed/overlap): ring-decomposed
+collective matmul numerics + mirrored-vjp grads vs the reference einsum,
+GradientBucketer planning/coalescing properties, env-flag gating, AOT
+fingerprint sensitivity, XLA-flag CPU no-op, and the measured
+overlap_fraction plumbing (chrome-trace intersection + StepMeter export).
+
+Tier-1 FAST lane (``-m overlap``)."""
+
+import os
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed.overlap import (GradientBucketer,
+                                            all_gather_matmul,
+                                            grad_bucket_bytes,
+                                            hidden_comm_seconds,
+                                            matmul_reduce_scatter,
+                                            overlap_fraction_from_trace,
+                                            overlap_fingerprint,
+                                            should_decompose)
+from paddle_tpu.distributed.topology import build_mesh
+
+pytestmark = pytest.mark.overlap
+
+
+@pytest.fixture
+def mesh_mp4():
+    return build_mesh(mp=4, devices=jax.devices()[:4])
+
+
+@pytest.fixture
+def mesh_dp2mp2():
+    return build_mesh(dp=2, mp=2, devices=jax.devices()[:4])
+
+
+@pytest.fixture
+def overlap_on(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_TP_OVERLAP", "1")
+    monkeypatch.setenv("PADDLE_TPU_TP_OVERLAP_MIN_ROWS", "1")
+
+
+# ---------------------------------------------------------------------------
+# collective matmul numerics (fwd + grad, fp32 and bf16) vs reference einsum
+
+
+class TestCollectiveMatmulNumerics:
+    def _xw(self, m, k, n, dtype=np.float32, seed=0):
+        rng = np.random.default_rng(seed)
+        return (rng.standard_normal((m, k)).astype(dtype),
+                rng.standard_normal((k, n)).astype(dtype))
+
+    def test_all_gather_matmul_forward_fp32(self, mesh_mp4):
+        x, w = self._xw(16, 12, 8)
+        out = all_gather_matmul(jnp.asarray(x), jnp.asarray(w), mesh_mp4)
+        np.testing.assert_allclose(np.asarray(out), x @ w,
+                                   rtol=1e-6, atol=1e-5)
+
+    def test_matmul_reduce_scatter_forward_fp32(self, mesh_mp4):
+        x, w = self._xw(16, 12, 8, seed=1)
+        out = matmul_reduce_scatter(jnp.asarray(x), jnp.asarray(w), mesh_mp4)
+        np.testing.assert_allclose(np.asarray(out), x @ w,
+                                   rtol=1e-6, atol=1e-5)
+
+    @pytest.mark.parametrize("prim", [all_gather_matmul,
+                                      matmul_reduce_scatter])
+    def test_grads_match_reference_fp32(self, mesh_mp4, prim):
+        """The custom_vjp mirrored rings must produce the einsum grads."""
+        x, w = self._xw(16, 12, 8, seed=2)
+
+        def loss(xx, ww):
+            return jnp.sum(jnp.sin(prim(xx, ww, mesh_mp4)))
+
+        def ref(xx, ww):
+            return jnp.sum(jnp.sin(xx @ ww))
+
+        gx, gw = jax.jit(jax.grad(loss, argnums=(0, 1)))(jnp.asarray(x),
+                                                         jnp.asarray(w))
+        rx, rw = jax.grad(ref, argnums=(0, 1))(jnp.asarray(x),
+                                               jnp.asarray(w))
+        np.testing.assert_allclose(np.asarray(gx), np.asarray(rx),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gw), np.asarray(rw),
+                                   rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("prim", [all_gather_matmul,
+                                      matmul_reduce_scatter])
+    def test_bf16_tolerance(self, mesh_mp4, prim):
+        x, w = self._xw(16, 12, 8, seed=3)
+        xb, wb = jnp.asarray(x, jnp.bfloat16), jnp.asarray(w, jnp.bfloat16)
+        out = prim(xb, wb, mesh_mp4)
+        assert out.dtype == jnp.bfloat16
+        ref = np.asarray(jnp.dot(xb, wb), np.float32)
+        np.testing.assert_allclose(np.asarray(out, np.float32), ref,
+                                   rtol=5e-2, atol=5e-2)
+
+    def test_composes_with_data_axis(self, mesh_dp2mp2):
+        """Rows stay sharded over "data" inside the manual region — the
+        decomposition must not gather activations across DP replicas."""
+        x, w = self._xw(8, 12, 8, seed=4)
+        out = jax.jit(lambda a, b: all_gather_matmul(a, b, mesh_dp2mp2))(
+            jnp.asarray(x), jnp.asarray(w))
+        np.testing.assert_allclose(np.asarray(out), x @ w,
+                                   rtol=1e-6, atol=1e-5)
+
+    @pytest.mark.parametrize("prim", [all_gather_matmul,
+                                      matmul_reduce_scatter])
+    def test_grads_with_data_axis(self, mesh_dp2mp2, prim):
+        """dW on a DP mesh: each data-group computes a partial from its
+        row block — the backward must psum those partials over the batch
+        axes (regression: the global-vjp restructure initially dropped
+        every group's contribution but one)."""
+        x, w = self._xw(8, 12, 8, seed=7)
+
+        def loss(xx, ww):
+            return jnp.sum(jnp.sin(prim(xx, ww, mesh_dp2mp2)))
+
+        gx, gw = jax.jit(jax.grad(loss, argnums=(0, 1)))(jnp.asarray(x),
+                                                         jnp.asarray(w))
+        rx, rw = jax.grad(lambda a, b: jnp.sum(jnp.sin(a @ b)),
+                          argnums=(0, 1))(jnp.asarray(x), jnp.asarray(w))
+        np.testing.assert_allclose(np.asarray(gx), np.asarray(rx),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gw), np.asarray(rw),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_hlo_is_ring_decomposed(self, mesh_mp4):
+        """The compiled grad program must contain collective-permutes (the
+        ring) and no all-gather — the collectives this layer eliminates."""
+        x, w = self._xw(16, 12, 8, seed=5)
+
+        def loss(xx, ww):
+            return jnp.sum(all_gather_matmul(xx, ww, mesh_mp4) ** 2)
+
+        txt = jax.jit(jax.grad(loss, argnums=(0, 1))).lower(
+            jnp.asarray(x), jnp.asarray(w)).compile().as_text()
+        assert len(re.findall(r"collective-permute", txt)) > 0
+        assert "all-gather(" not in txt and "all-gather-start(" not in txt
+
+    def test_p2_bitwise_identical_to_fused(self):
+        """At p=2 both paths sum the same two partial products — the
+        decomposed trajectory must be BIT-identical to fused GSPMD (the
+        bench's parity gate relies on this)."""
+        mesh = build_mesh(mp=2, devices=jax.devices()[:2])
+        rng = np.random.default_rng(6)
+        x = rng.standard_normal((8, 12)).astype(np.float32)
+        w = rng.standard_normal((12, 8)).astype(np.float32)
+
+        def fused(a, b):
+            a = jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, P(None, "model")))
+            b = jax.lax.with_sharding_constraint(
+                b, NamedSharding(mesh, P("model", None)))
+            return jax.lax.with_sharding_constraint(
+                a @ b, NamedSharding(mesh, P(None, None)))
+
+        dec = np.asarray(jax.jit(
+            lambda a, b: matmul_reduce_scatter(a, b, mesh))(x, w))
+        ref = np.asarray(jax.jit(fused)(x, w))
+        assert np.array_equal(dec, ref)
+
+
+# ---------------------------------------------------------------------------
+# gating
+
+
+class TestGating:
+    def test_env_kill_switch(self, mesh_mp4, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_TP_OVERLAP_MIN_ROWS", "1")
+        monkeypatch.setenv("PADDLE_TPU_TP_OVERLAP", "1")
+        assert should_decompose((16, 12), mesh_mp4)
+        monkeypatch.setenv("PADDLE_TPU_TP_OVERLAP", "0")
+        assert not should_decompose((16, 12), mesh_mp4)
+
+    def test_shape_threshold(self, mesh_mp4, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_TP_OVERLAP", "1")
+        monkeypatch.setenv("PADDLE_TPU_TP_OVERLAP_MIN_ROWS", "8")
+        assert should_decompose((32, 12), mesh_mp4)      # 8 rows/chunk
+        assert not should_decompose((16, 12), mesh_mp4)  # 4 rows/chunk
+
+    def test_divisibility_and_degree(self, overlap_on, mesh_mp4):
+        assert not should_decompose((15, 12), mesh_mp4)  # 15 % 4 != 0
+        mesh1 = build_mesh(dp=4, devices=jax.devices()[:4])
+        assert not should_decompose((16, 12), mesh1)     # model degree 1
+
+    def test_pipe_mesh_stays_fused(self, overlap_on):
+        mesh = build_mesh(mp=2, pp=2, devices=jax.devices()[:4])
+        assert not should_decompose((16, 12), mesh)
+
+    def test_refuses_nested_manual_region(self, overlap_on, mesh_mp4):
+        """Inside another shard_map body (the compiled pipeline engine)
+        the decomposition must gate off instead of raising on a nested
+        manual region."""
+        from paddle_tpu.framework.jax_compat import shard_map
+
+        seen = []
+
+        def body(x):
+            seen.append(should_decompose((16, 12), mesh_mp4))
+            return x
+
+        mesh = build_mesh(mp=4, devices=jax.devices()[:4])
+        shard_map(body, mesh, P("model"), P("model"), check_vma=False)(
+            jnp.arange(8, dtype=jnp.float32))
+        assert seen and not any(seen)
+
+
+# ---------------------------------------------------------------------------
+# mp_layers integration
+
+
+@pytest.fixture
+def hcg_mp2():
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import topology
+
+    saved = topology.get_hybrid_communicate_group()
+    strategy = dist.fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                               "pp_degree": 1, "sharding_degree": 2,
+                               "sep_degree": 1}
+    dist.fleet.init(is_collective=True, strategy=strategy)
+    yield dist.get_hybrid_communicate_group()
+    topology._hcg = saved
+
+
+class TestMpLayersIntegration:
+    def test_column_row_overlap_matches_fused(self, hcg_mp2, overlap_on,
+                                              monkeypatch):
+        from paddle_tpu.distributed.meta_parallel.mp_layers import (
+            ColumnParallelLinear, RowParallelLinear)
+
+        paddle.seed(0)
+        col = ColumnParallelLinear(16, 32, gather_output=False)
+        row = RowParallelLinear(32, 16, input_is_parallel=True)
+        x = paddle.to_tensor(np.random.default_rng(0)
+                             .standard_normal((8, 16)).astype(np.float32))
+        y_dec = row(col(x)).numpy()
+        monkeypatch.setenv("PADDLE_TPU_TP_OVERLAP", "0")
+        y_ref = row(col(x)).numpy()
+        # p=2: same partial products, same 2-term sums — exact
+        np.testing.assert_array_equal(y_dec, y_ref)
+
+    def test_eager_tape_grads_match(self, hcg_mp2, overlap_on, monkeypatch):
+        from paddle_tpu.distributed.meta_parallel.mp_layers import (
+            ColumnParallelLinear)
+
+        paddle.seed(1)
+        col = ColumnParallelLinear(16, 32, gather_output=True)
+        xv = np.random.default_rng(1).standard_normal((8, 16)) \
+            .astype(np.float32)
+
+        def grads(overlap):
+            monkeypatch.setenv("PADDLE_TPU_TP_OVERLAP", overlap)
+            x = paddle.to_tensor(xv, stop_gradient=False)
+            col.weight.clear_grad()
+            col(x).sum().backward()
+            return x.grad.numpy().copy(), col.weight.grad.numpy().copy()
+
+        dx1, dw1 = grads("1")
+        dx0, dw0 = grads("0")
+        np.testing.assert_allclose(dx1, dx0, rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(dw1, dw0, rtol=1e-6, atol=1e-6)
+
+    def test_parallel_ce_never_gathers_logits(self, hcg_mp2):
+        """Satellite: the one_hot is constrained BEFORE it meets the
+        logits, so the compiled loss+grad program contains no all-gather
+        of a full [B, V] tensor (walked from the optimized HLO — the
+        collective-bytes assertion)."""
+        from paddle_tpu.distributed.meta_parallel import ParallelCrossEntropy
+        from paddle_tpu.tensor.tensor import Tensor
+
+        mesh = hcg_mp2.mesh
+        B, V = 8, 64
+        pce = ParallelCrossEntropy()
+        labels = jnp.asarray(np.random.default_rng(2).integers(0, V, (B,)))
+
+        def loss(lg):
+            lg = jax.lax.with_sharding_constraint(
+                lg, NamedSharding(mesh, P(None, "model")))
+            return jnp.sum(pce(Tensor(lg), Tensor(labels))._value)
+
+        logits = jnp.asarray(np.random.default_rng(3)
+                             .standard_normal((B, V)).astype(np.float32))
+        txt = jax.jit(jax.grad(loss)).lower(logits).compile().as_text()
+        full_row_bytes = B * V * 4
+        for m in re.finditer(r"=\s*(.*?)\s+all-gather(?:-start)?\(", txt):
+            size = 0
+            for dm in re.finditer(r"(f32|bf16|f16)\[([\d,]*)\]", m.group(1)):
+                s = 4 if dm.group(1) == "f32" else 2
+                for d in dm.group(2).split(","):
+                    if d.strip():
+                        s *= int(d)
+                size += s
+            assert size < full_row_bytes, \
+                f"full logits row gathered: {m.group(0)}"
+
+
+# ---------------------------------------------------------------------------
+# bucketer
+
+
+class TestGradientBucketer:
+    def test_plan_covers_all_indices_once_reverse_order(self):
+        b = GradientBucketer([100] * 7, bucket_bytes=250)
+        flat = [i for bucket in b.buckets for i in bucket]
+        assert sorted(flat) == list(range(7))
+        assert flat == list(reversed(range(7)))  # reverse-topological
+        assert all(sum(100 for _ in bk) <= 250 for bk in b.buckets)
+
+    def test_oversize_param_gets_own_bucket(self):
+        b = GradientBucketer([10, 1000, 10], bucket_bytes=100)
+        assert [sorted(bk) for bk in b.buckets] == [[2], [1], [0]]
+
+    def test_dtype_keys_never_mix(self):
+        b = GradientBucketer([10, 10, 10, 10], bucket_bytes=10 ** 6,
+                             keys=["f32", "f32", "bf16", "f32"])
+        for bk in b.buckets:
+            assert len({["f32", "f32", "bf16", "f32"][i] for i in bk}) == 1
+
+    def test_zero_bucket_bytes_is_one_bucket_per_nothing(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_BUCKET_MB", "0")
+        assert grad_bucket_bytes() == 0
+
+    def test_env_default_25mb(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_TPU_BUCKET_MB", raising=False)
+        assert grad_bucket_bytes() == 25 * 2 ** 20
+
+    def test_coalesce_split_round_trip(self):
+        rng = np.random.default_rng(0)
+        arrays = [jnp.asarray(rng.standard_normal(s).astype(np.float32))
+                  for s in [(4, 3), (2,), (5, 2, 2)]]
+        sizes = [a.size * 4 for a in arrays]
+        b = GradientBucketer(sizes, bucket_bytes=60)
+        flats = b.coalesce(arrays)
+        assert len(flats) == b.num_buckets
+        back = b.split(flats, [a.shape for a in arrays])
+        for a, r in zip(arrays, back):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(r))
+
+    def test_constrain_is_value_identity(self, mesh_dp2mp2):
+        rng = np.random.default_rng(1)
+        grads = [jnp.asarray(rng.standard_normal((8, 4)).astype(np.float32)),
+                 jnp.asarray(rng.standard_normal((16,)).astype(np.float32))]
+        b = GradientBucketer([g.size * 4 for g in grads], bucket_bytes=64)
+        out = jax.jit(lambda gs: b.constrain(gs, mesh_dp2mp2))(grads)
+        for g, o in zip(grads, out):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(o))
+
+
+class TestEngineBucketing:
+    def test_bucketed_step_matches_unbucketed(self, hcg_mp2, monkeypatch):
+        """Stage-2 DistributedTrainStep with tiny buckets (many of them)
+        must train the exact same trajectory as with bucketing disabled —
+        the constraint is wire-shaping, never math."""
+        from paddle_tpu.distributed import DistributedTrainStep
+
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((8, 16)).astype(np.float32)
+        y = rng.standard_normal((8, 8)).astype(np.float32)
+
+        def run(bucket_mb):
+            monkeypatch.setenv("PADDLE_TPU_BUCKET_MB", bucket_mb)
+            paddle.seed(7)
+            m = nn.Sequential(nn.Linear(16, 32), nn.Tanh(), nn.Linear(32, 8))
+            opt = paddle.optimizer.AdamW(1e-2, parameters=m.parameters())
+            step = DistributedTrainStep(
+                m, lambda mm, a, b: F.mse_loss(mm(a), b), opt, hcg_mp2,
+                sharding_stage=2)
+            return step, [float(step(paddle.to_tensor(x),
+                                     paddle.to_tensor(y)).numpy())
+                          for _ in range(2)]
+
+        s_b, losses_b = run("0.0001")   # ~100-byte buckets → many
+        assert s_b._grad_bucketer is not None
+        assert s_b._grad_bucketer.num_buckets > 1
+        s_n, losses_n = run("0")        # disabled
+        assert s_n._grad_bucketer is None
+        np.testing.assert_allclose(losses_b, losses_n, rtol=0, atol=0)
+
+    def test_fingerprint_extras_include_buckets(self, hcg_mp2, monkeypatch):
+        from paddle_tpu.distributed import DistributedTrainStep
+
+        monkeypatch.setenv("PADDLE_TPU_BUCKET_MB", "0.0001")
+        paddle.seed(8)
+        m = nn.Sequential(nn.Linear(16, 8))
+        opt = paddle.optimizer.AdamW(1e-2, parameters=m.parameters())
+        step = DistributedTrainStep(m, lambda mm, a, b: F.mse_loss(mm(a), b),
+                                    opt, hcg_mp2, sharding_stage=1)
+        ex = step._fingerprint_extras("step")
+        assert ex["grad_buckets"] is not None
+        assert ex["grad_buckets"]["buckets"] == step._grad_bucketer.buckets
+        assert "overlap" in ex
+
+
+class TestCoalescedReduceScatter:
+    def test_matches_per_tensor_reduce_scatter(self, hcg_mp2):
+        from paddle_tpu.distributed import communication as comm
+
+        g = hcg_mp2.get_data_parallel_group()
+        rng = np.random.default_rng(4)
+        a = rng.standard_normal((4, 3)).astype(np.float32)
+        b = rng.standard_normal((2, 5)).astype(np.float32)
+        ta = comm.scatter_stack(paddle.to_tensor(a), g)
+        tb = comm.scatter_stack(paddle.to_tensor(b), g)
+        out = comm.coalesced_reduce_scatter([ta, tb], group=g)
+        np.testing.assert_allclose(out[0].numpy(), a[:2] + a[2:],
+                                   rtol=1e-6)
+        np.testing.assert_allclose(out[1].numpy(), b[:1] + b[1:],
+                                   rtol=1e-6)
+
+    def test_one_collective_per_bucket(self, hcg_mp2):
+        from paddle_tpu import telemetry
+        from paddle_tpu.distributed import communication as comm
+
+        g = hcg_mp2.get_data_parallel_group()
+        ts = [comm.scatter_stack(
+            paddle.to_tensor(np.ones((2, 4), np.float32)), g)
+            for _ in range(6)]
+        telemetry.reset()
+        comm.coalesced_reduce_scatter(ts, group=g)  # all fit one bucket
+        stats = telemetry.collective_stats()
+        assert stats["reduce_scatter"]["calls"] == 1
+
+
+# ---------------------------------------------------------------------------
+# xla flags, fingerprint, measurement
+
+
+class TestXlaFlags:
+    def test_cpu_is_noop(self, monkeypatch):
+        from paddle_tpu.distributed.overlap import (apply_overlap_xla_flags,
+                                                    overlap_xla_flags)
+
+        monkeypatch.setenv("PADDLE_TPU_XLA_OVERLAP_FLAGS", "1")
+        assert overlap_xla_flags(platform="cpu") == ()
+        assert apply_overlap_xla_flags(platform="cpu") == ()
+
+    def test_tpu_set_is_nonempty_and_killable(self, monkeypatch):
+        from paddle_tpu.distributed.overlap import overlap_xla_flags
+
+        monkeypatch.setenv("PADDLE_TPU_XLA_OVERLAP_FLAGS", "1")
+        flags = overlap_xla_flags(platform="tpu")
+        assert any("latency_hiding_scheduler" in f for f in flags)
+        monkeypatch.setenv("PADDLE_TPU_XLA_OVERLAP_FLAGS", "0")
+        assert overlap_xla_flags(platform="tpu") == ()
+
+    def test_user_override_respected_and_not_claimed_applied(self,
+                                                             monkeypatch):
+        """A user-set key (even with a different value) is never
+        re-applied, never counted as applied, and key matching is
+        token-exact (a key that prefixes another key must not mask it)."""
+        from paddle_tpu.distributed.overlap import xla_flags as xf
+
+        monkeypatch.setenv("PADDLE_TPU_XLA_OVERLAP_FLAGS", "1")
+        monkeypatch.setenv(
+            "XLA_FLAGS",
+            "--xla_tpu_enable_latency_hiding_scheduler=false "
+            "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true")
+        monkeypatch.setattr(xf, "_backend_initialized", lambda: False)
+        applied = xf.apply_overlap_xla_flags(platform="tpu")
+        cur = os.environ["XLA_FLAGS"].split()
+        # the user's "false" survives, exactly once
+        assert cur.count(
+            "--xla_tpu_enable_latency_hiding_scheduler=false") == 1
+        assert not any(f.startswith(
+            "--xla_tpu_enable_latency_hiding_scheduler=true")
+            for f in cur)
+        assert all(f.split("=")[0] != (
+            "--xla_tpu_enable_latency_hiding_scheduler")
+            for f in applied)
+        # prefix key: base fusion flag must still have been applied even
+        # though a longer key containing it was pre-set
+        assert "--xla_tpu_enable_async_collective_fusion=true" in cur
+
+    def test_effective_flags_env_derived_for_fingerprint(self, monkeypatch):
+        """Fingerprints must see flags INHERITED via XLA_FLAGS (supervisor
+        relaunch) and distinguish a user override value."""
+        from paddle_tpu.distributed.overlap import effective_overlap_flags
+
+        monkeypatch.setenv(
+            "XLA_FLAGS",
+            "--xla_force_host_platform_device_count=8 "
+            "--xla_tpu_enable_latency_hiding_scheduler=false")
+        eff = effective_overlap_flags()
+        assert eff == ("--xla_tpu_enable_latency_hiding_scheduler=false",)
+        fp_off = overlap_fingerprint()
+        monkeypatch.setenv(
+            "XLA_FLAGS", "--xla_tpu_enable_latency_hiding_scheduler=true")
+        assert overlap_fingerprint() != fp_off
+
+
+class TestFingerprintSensitivity:
+    def test_fingerprint_changes_with_overlap_config(self, monkeypatch):
+        from paddle_tpu.compile import fingerprint
+
+        monkeypatch.setenv("PADDLE_TPU_TP_OVERLAP", "1")
+        monkeypatch.setenv("PADDLE_TPU_BUCKET_MB", "25")
+        fp_base = fingerprint("module {}")
+        assert fp_base == fingerprint("module {}")  # deterministic
+        monkeypatch.setenv("PADDLE_TPU_TP_OVERLAP", "0")
+        fp_no_overlap = fingerprint("module {}")
+        assert fp_no_overlap != fp_base
+        monkeypatch.setenv("PADDLE_TPU_TP_OVERLAP", "1")
+        monkeypatch.setenv("PADDLE_TPU_BUCKET_MB", "7")
+        assert fingerprint("module {}") not in (fp_base, fp_no_overlap)
+
+    def test_overlap_fingerprint_shape(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_TP_OVERLAP", "1")
+        fp = overlap_fingerprint()
+        assert set(fp) == {"tp_overlap", "min_rows", "bucket_bytes",
+                           "xla_flags"}
+
+
+class TestMeasurement:
+    def test_trace_intersection(self):
+        events = [
+            # 100us collective, 60us of it under compute
+            {"ph": "X", "name": "collective-permute.1", "ts": 0,
+             "dur": 100},
+            {"ph": "X", "name": "fusion.7", "ts": 40, "dur": 60},
+            # telemetry-cat events never count as compute
+            {"ph": "X", "name": "whatever", "cat": "telemetry", "ts": 0,
+             "dur": 1000},
+        ]
+        assert overlap_fraction_from_trace(events) == pytest.approx(0.6)
+
+    def test_trace_without_collectives_is_none(self):
+        assert overlap_fraction_from_trace(
+            [{"ph": "X", "name": "fusion.1", "ts": 0, "dur": 5}]) is None
+
+    def test_hidden_comm_seconds(self):
+        acct = hidden_comm_seconds(overlappable_s=2.0, exposed_s=1.0,
+                                   compute_s=10.0)
+        assert acct["hidden_s"] == 2.0
+        assert acct["exposed_s"] == 1.0
+        assert acct["overlap_fraction"] == pytest.approx(2.0 / 3.0)
+        # compute-starved: only part of the ring time can hide
+        acct = hidden_comm_seconds(2.0, 1.0, compute_s=0.5)
+        assert acct["hidden_s"] == 0.5
+        assert acct["exposed_s"] == pytest.approx(2.5)
+
+    def test_traced_program_export_via_stepmeter(self):
+        from paddle_tpu import telemetry
+
+        telemetry.reset()
+        prog = telemetry.register_traced_program(
+            "overlap_test_prog",
+            [{"kind": "ppermute", "nbytes": 1024, "group_size": 4,
+              "count": 3}])
+        meter = telemetry.StepMeter("overlap_test", jsonl_path=False)
+        meter.step()
+        assert "overlap_fraction" not in meter.summary()  # never guessed
+        prog.set_overlap_fraction(0.8, source="chrome_trace")
+        assert meter.summary()["overlap_fraction"] == pytest.approx(0.8)
+        assert telemetry.counters()["overlap_fraction_last"] == \
+            pytest.approx(0.8)
+        telemetry.reset()
