@@ -1271,7 +1271,7 @@ _COMPACT_KEYS = (
     "slice_tokens_per_sec", "virtual_stages", "micro_batches",
     "cache_gb_read_per_step", "norm_target", "device", "hbm_peak_gb",
     "resume_ok", "steps_skipped", "rewinds", "compile_time_s",
-    "compile_mode", "warm_ok",
+    "compile_mode", "warm_ok", "fault_domain",
 )
 
 
@@ -1304,6 +1304,15 @@ def _resume_smoke() -> bool:
         load_state_dict(dst, latest)
         return bool((dst["w"].numpy() == src).all()
                     and int(np.asarray(dst["step"].numpy())) == 3)
+
+
+def _fault_domain_smoke() -> str:
+    """Heartbeat-lease + poison-pill round trip over a local TCPStore:
+    the bench's fast proof that the fleet fault domain works on this
+    build. Rides into the primary detail as ``fault_domain: on|off``."""
+    from paddle_tpu.distributed.fleet.fault_domain import smoke_check
+
+    return "on" if smoke_check() else "off"
 
 
 def _compact(entry: dict) -> str:
@@ -1347,6 +1356,13 @@ def main() -> None:
         primary["detail"]["resume_ok"] = _resume_smoke()
     except Exception:
         primary["detail"]["resume_ok"] = False
+    # fleet fault-domain availability (heartbeat lease + poison round trip
+    # over a local store): "on" means a gang on this build would detect a
+    # dead rank and abort in bounded time, "off" = disabled or broken
+    try:
+        primary["detail"]["fault_domain"] = _fault_domain_smoke()
+    except Exception:
+        primary["detail"]["fault_domain"] = "off"
     extras = []
     for fn, kw in ((bench_resnet, {}), (bench_gpt_tp_pp, {}),
                    (bench_llama_longctx, {}), (bench_ernie_ft, {}),

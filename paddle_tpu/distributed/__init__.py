@@ -26,6 +26,9 @@ from .parallel import (DataParallel, ParallelEnv, get_rank, get_world_size,  # n
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from .store import TCPKVStore, TCPStore, rendezvous  # noqa: F401
 from .watchdog import CommWatchdog  # noqa: F401
+from .fleet.fault_domain import (FaultDomain, HeartbeatLease,  # noqa: F401
+                                 LeaseMonitor)
+from .fleet.elastic import FleetSupervisor, GangPolicy  # noqa: F401
 from .topology import (CommGroup, HybridCommunicateGroup, build_mesh,  # noqa: F401
                        get_hybrid_communicate_group, set_hybrid_communicate_group)
 from . import rpc  # noqa: E402,F401

@@ -21,7 +21,8 @@ from ..topology import (HybridCommunicateGroup, get_hybrid_communicate_group,
                         set_hybrid_communicate_group)
 
 __all__ = ["DistributedStrategy", "init", "distributed_model", "distributed_optimizer",
-           "get_hybrid_communicate_group", "worker_index", "worker_num", "Fleet", "fleet"]
+           "get_hybrid_communicate_group", "worker_index", "worker_num", "Fleet", "fleet",
+           "fault_domain", "FaultDomain", "HeartbeatLease", "LeaseMonitor"]
 
 
 # reference `distributed_strategy.proto:359` fields paddle_tpu does NOT
@@ -242,3 +243,6 @@ worker_index = fleet.worker_index
 worker_num = fleet.worker_num
 
 from . import elastic  # noqa: E402,F401
+from . import fault_domain  # noqa: E402,F401
+from .fault_domain import (FaultDomain, HeartbeatLease,  # noqa: E402,F401
+                           LeaseMonitor)

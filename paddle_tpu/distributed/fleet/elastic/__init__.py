@@ -33,15 +33,17 @@ from __future__ import annotations
 import json
 import os
 import socket
-import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, List, Optional
+
+from ..fault_domain import FLEET_EXIT_CODE, HeartbeatLease
 
 __all__ = ["ElasticManager", "ElasticStatus", "ElasticLevel", "FileStore",
            "ELASTIC_EXIT_CODE", "PreemptionGuard", "Supervisor",
-           "RestartPolicy", "emergency_handler"]
+           "RestartPolicy", "emergency_handler", "FleetSupervisor",
+           "GangPolicy", "HeartbeatLease"]
 
-ELASTIC_EXIT_CODE = 101
+ELASTIC_EXIT_CODE = FLEET_EXIT_CODE  # 101 everywhere in the stack
 
 
 class ElasticLevel:
@@ -132,24 +134,22 @@ class ElasticManager:
         self.timeout = timeout
         self.pre_hook = pre_hook
         self.post_hook = post_hook
-        self._stop = threading.Event()
-        self._hb_thread: Optional[threading.Thread] = None
         self._key = f"{job_id}/nodes/{self.host_id}"
         self._world_key = f"{job_id}/world"
+        self._lease: Optional[HeartbeatLease] = None
         self.register()
 
     # -- membership --------------------------------------------------------
     def register(self) -> None:
-        self.store.put(self._key, {"host": self.host_id, "ts": time.time()})
-        self._hb_thread = threading.Thread(target=self._heartbeat, daemon=True)
-        self._hb_thread.start()
-
-    def _heartbeat(self) -> None:
-        while not self._stop.wait(max(0.5, self.ttl / 3)):
-            try:
-                self.store.touch(self._key)
-            except Exception:
-                pass
+        # one heartbeat implementation for the whole stack: the same
+        # HeartbeatLease the fleet fault domain publishes rank leases with,
+        # here over the elastic store backend (FileStore or TCPKVStore) —
+        # beat period matches the reference's ttl/3, floored at 0.5s
+        self._lease = HeartbeatLease(
+            self.store, self._key, ttl=self.ttl, interval=self.ttl / 3,
+            min_interval=0.5,
+            payload={"host": self.host_id, "ts": time.time()})
+        self._lease.start()
 
     def hosts(self) -> List[str]:
         """Live peers (heartbeat fresher than ttl)."""
@@ -172,12 +172,15 @@ class ElasticManager:
         """One membership check → ElasticStatus (reference watch loop body)."""
         status = self._watch_once()
         if status != ElasticStatus.HOLD:
-            try:  # flight recorder: elastic transitions bracket restarts
+            try:  # flight recorder: elastic transitions bracket restarts —
+                # one `elastic_<status>` event kind per transition (e.g.
+                # elastic_restart / elastic_completed / elastic_error), so
+                # dumps and the chrome-trace merge can filter them directly
                 from .... import telemetry
 
-                telemetry.record_event("elastic", status,
-                                       host=self.host_id,
-                                       live=len(self.hosts()))
+                telemetry.record_event(f"elastic_{status}", self.host_id,
+                                       live=len(self.hosts()),
+                                       job_id=self.job_id)
             except Exception:
                 pass
         return status
@@ -225,10 +228,15 @@ class ElasticManager:
     def exit(self, completed: bool = False) -> None:
         if completed:
             self.store.put(f"{self.job_id}/completed", True)
-        self._stop.set()
-        if self._hb_thread is not None:
-            self._hb_thread.join(timeout=2)
-        self.store.delete(self._key)
+        if self._lease is not None:
+            self._lease.stop(release=True)
+        try:  # flight recorder: leaving is a transition too
+            from .... import telemetry
+
+            telemetry.record_event("elastic_exit", self.host_id,
+                                   completed=completed, job_id=self.job_id)
+        except Exception:
+            pass
         if self.post_hook:
             self.post_hook(completed)
 
@@ -236,3 +244,4 @@ class ElasticManager:
 from .preemption import PreemptionGuard  # noqa: E402
 from .supervisor import (RestartPolicy, Supervisor,  # noqa: E402
                          emergency_handler)
+from .gang import FleetSupervisor, GangPolicy  # noqa: E402

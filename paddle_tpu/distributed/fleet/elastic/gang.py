@@ -1,0 +1,233 @@
+"""Gang restart + elastic degrade: the fleet-level restart supervisor.
+
+PR 2's :class:`~.supervisor.Supervisor` relaunches ONE process; on a pod the
+unit of failure is the gang — when any rank dies, the launcher (with the
+fault domain's coordinated abort) tears the whole gang down, and something
+above it must relaunch the whole gang.  That something is
+:class:`FleetSupervisor`:
+
+- each attempt launches the full gang through ``launch.launch`` (the
+  pod-per-host CLI) with a fresh **gang epoch** stamped into
+  ``PADDLE_TPU_GANG_EPOCH`` — poison pills and the pre-step-0 gang barrier
+  are epoch-scoped, so a stale pill can never kill the relaunch;
+- ranks run a store **barrier with deadline** before step 0
+  (``FaultDomain.gang_barrier``; ``PADDLE_TPU_GANG_BARRIER=1`` exported
+  here), then resume from ``latest_checkpoint(ckpt_root)`` exactly like the
+  single-process supervisor path;
+- restarts are bounded per world size (``GangPolicy.max_gang_restarts``,
+  env ``PADDLE_TPU_GANG_RESTARTS``) with the same seeded backoff as
+  :class:`~.supervisor.RestartPolicy`;
+- after the budget is exhausted with a persistently failing gang, the
+  supervisor **degrades**: it relaunches at reduced world size
+  (``nproc_per_node - 1`` per degrade step, floored at
+  ``GangPolicy.min_procs``), shrinking the DP degree — the relaunched ranks
+  ride the checkpoint reshard-on-load path under the smaller mesh (the
+  "resume under a different mesh" property PR 2's tests established).
+
+usage::
+
+    sup = FleetSupervisor("train.py", [ckpt_root],
+                          nproc_per_node=4, ckpt_root=ckpt_root,
+                          policy=GangPolicy(max_gang_restarts=2))
+    sys.exit(sup.run())
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .supervisor import RestartPolicy
+
+__all__ = ["GangPolicy", "FleetSupervisor"]
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+@dataclass
+class GangPolicy:
+    """Bounds of the gang restart loop.
+
+    ``max_gang_restarts`` — relaunches allowed per world size before the
+    supervisor either degrades or gives up (env
+    ``PADDLE_TPU_GANG_RESTARTS`` overrides the default).
+    ``degrade`` — allow re-launching at reduced world size once the budget
+    for the current size is spent (elastic degrade; off = give up).
+    ``degrade_step`` — how many procs each degrade removes.
+    ``min_procs`` — smallest world the job still makes sense at.
+    ``backoff`` — seeded exponential backoff between relaunches."""
+
+    max_gang_restarts: int = field(
+        default_factory=lambda: _env_int("PADDLE_TPU_GANG_RESTARTS", 3))
+    degrade: bool = True
+    degrade_step: int = 1
+    min_procs: int = 1
+    backoff: RestartPolicy = field(default_factory=RestartPolicy)
+
+
+class FleetSupervisor:
+    """Relaunch loop around one gang (generalizes ``Supervisor`` from one
+    process to one pod).
+
+    ``script``/``script_args`` name the per-rank training program; each
+    attempt goes through the launch CLI in-process (``launch.launch``), so
+    ranks are real subprocesses with the full PADDLE_* env contract, and
+    the launcher's fault domain (store hosting, lease monitor, poison
+    teardown) is armed per attempt.  ``launch_fn(argv, env) -> int``
+    overrides the launcher for tests.
+
+    Any nonzero gang exit is restartable by default — a coordinated abort
+    surfaces as whichever rank's exit the launcher saw first (101 from a
+    poison-poll exit, a negative signal code from the culprit), and
+    distinguishing them buys nothing at the gang level.  ``fatal_codes``
+    lists exceptions (e.g. a config error exit that relaunching cannot
+    fix)."""
+
+    def __init__(self, script: str, script_args: Sequence[str] = (), *,
+                 nproc_per_node: int = 1, nnodes: int = 1,
+                 master: Optional[str] = None, job_id: str = "default",
+                 log_dir: str = "log",
+                 policy: Optional[GangPolicy] = None,
+                 ckpt_root: Optional[str] = None,
+                 keep_n: Optional[int] = None,
+                 compile_cache: Optional[str] = None,
+                 fatal_codes: Sequence[int] = (),
+                 env: Optional[Dict[str, str]] = None,
+                 launch_fn: Optional[Callable[..., int]] = None):
+        self.script = script
+        self.script_args = list(script_args)
+        self.nproc_per_node = int(nproc_per_node)
+        self.nnodes = int(nnodes)
+        self.master = master
+        self.job_id = job_id
+        self.log_dir = log_dir
+        self.policy = policy or GangPolicy()
+        self.ckpt_root = ckpt_root
+        self.keep_n = keep_n
+        self.compile_cache = compile_cache
+        self.fatal_codes = tuple(fatal_codes)
+        self.env = dict(env) if env else {}
+        self.launch_fn = launch_fn
+        # trajectory (inspected by tests / status reporting)
+        self.epoch = 0                  # launch attempts so far
+        self.gang_restarts = 0          # relaunches at the CURRENT world
+        self.degrades = 0
+        self.world_size = self.nnodes * self.nproc_per_node
+        self.exit_codes: List[int] = []
+
+    # -- one launch --------------------------------------------------------
+    def _argv(self) -> List[str]:
+        argv = ["--nnodes", str(self.nnodes),
+                "--nproc_per_node", str(self.nproc_per_node),
+                "--log_dir", os.path.join(self.log_dir,
+                                          f"epoch_{self.epoch}"),
+                "--job_id", self.job_id]
+        if self.master:
+            argv += ["--master", self.master]
+        return argv + [self.script, *self.script_args]
+
+    def _launch_env(self) -> Dict[str, str]:
+        env = {
+            "PADDLE_TPU_GANG_EPOCH": str(self.epoch),
+            "PADDLE_TPU_GANG_BARRIER": "1",
+            "PADDLE_TPU_FAULT_DOMAIN": os.environ.get(
+                "PADDLE_TPU_FAULT_DOMAIN", "1"),
+        }
+        if self.compile_cache:
+            env["PADDLE_TPU_COMPILE_CACHE"] = self.compile_cache
+        env.update(self.env)
+        return env
+
+    def _launch_once(self) -> int:
+        self.epoch += 1
+        argv = self._argv()
+        extra = self._launch_env()
+        self._event("gang_launch", epoch=self.epoch,
+                    world=self.world_size,
+                    nproc_per_node=self.nproc_per_node)
+        if self.launch_fn is not None:
+            return self.launch_fn(argv, extra)
+        from ...launch.main import launch
+
+        saved = {k: os.environ.get(k) for k in extra}
+        os.environ.update(extra)
+        try:
+            return launch(argv)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    # -- degrade -----------------------------------------------------------
+    def _degrade(self) -> bool:
+        """Shrink the gang one step; False when already at the floor."""
+        new_nproc = self.nproc_per_node - self.policy.degrade_step
+        if self.nnodes * new_nproc < self.policy.min_procs or new_nproc < 1:
+            return False
+        self.nproc_per_node = new_nproc
+        self.world_size = self.nnodes * new_nproc
+        self.degrades += 1
+        self.gang_restarts = 0  # fresh budget at the smaller world
+        self._event("gang_degrade", epoch=self.epoch,
+                    world=self.world_size,
+                    nproc_per_node=self.nproc_per_node,
+                    degrades=self.degrades)
+        return True
+
+    # -- loop --------------------------------------------------------------
+    def run(self) -> int:
+        """Launch the gang; relaunch (and eventually degrade) on failure;
+        return the final exit code (0 = the gang completed)."""
+        self._event("fleet_supervisor_start", world=self.world_size)
+        while True:
+            rc = self._launch_once()
+            self.exit_codes.append(rc)
+            if rc == 0:
+                self._event("fleet_supervisor_done", epoch=self.epoch,
+                            restarts=self.epoch - 1,
+                            degrades=self.degrades,
+                            world=self.world_size)
+                return 0
+            if rc in self.fatal_codes:
+                self._event("fleet_supervisor_fatal", exit_code=rc,
+                            epoch=self.epoch)
+                return rc
+            if self.gang_restarts >= self.policy.max_gang_restarts:
+                # budget for this world size is spent: a persistently
+                # missing host keeps killing every relaunch — degrade the
+                # mesh instead of burning forever (or give up at the floor)
+                if not (self.policy.degrade and self._degrade()):
+                    self._event("fleet_supervisor_giveup", exit_code=rc,
+                                epoch=self.epoch, world=self.world_size)
+                    return rc
+            else:
+                self.gang_restarts += 1
+            delay = self.policy.backoff.delay(self.epoch)
+            self._event("gang_restart", attempt=self.epoch, exit_code=rc,
+                        backoff_s=round(delay, 3), world=self.world_size)
+            if self.ckpt_root and self.keep_n:
+                try:
+                    from ...checkpoint import gc_checkpoints
+
+                    gc_checkpoints(self.ckpt_root, keep=self.keep_n)
+                except Exception:
+                    pass
+            time.sleep(delay)
+
+    @staticmethod
+    def _event(name: str, **data) -> None:
+        try:  # flight recorder: the pod-level restart story
+            from .... import telemetry
+
+            telemetry.record_event("fleet_supervisor", name, **data)
+        except Exception:
+            pass

@@ -287,12 +287,27 @@ class HealthGuard:
         except Exception:
             pass
         if callable(self.on_escalate):
+            # the handler OWNS the recovery decision (may continue training
+            # in-process): no gang poison — poisoning here would os._exit
+            # every rank, this one included, out from under the callback
             self.on_escalate(dict(entry, flight_recorder_dump=dump))
             return
         if self.on_escalate == "raise":
             raise HealthError(
                 f"health guard escalated at step {step} ({reason}); "
                 f"poisoned window {entry['window']}")
+        try:
+            # default exit path: this rank is about to leave with 101 — a
+            # health escalation is gang-fatal (every rank must rewind to the
+            # same checkpoint), so poison the epoch (first writer wins) so
+            # siblings exit within the poison deadline instead of wedging
+            # in the next collective
+            from ..fleet import fault_domain as _fd
+
+            _fd.poison_current("health_escalation",
+                               detail=f"step {step}: {reason}")
+        except Exception:
+            pass
         raise SystemExit(REWIND_EXIT_CODE)
 
     # -- telemetry plumbing ------------------------------------------------

@@ -7,6 +7,21 @@ host; each worker process gets the PADDLE_* env contract
 ``log_dir/workerlog.N`` file. The first worker failure tears the pod down
 (reference controller watch-loop semantics).
 
+Fleet fault domain (``--fault_domain on|off``, default on, env
+``PADDLE_TPU_FAULT_DOMAIN``): the launcher hosts (single-node) or joins
+(multi-node: the rendezvous store doubles as it) the job's TCPStore and
+exports ``PADDLE_TPU_FLEET_STORE`` so every rank can publish heartbeat
+leases and poll the poison key.  The launcher runs the lease monitor — a
+rank whose lease expires is poisoned (``lease_expired``) — and its watch
+loop is poison-aware in BOTH directions: the first dead child writes the
+poison pill (reason ``rank_exit``, culprit = the rank) so siblings wedged
+inside an XLA collective convert the hang into a bounded exit-101, and a
+pill written by anyone else (a rank's CommWatchdog, a HealthGuard
+escalation) tears this pod down even when every local child still looks
+healthy.  Teardown is TERM → ``PADDLE_TPU_TEARDOWN_GRACE`` seconds → KILL,
+after an initial self-exit window so ranks get to finish their emergency
+checkpoints.
+
 On TPU the normal deployment is ONE process per host owning all local chips
 (`--nproc_per_node 1`, the default); multi-process-per-host is used by the
 CPU "fake cluster" tests."""
@@ -20,7 +35,7 @@ import socket
 import subprocess
 import sys
 import time
-from typing import List
+from typing import List, Optional
 
 __all__ = ["launch", "main"]
 
@@ -52,9 +67,59 @@ def _parse(argv):
                    help="directory for per-rank workerlog.N files")
     p.add_argument("--job_id", type=str, default="default",
                    help="job name tag (reference parity)")
+    p.add_argument("--fault_domain", choices=("on", "off"),
+                   default=("off" if os.environ.get(
+                       "PADDLE_TPU_FAULT_DOMAIN", "1") in ("0", "false")
+                       else "on"),
+                   help="heartbeat-lease/poison fault domain over the job "
+                        "store (default on; env PADDLE_TPU_FAULT_DOMAIN)")
     p.add_argument("script", type=str, help="training script")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
+
+
+def _record_event(name: str, **data) -> None:
+    try:  # flight recorder: the pod's watch-loop story
+        from ... import telemetry
+
+        telemetry.record_event("gang", name, **data)
+    except Exception:
+        pass
+
+
+class _PodWatch:
+    """The launcher's membership in the fault domain: store hosting/joining,
+    lease monitor, poison pill plumbing. All methods are best-effort — a
+    fault-domain hiccup must never take down a healthy pod."""
+
+    def __init__(self, store, world: int, job_id: str, own_store: bool):
+        from ..fleet.fault_domain import FaultDomain
+
+        self.own_store = own_store
+        self.poisoned: Optional[dict] = None
+        self.domain = FaultDomain(
+            store, rank=None, world_size=world, job_id=job_id,
+            epoch=int(os.environ.get("PADDLE_TPU_GANG_EPOCH", "0")),
+            # only the store-hosting launcher monitors leases (one poisoner
+            # per gang is enough; the pill is first-writer-wins anyway)
+            monitor=own_store,
+            on_abort=self._on_poison)
+        self.domain.start()
+
+    def _on_poison(self, doc: dict) -> None:
+        self.poisoned = doc
+
+    def poison(self, reason: str, culprit: Optional[int], detail: str) -> None:
+        try:
+            self.domain.poison(reason, culprit=culprit, detail=detail)
+        except Exception:
+            pass
+
+    def stop(self) -> None:
+        try:
+            self.domain.stop()
+        except Exception:
+            pass
 
 
 def launch(argv=None) -> int:
@@ -85,9 +150,39 @@ def launch(argv=None) -> int:
         coordinator = f"{mhost}:{int(mport) + 1}"
     elif node_rank < 0:
         node_rank = 0
+
+    # fleet fault domain: single-node pods host a dedicated store (the
+    # master port stays free — init_parallel_env hands it to
+    # jax.distributed when nnodes==1); multi-node pods reuse the rendezvous
+    # store, whose server already lives on the master host
+    fleet_store_addr = None
+    watch: Optional[_PodWatch] = None
+    fleet_store = store
+    if args.fault_domain == "on":
+        try:
+            from ..store import TCPStore
+
+            if fleet_store is None:
+                fleet_store = TCPStore("127.0.0.1", 0, is_master=True,
+                                       world_size=world)
+                fleet_store_addr = f"127.0.0.1:{fleet_store.port}"
+            else:
+                fleet_store_addr = master
+            watch = _PodWatch(fleet_store, world, args.job_id,
+                              own_store=fleet_store.is_master)
+        except Exception as e:
+            sys.stderr.write(f"[launch] fault domain unavailable: {e!r}\n")
+            fleet_store_addr, watch = None, None
     os.makedirs(args.log_dir, exist_ok=True)
 
+    grace = 10.0
+    try:
+        grace = float(os.environ.get("PADDLE_TPU_TEARDOWN_GRACE", grace))
+    except ValueError:
+        pass
+
     procs: List[subprocess.Popen] = []
+    ranks = {}
     logs = []
     try:
         for local in range(nproc):
@@ -103,6 +198,9 @@ def launch(argv=None) -> int:
                 "PADDLE_NNODES": str(args.nnodes),
                 "PADDLE_NODE_RANK": str(node_rank),
                 **({"PADDLE_COORDINATOR": coordinator} if coordinator else {}),
+                **({"PADDLE_TPU_FLEET_STORE": fleet_store_addr,
+                    "PADDLE_TPU_FLEET_MONITOR": "launcher"}
+                   if fleet_store_addr else {}),
                 # multi-process-per-host (CPU fake cluster): keep each worker
                 # to its own slice of host devices
                 "PADDLE_NPROC_PER_NODE": str(nproc),
@@ -110,9 +208,15 @@ def launch(argv=None) -> int:
             log_path = os.path.join(args.log_dir, f"workerlog.{rank}")
             log_f = open(log_path, "w")
             logs.append(log_f)
-            procs.append(subprocess.Popen(
+            pr = subprocess.Popen(
                 [sys.executable, "-u", args.script, *args.script_args],
-                env=env, stdout=log_f, stderr=subprocess.STDOUT))
+                env=env, stdout=log_f, stderr=subprocess.STDOUT)
+            ranks[pr.pid] = rank
+            procs.append(pr)
+        _record_event("gang_start", world=world, node_rank=node_rank,
+                      nproc=nproc,
+                      epoch=int(os.environ.get("PADDLE_TPU_GANG_EPOCH", "0")),
+                      fault_domain=args.fault_domain)
     except BaseException:
         # a failed spawn must not leave earlier workers blocked on a
         # rendezvous that will never complete
@@ -120,7 +224,33 @@ def launch(argv=None) -> int:
             pr.kill()
         for f in logs:
             f.close()
+        if watch is not None:
+            watch.stop()
+        if fleet_store is not None:
+            fleet_store.close()
         raise
+
+    def _teardown(remaining: List[subprocess.Popen],
+                  self_exit_window: float) -> None:
+        """Poisoned ranks exit on their own within the poison deadline —
+        give them ``self_exit_window`` to finish emergency checkpoints,
+        then TERM, then KILL after ``grace`` (reference teardown, hardened:
+        a rank wedged in an uninterruptible XLA wait ignores TERM)."""
+        deadline = time.time() + self_exit_window
+        while remaining and time.time() < deadline:
+            remaining = [pr for pr in remaining if pr.poll() is None]
+            if remaining:
+                time.sleep(0.1)
+        for pr in remaining:
+            if pr.poll() is None:
+                pr.terminate()
+        for pr in remaining:
+            try:
+                pr.wait(timeout=grace)
+            except subprocess.TimeoutExpired:
+                pr.kill()
+        _record_event("gang_teardown", world=world,
+                      killed=len(remaining))
 
     rc = 0
     try:
@@ -130,18 +260,42 @@ def launch(argv=None) -> int:
                 if code is None or pr not in procs:
                     continue
                 procs.remove(pr)
+                _record_event("gang_child_exit", rank=ranks.get(pr.pid),
+                              exit_code=code)
+                if code == 0 and watch is not None and \
+                        ranks.get(pr.pid) is not None:
+                    # a clean exit that never stopped its domain must not
+                    # leave a lease behind to expire and poison survivors
+                    watch.domain.release_rank(ranks[pr.pid])
                 if code != 0:
                     rc = code
                     # first failure tears down the pod (reference
-                    # CollectiveController watch loop)
-                    for other in procs:
-                        other.terminate()
-                    for other in procs:
-                        try:
-                            other.wait(timeout=10)
-                        except subprocess.TimeoutExpired:
-                            other.kill()
+                    # CollectiveController watch loop) — poison FIRST so
+                    # ranks wedged inside a collective convert the hang
+                    # into their own bounded exit + emergency checkpoint
+                    if watch is not None and procs:
+                        watch.poison("rank_exit", ranks.get(pr.pid),
+                                     f"exit code {code}")
+                        _teardown(procs, self_exit_window=grace)
+                    else:
+                        _teardown(procs, self_exit_window=0.0)
                     procs.clear()
+            if procs and watch is not None and watch.poisoned is not None:
+                # someone ELSE poisoned the gang (a rank's watchdog, a
+                # health escalation, a dead lease on another pod): all
+                # local children must leave too, even the healthy ones
+                doc = watch.poisoned
+                _record_event("gang_poisoned",
+                              reason=doc.get("reason"),
+                              culprit=doc.get("culprit"), by=doc.get("by"))
+                _teardown(procs, self_exit_window=grace)
+                for pr in procs:
+                    code = pr.poll()
+                    if code and not rc:
+                        rc = code
+                procs.clear()
+                if not rc:
+                    rc = 101  # poisoned gang is not a clean completion
             time.sleep(0.2)
     except KeyboardInterrupt:
         for pr in procs:
@@ -150,8 +304,10 @@ def launch(argv=None) -> int:
     finally:
         for f in logs:
             f.close()
-        if store is not None:
-            store.close()
+        if watch is not None:
+            watch.stop()
+        if fleet_store is not None:
+            fleet_store.close()
     return rc
 
 

@@ -50,6 +50,20 @@ def init_parallel_env(strategy=None) -> "ParallelEnv":
     if get_hybrid_communicate_group() is None:
         n = len(jax.devices())
         set_hybrid_communicate_group(HybridCommunicateGroup(dp=n))
+    # fleet fault domain: when the launcher exported a fleet store
+    # (PADDLE_TPU_FLEET_STORE), join it — heartbeat lease + poison poll
+    # (+ the gang barrier when a FleetSupervisor armed one).
+    try:
+        from .fleet import fault_domain as _fd
+
+        _fd.init_from_env()
+    except Exception:
+        # an ARMED fault domain failing to start must be loud: swallowing a
+        # gang-barrier TimeoutError (partial gang) or an unreachable fleet
+        # store would let this rank train unprotected — and wedge exactly
+        # the way the fault domain exists to prevent
+        if os.environ.get("PADDLE_TPU_FLEET_STORE"):
+            raise
     _initialized = True
     return ParallelEnv()
 

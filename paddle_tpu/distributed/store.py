@@ -184,21 +184,47 @@ class _StoreServer(threading.Thread):
 
     def _cmd_barrier(self, req):
         key, world = req["key"], int(req["world"])
+        rank = req.get("rank")
         deadline = time.time() + req.get("timeout", 300.0)
         with self._cond:
-            b = self._barriers.setdefault(key, {"arrived": 0, "gen": 0})
+            b = self._barriers.setdefault(
+                key, {"arrived": 0, "gen": 0, "ranks": set()})
             gen = b["gen"]
             b["arrived"] += 1
+            if rank is not None:
+                b["ranks"].add(int(rank))
             if b["arrived"] >= world:
                 b["arrived"] = 0
                 b["gen"] += 1
+                b["ranks"] = set()
                 self._cond.notify_all()
             else:
                 while b["gen"] == gen:
                     if not self._cond.wait(deadline - time.time()):
+                        # timeout race: the releasing arrival may have bumped
+                        # the generation between this waiter's wait() expiry
+                        # and its lock reacquisition — decrementing then
+                        # would corrupt the NEW generation's count (−1 →
+                        # permanently desynced barriers). Re-check first:
+                        # a bumped gen means we were released, not timed out.
+                        if b["gen"] != gen:
+                            break
                         b["arrived"] -= 1
+                        if rank is not None:
+                            b["ranks"].discard(int(rank))
+                        # name the MISSING ranks, not just the count — only
+                        # meaningful when every waiter registered its rank
+                        if len(b["ranks"]) == b["arrived"] and \
+                                (b["ranks"] or rank is not None):
+                            missing = sorted(
+                                set(range(world)) - b["ranks"] -
+                                ({int(rank)} if rank is not None else set()))
+                            raise TimeoutError(
+                                f"barrier({key!r}) at "
+                                f"{b['arrived'] + 1}/{world}: missing ranks "
+                                f"{missing}")
                         raise TimeoutError(f"barrier({key!r}) at "
-                                           f"{b['arrived']}/{world}")
+                                           f"{b['arrived'] + 1}/{world}")
         return {}
 
 
@@ -335,10 +361,16 @@ class TCPStore:
         return self._call(cmd="age", key=key)["value"]
 
     def barrier(self, key: str = "_barrier", world_size: Optional[int] = None,
-                timeout: Optional[float] = None) -> None:
-        self._call(cmd="barrier", key=key,
-                   world=world_size or self.world_size,
-                   timeout=timeout or self.timeout)
+                timeout: Optional[float] = None,
+                rank: Optional[int] = None) -> None:
+        """``rank`` (optional) registers the caller so a timeout names the
+        MISSING ranks instead of just an arrived/world count."""
+        req = {"cmd": "barrier", "key": key,
+               "world": world_size or self.world_size,
+               "timeout": timeout or self.timeout}
+        if rank is not None:
+            req["rank"] = int(rank)
+        self._call(**req)
 
     def close(self) -> None:
         try:
@@ -453,5 +485,5 @@ def rendezvous(master: str, nnodes: int, job_id: str = "default",
             f"rendezvous: node rank {node_rank} claimed by {claims} pods — "
             f"set node_rank on every pod or on none")
     store.set(f"{job_id}/node/{node_rank}", socket.gethostname())
-    store.barrier(f"{job_id}/rdzv", nnodes, timeout)
+    store.barrier(f"{job_id}/rdzv", nnodes, timeout, rank=node_rank)
     return store, node_rank

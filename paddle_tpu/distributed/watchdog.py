@@ -38,14 +38,24 @@ class CommWatchdog:
 
     One monitor thread serves all watches (reference keeps one loop thread
     for all comm tasks). ``on_timeout(info)`` fires ONCE per expired watch
-    with ``{"name", "elapsed", "stacks"}``."""
+    with ``{"name", "elapsed", "stacks"}``.
+
+    ``fault_domain`` (a :class:`~paddle_tpu.distributed.fleet.fault_domain.
+    FaultDomain`, or the string ``"current"`` to resolve the process-global
+    domain lazily) makes a hang CLUSTER-fatal instead of silently local:
+    on expiry the watchdog writes the gang's poison pill (reason
+    ``watchdog_hang``, culprit = this rank) BEFORE invoking ``on_timeout``,
+    and the monitor loop also polls the poison key each tick — so a rank
+    parked inside a watchdog-wrapped collective learns a peer died and
+    exits within the poison deadline instead of blocking in XLA forever."""
 
     def __init__(self, timeout: float = 120.0,
                  on_timeout: Optional[Callable[[dict], None]] = None,
-                 poll_interval: float = 0.5):
+                 poll_interval: float = 0.5, fault_domain=None):
         self.timeout = timeout
         self.on_timeout = on_timeout or self._default_handler
         self.poll_interval = poll_interval
+        self.fault_domain = fault_domain
         self._watches: Dict[int, _Watch] = {}
         self._fired: set = set()
         self._lock = threading.Lock()
@@ -105,9 +115,32 @@ class CommWatchdog:
             self._watches.pop(wid, None)
             self._fired.discard(wid)
 
+    def attach_fault_domain(self, domain) -> None:
+        """Join the fleet fault domain after construction (the
+        ``fault_domain=`` ctor arg is equivalent)."""
+        self.fault_domain = domain
+
+    def _resolve_domain(self):
+        fd = self.fault_domain
+        if fd == "current":
+            try:
+                from .fleet import fault_domain as _fd_mod
+
+                return _fd_mod.current()
+            except Exception:
+                return None
+        return fd
+
     # -- monitor -----------------------------------------------------------
     def _loop(self) -> None:
         while not self._stop.wait(self.poll_interval):
+            fd = self._resolve_domain()
+            if fd is not None:
+                try:  # coordinated abort: a poisoned gang must not keep
+                    # waiting out local timeouts — poll_once aborts the rank
+                    fd.poll_once()
+                except Exception:
+                    pass
             now = time.time()
             expired: List[tuple] = []
             with self._lock:
@@ -123,6 +156,16 @@ class CommWatchdog:
                 self.timeout_count += 1
                 info = {"name": w.name, "elapsed": now - w.started,
                         "stacks": self._all_stacks()}
+                if fd is not None:
+                    try:  # the detecting party poisons FIRST: siblings
+                        # wedged in the same collective start their bounded
+                        # exits while this rank is still dumping stacks
+                        fd.poison("watchdog_hang", culprit=fd.rank,
+                                  detail=f"{w.name} exceeded "
+                                         f"{w.deadline - w.started:.1f}s")
+                        info["poisoned"] = True
+                    except Exception:
+                        pass
                 info["flight_recorder_dump"] = self._dump_flight_recorder(
                     w, now)
                 try:

@@ -117,6 +117,25 @@ _MISSING = object()
 
 
 _stamped_paths: set = set()
+_fleet_fd_mod = None
+
+
+def _note_fleet_step(step: int) -> None:
+    """Fleet fault domain probe: stamp per-step progress into this rank's
+    heartbeat lease, so the lease monitor can tell alive-but-stuck-in-step
+    (straggler) from dead. No-op (one global read) without an active
+    domain — must stay free on the hot path."""
+    global _fleet_fd_mod
+    if _fleet_fd_mod is None:
+        try:
+            from ..distributed.fleet import fault_domain as _fleet_fd_mod
+        except Exception:
+            _fleet_fd_mod = False
+    if _fleet_fd_mod:
+        try:
+            _fleet_fd_mod.note_step_current(step)
+        except Exception:
+            pass
 
 
 def _stamp_first_step() -> None:
@@ -712,6 +731,8 @@ class TrainStep:
         # supervisor goodput probe: first completed step of this process
         # (relaunch → here is time_to_first_step_s in restart events)
         _stamp_first_step()
+        # fleet fault domain: per-step heartbeat stamp (straggler detection)
+        _note_fleet_step(self.optimizer._step_count)
         try:  # telemetry: step event for the flight recorder + prometheus.
             # No host sync here — loss stays a device value.
             from .. import telemetry

@@ -95,4 +95,13 @@ def prometheus_text() -> str:
     _metric(lines, "watchdog_timeouts_total", "counter",
             "Comm-watchdog timeouts fired",
             [(None, int(ctr.get("watchdog_timeouts_total", 0)))])
+
+    # fleet fault domain: lease-monitor view of the gang (only present once
+    # a monitor has scanned — absent metrics mean "no fault domain here")
+    for gauge, help_ in (
+            ("fleet_live_ranks", "Ranks with a fresh heartbeat lease"),
+            ("fleet_dead_ranks", "Ranks whose heartbeat lease expired"),
+            ("fleet_max_step", "Freshest per-step stamp across the gang")):
+        if gauge in ctr:
+            _metric(lines, gauge, "gauge", help_, [(None, ctr[gauge])])
     return "\n".join(lines) + "\n"

@@ -27,8 +27,16 @@ evictions, serialize-unsupported) — and the numerical-health kinds —
 ``health_skip`` (update withheld for a NaN/Inf step), ``health_anomaly``
 (finite loss/grad-norm spike), ``health_rewind`` (escalation: the dump you
 are reading may BE that dump), ``health_fast_forward`` (restart skipped a
-poisoned data window) — so a dump reads as the story of how the process
-got where it is.
+poisoned data window), the fleet fault-domain kinds —
+``fleet_domain_start``, ``fleet_lease_expired`` (a rank's heartbeat lease
+died), ``fleet_straggler`` (alive-but-stuck-in-step), ``fleet_poison_set``
+(coordinated abort initiated: reason + culprit rank), ``fleet_abort``
+(this rank leaving on a poison pill), ``fleet_gang_barrier``,
+``elastic_<status>`` membership transitions, the launcher's ``gang``
+events (``gang_start`` / ``gang_child_exit`` / ``gang_poisoned`` /
+``gang_teardown``) and ``fleet_supervisor`` gang-restart events
+(``gang_launch`` / ``gang_restart`` / ``gang_degrade``) — so a dump reads
+as the story of how the process got where it is.
 
 Ring size: ``PADDLE_TPU_FLIGHT_RECORDER_SIZE`` (default 512). Dump dir:
 ``PADDLE_TPU_FLIGHT_RECORDER_DIR`` (default ``flight_recorder/``).

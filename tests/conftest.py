@@ -31,6 +31,18 @@ if "PADDLE_TPU_COMPILE_CACHE" not in os.environ:
 # schedules between runs — pin them to a no-op so tier-1 stays
 # deterministic regardless of what any test calls
 os.environ["PADDLE_TPU_XLA_OVERLAP_FLAGS"] = "0"
+# fleet fault-domain chaos suite: production default intervals (hb 10s ttl,
+# 15s abort deadline) would blow the tier-1 budget — pin heartbeat, poison
+# poll and deadlines down so lease expiry → poison → gang exit resolves in
+# ~1-2s. setdefault: a test that needs its own timing can still override,
+# and launched subprocesses inherit these.
+for _k, _v in (("PADDLE_TPU_HB_INTERVAL", "0.25"),
+               ("PADDLE_TPU_HB_TTL", "1.5"),
+               ("PADDLE_TPU_POISON_POLL", "0.2"),
+               ("PADDLE_TPU_ABORT_DEADLINE", "5"),
+               ("PADDLE_TPU_GANG_BARRIER_DEADLINE", "20"),
+               ("PADDLE_TPU_TEARDOWN_GRACE", "4")):
+    os.environ.setdefault(_k, _v)
 
 import jax  # noqa: E402
 

@@ -1,0 +1,323 @@
+"""Fleet fault-domain chaos suite (process-isolated e2e):
+
+- a SIGSTOP'd rank: hang → lease expiry → poison → every gang member exits
+  within the poison deadline (the lightweight children load store.py +
+  fault_domain.py standalone — no jax import, so the whole scenario runs
+  in seconds);
+- a SIGKILL'd rank mid-step: the launcher poisons + tears the gang down,
+  ``FleetSupervisor`` relaunches the whole gang through ``launch``, ranks
+  barrier before step 0 and resume from the latest committed checkpoint —
+  with a per-rank loss trajectory identical to an uninterrupted run;
+- a persistently missing rank: the restart budget at world=4 burns out and
+  the supervisor relaunches at reduced world size (elastic degrade), where
+  the gang completes its steps.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+pytestmark = [pytest.mark.fleet, pytest.mark.chaos]
+
+from paddle_tpu.distributed.fleet.elastic import (FleetSupervisor,
+                                                  GangPolicy, RestartPolicy)
+from paddle_tpu.distributed.store import TCPStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STORE_PY = os.path.join(REPO, "paddle_tpu", "distributed", "store.py")
+FD_PY = os.path.join(REPO, "paddle_tpu", "distributed", "fleet",
+                     "fault_domain.py")
+
+
+def _fast_gang_policy(max_gang_restarts=1, **kw):
+    return GangPolicy(max_gang_restarts=max_gang_restarts,
+                      backoff=RestartPolicy(backoff_base=0.01,
+                                            backoff_cap=0.02), **kw)
+
+
+# -- SIGSTOP: hang → lease expiry → poison → bounded gang exit ---------------
+
+# jax-free gang member: loads the store client and the fault domain
+# standalone (importlib), heartbeats + stamps steps forever; rank 0 runs
+# the lease monitor. The ONLY way out is the poison poll's exit-101.
+_LIGHT_MEMBER = textwrap.dedent("""
+    import importlib.util, sys, time
+
+    def load(name, path):
+        spec = importlib.util.spec_from_file_location(name, path)
+        m = importlib.util.module_from_spec(spec)
+        sys.modules[name] = m
+        spec.loader.exec_module(m)
+        return m
+
+    store_mod = load("pt_store", sys.argv[1])
+    fd_mod = load("pt_fd", sys.argv[2])
+    assert "jax" not in sys.modules  # the light member must stay light
+    port, rank, world = int(sys.argv[3]), int(sys.argv[4]), int(sys.argv[5])
+    client = store_mod.TCPStore("127.0.0.1", port, timeout=30.0)
+    d = fd_mod.FaultDomain(client, rank, world, monitor=(rank == 0),
+                           hb_interval=0.1, hb_ttl=0.6, poison_poll=0.1,
+                           abort_deadline=5.0)
+    d.start()
+    d.gang_barrier(timeout=15.0)
+    print("READY", rank, flush=True)
+    step = 0
+    while True:
+        step += 1
+        d.note_step(step)
+        time.sleep(0.05)
+""")
+
+
+class TestSigstopCoordinatedAbort:
+    def test_stuck_rank_lease_expires_and_gang_exits_bounded(self, tmp_path):
+        world = 4
+        master = TCPStore("127.0.0.1", 0, is_master=True, world_size=world,
+                          timeout=30.0)
+        script = tmp_path / "member.py"
+        script.write_text(_LIGHT_MEMBER)
+        procs = []
+        try:
+            for rank in range(world):
+                procs.append(subprocess.Popen(
+                    [sys.executable, str(script), STORE_PY, FD_PY,
+                     str(master.port), str(rank), str(world)],
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    text=True))
+            for pr in procs:
+                assert pr.stdout.readline().startswith("READY")
+
+            t0 = time.time()
+            os.kill(procs[2].pid, signal.SIGSTOP)  # rank 2 wedges mid-step
+
+            # every OTHER member must exit 101 within the detection bound:
+            # ttl (0.6) + monitor/poll latency + margin — and certainly
+            # well under the formerly-infinite hang
+            for rank in (0, 1, 3):
+                rc = procs[rank].wait(timeout=20)
+                assert rc == 101, (rank, rc, procs[rank].stdout.read())
+            assert time.time() - t0 < 15
+
+            # the pill names the culprit
+            import json
+
+            doc = json.loads(master.get("fleet/default/poison/0"))
+            assert doc["reason"] == "lease_expired"
+            assert doc["culprit"] == 2
+
+            # un-wedged, the stuck rank sees the pill and leaves the same way
+            os.kill(procs[2].pid, signal.SIGCONT)
+            assert procs[2].wait(timeout=20) == 101
+        finally:
+            for pr in procs:
+                if pr.poll() is None:
+                    try:
+                        os.kill(pr.pid, signal.SIGCONT)
+                    except OSError:
+                        pass
+                    pr.kill()
+            master.close()
+
+
+# -- SIGKILL mid-step: gang restart + bit-exact resume -----------------------
+
+# real training-shaped gang member (imports paddle_tpu: checkpoints + the
+# fault domain via the launcher env contract). Deterministic "training":
+# acc_{s+1} = acc_s + (s+1); rank 0 commits a checkpoint only AFTER the
+# whole gang passed the step barrier. Rank 2 is SIGKILLed entering
+# `kill_at` on the first epoch; survivors wedge on that step's barrier —
+# their poison poll converts the hang into exit 101.
+_TRAIN_MEMBER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax; jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.checkpoint import (latest_checkpoint,
+        load_state_dict, save_state_dict)
+    from paddle_tpu.distributed.fleet import fault_domain as fd_mod
+
+    root, total, kill_at, log_dir = sys.argv[1:5]
+    total, kill_at = int(total), int(kill_at)
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    epoch = int(os.environ["PADDLE_TPU_GANG_EPOCH"])
+    d = fd_mod.init_from_env()      # lease + poison poll + gang barrier
+    assert d is not None and d.rank == rank
+
+    start = 0
+    acc = paddle.to_tensor(np.zeros(4, np.float32))
+    resume = latest_checkpoint(root)
+    if resume:
+        state = {"acc": acc, "step": paddle.to_tensor(np.int64(0))}
+        load_state_dict(state, resume)
+        start = int(np.asarray(state["step"].numpy()))
+    log = open(os.path.join(log_dir, f"losses.{rank}"), "a")
+    for step in range(start, total):
+        if epoch == 1 and rank == 2 and step == kill_at:
+            os.kill(os.getpid(), 9)          # SIGKILL mid-step
+        acc = acc + float(step + 1)
+        log.write(f"{epoch}:{step}:{float(acc.numpy()[0]):.1f}\\n")
+        log.flush()
+        d.note_step(step)
+        # the stand-in collective: the gang completes the step together
+        d._store.barrier(f"step/{epoch}/{step}", d.world_size,
+                         timeout=60.0, rank=rank)
+        if rank == 0:
+            save_state_dict(
+                {"acc": acc, "step": paddle.to_tensor(np.int64(step + 1))},
+                os.path.join(root, f"step_{step + 1}"), keep_n=3)
+    d.stop()
+    print("DONE", rank, flush=True)
+""")
+
+
+class TestSigkillGangRestart:
+    def test_kill_restart_resume_identical_trajectory(self, tmp_path):
+        total, kill_at, world = 6, 3, 4
+        script = tmp_path / "member.py"
+        script.write_text(_TRAIN_MEMBER)
+        root = tmp_path / "ckpts"
+        root.mkdir()
+        sup = FleetSupervisor(
+            str(script), [str(root), str(total), str(kill_at),
+                          str(tmp_path)],
+            nproc_per_node=world, log_dir=str(tmp_path / "log"),
+            policy=_fast_gang_policy(max_gang_restarts=2, degrade=False),
+            ckpt_root=str(root), keep_n=3,
+            # workers run script-mode (script dir on sys.path, not cwd)
+            env={"PYTHONPATH": REPO + os.pathsep +
+                 os.environ.get("PYTHONPATH", "")})
+        assert sup.run() == 0
+        assert sup.epoch == 2          # one gang relaunch
+        assert sup.world_size == world  # no degrade
+        assert sup.exit_codes[0] != 0 and sup.exit_codes[-1] == 0
+
+        # per-rank loss trajectories: deterministic cumulative sum — steps
+        # replayed across the crash/resume boundary must be bit-identical,
+        # every rank must cover every step, and nothing else may appear
+        expect = {}
+        acc = 0.0
+        for s in range(total):
+            acc += s + 1
+            expect[s] = acc
+        for rank in range(world):
+            lines = [l for l in
+                     (tmp_path / f"losses.{rank}").read_text().splitlines()
+                     if l]
+            seen = {}
+            epochs = set()
+            for line in lines:
+                ep, step, val = line.split(":")
+                epochs.add(int(ep))
+                step, val = int(step), float(val)
+                assert val == expect[step], (rank, step, val)
+                seen.setdefault(step, set()).add(val)
+            assert sorted(seen) == list(range(total)), (rank, sorted(seen))
+            # replays recompute the SAME value (one distinct loss per step)
+            assert all(len(v) == 1 for v in seen.values())
+            assert epochs == {1, 2}, (rank, epochs)  # both gang launches ran
+
+        # the relaunch resumed from a committed checkpoint, not from scratch:
+        # epoch-2 lines start at (or before) the kill step, never at 0 twice
+        r2 = [l for l in
+              (tmp_path / "losses.2").read_text().splitlines() if l]
+        epoch2_steps = [int(l.split(":")[1]) for l in r2
+                        if l.startswith("2:")]
+        assert epoch2_steps[0] > 0            # resumed, not restarted
+        assert epoch2_steps[0] <= kill_at     # from a pre-kill checkpoint
+
+
+# -- persistent rank loss: elastic degrade to a smaller world ----------------
+
+# jax-free member for the degrade path: rank 3 ("the bad host") dies
+# instantly whenever the gang runs at world 4; at world 3 everyone
+# completes a few steps and exits 0.
+_FLAKY_MEMBER = textwrap.dedent("""
+    import os, sys, time
+    out_dir = sys.argv[1]
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    epoch = os.environ.get("PADDLE_TPU_GANG_EPOCH", "0")
+    if world == 4 and rank == 3:
+        sys.exit(1)                      # persistently missing host
+    for step in range(3):
+        time.sleep(0.02)
+    with open(os.path.join(out_dir, f"done.{epoch}.{rank}"), "w") as f:
+        f.write(str(world))
+""")
+
+
+class TestElasticDegrade:
+    def test_persistent_loss_degrades_world_and_completes(self, tmp_path):
+        script = tmp_path / "member.py"
+        script.write_text(_FLAKY_MEMBER)
+        sup = FleetSupervisor(
+            str(script), [str(tmp_path)],
+            nproc_per_node=4, log_dir=str(tmp_path / "log"),
+            policy=_fast_gang_policy(max_gang_restarts=1, degrade=True,
+                                     min_procs=2))
+        assert sup.run() == 0
+        # epoch 1 (world 4) fails, epoch 2 (world 4, last restart) fails,
+        # degrade → epoch 3 at world 3 completes at reduced DP
+        assert sup.degrades == 1
+        assert sup.world_size == 3
+        assert sup.epoch == 3
+        done = sorted(p.name for p in tmp_path.glob("done.3.*"))
+        assert done == ["done.3.0", "done.3.1", "done.3.2"]
+        assert all((tmp_path / d).read_text() == "3" for d in done)
+
+    def test_gang_restart_budget_env_knob(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_GANG_RESTARTS", "7")
+        assert GangPolicy().max_gang_restarts == 7
+
+    def test_supervisor_loop_with_stub_launcher(self):
+        """World/epoch bookkeeping without real processes: budget per world
+        size, degrade resets it, env contract stamped per attempt."""
+        calls = []
+
+        def fake_launch(argv, env):
+            calls.append((argv, dict(env)))
+            return 0 if len(calls) >= 4 else 101
+
+        sup = FleetSupervisor(
+            "train.py", nproc_per_node=4,
+            policy=_fast_gang_policy(max_gang_restarts=1, degrade=True,
+                                     min_procs=2, degrade_step=2),
+            launch_fn=fake_launch)
+        assert sup.run() == 0
+        nprocs = [a[a.index("--nproc_per_node") + 1] for a, _ in calls]
+        assert nprocs == ["4", "4", "2", "2"]
+        epochs = [e["PADDLE_TPU_GANG_EPOCH"] for _, e in calls]
+        assert epochs == ["1", "2", "3", "4"]
+        assert all(e["PADDLE_TPU_GANG_BARRIER"] == "1" for _, e in calls)
+        assert sup.degrades == 1 and sup.gang_restarts == 1
+
+    def test_fatal_code_is_not_restarted(self):
+        calls = []
+
+        def fake_launch(argv, env):
+            calls.append(1)
+            return 7
+
+        sup = FleetSupervisor("train.py", nproc_per_node=2,
+                              policy=_fast_gang_policy(),
+                              fatal_codes=(7,), launch_fn=fake_launch)
+        assert sup.run() == 7
+        assert calls == [1]
+
+    def test_giveup_at_the_floor(self):
+        def fake_launch(argv, env):
+            return 101
+
+        sup = FleetSupervisor(
+            "train.py", nproc_per_node=2,
+            policy=_fast_gang_policy(max_gang_restarts=1, degrade=True,
+                                     min_procs=2), launch_fn=fake_launch)
+        assert sup.run() == 101
+        assert sup.degrades == 0  # floor: 2 - 1 < min_procs
+        assert sup.epoch == 2     # initial + one restart, then give up

@@ -81,6 +81,68 @@ class TestTCPStore:
         assert client.num_keys() >= 1
         client.close()
 
+    def test_barrier_timeout_race_does_not_corrupt_next_generation(
+            self, master):
+        """Regression: a waiter whose cond.wait times out JUST AFTER the
+        releasing arrival bumped the generation must count as released —
+        the old code decremented the NEW generation's arrived count (to −1)
+        and desynced every later barrier on that key."""
+        server = master._server
+        result = {}
+
+        def waiter():
+            c = TCPStore("127.0.0.1", master.port, timeout=10.0)
+            try:
+                c.barrier("race", 2, timeout=0.5)
+                result["ok"] = True
+            except TimeoutError:
+                result["ok"] = False
+            finally:
+                c.close()
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.2)  # waiter is parked in cond.wait
+        with server._cond:
+            # hold the lock PAST the waiter's deadline (its wait() expires
+            # but cannot reacquire), then emulate the releasing second
+            # arrival — exactly the race window
+            time.sleep(0.6)
+            b = server._barriers["race"]
+            b["arrived"] = 0
+            b["gen"] += 1
+            b["ranks"] = set()
+            server._cond.notify_all()
+        t.join(5)
+        assert result["ok"] is True  # released, not timed out
+        assert server._barriers["race"]["arrived"] == 0  # not −1
+        # the NEXT generation still releases both members
+        done = []
+
+        def member():
+            c = TCPStore("127.0.0.1", master.port, timeout=10.0)
+            c.barrier("race", 2, timeout=5.0)
+            done.append(1)
+            c.close()
+
+        t2 = threading.Thread(target=member)
+        t2.start()
+        master.barrier("race", 2, timeout=5.0)
+        t2.join(5)
+        assert done == [1]
+
+    def test_barrier_timeout_names_missing_ranks(self, master):
+        with pytest.raises(TimeoutError) as ei:
+            master.barrier("who", world_size=3, timeout=0.4, rank=1)
+        msg = str(ei.value)
+        assert "missing ranks" in msg
+        assert "[0, 2]" in msg  # the ranks that never arrived, not ours
+
+    def test_barrier_timeout_without_rank_keeps_count_message(self, master):
+        with pytest.raises(TimeoutError) as ei:
+            master.barrier("anon", world_size=4, timeout=0.3)
+        assert "1/4" in str(ei.value)
+
     def test_barrier_releases_all(self, master):
         done = []
 
