@@ -96,6 +96,32 @@ def _meter_detail(meter) -> dict:
                 {k: v // steps for k, v in s["collective_bytes"].items()}}
 
 
+def _lint_detail(step, batch, full: bool) -> dict:
+    """shardlint detail fields for one bench point (schema additive).
+
+    ``full=True`` (the CPU smoke path) runs the whole rule set — the lint
+    re-lowers and re-compiles the step program, cheap at smoke shapes.
+    ``full=False`` (silicon) avoids a second multi-minute XLA compile:
+    source/jaxpr rules still run (``compile=False``), and the
+    involuntary-remat evidence comes from the partitioner diagnostics the
+    AOT compile service captured during the step's OWN cold compile
+    (``compile_info['partitioner_remats']``)."""
+    try:
+        from paddle_tpu.analysis import lint
+
+        report = lint(step, args=batch, compile=full)
+        n = sum(report.counts.values())
+        counts = dict(report.counts)
+        if not full:
+            remats = (step.compile_info or {}).get("partitioner_remats")
+            if remats:
+                counts["involuntary-remat"] = remats
+                n += remats
+        return {"lint_findings": n, "lint_counts": counts}
+    except Exception:
+        return {}
+
+
 def _llama_measure(cfg, batch, seq, steps, warmup, compile_cache=None):
     """Shared llama bench recipe: AMP-O2 fused train step, fresh random
     batch per step, host-read sync; returns (tok/s, first, final, params).
@@ -175,6 +201,18 @@ def bench_llama(on_accel: bool, peak: float):
         ratio = crosscheck_stepmeter(meter, info.get("flops"))
         if ratio is not None:
             compile_detail["flops_model_ratio"] = round(ratio, 4)
+        # shardlint the primary step (full rule set on the CPU smoke
+        # path; diagnostics-backed cheap pass on silicon — no recompile)
+        import numpy as _np
+
+        _lint_ids = _np.random.default_rng(1).integers(
+            0, cfg.vocab_size, (batch, seq)).astype("int32")
+        import paddle_tpu as _paddle
+
+        compile_detail.update(_lint_detail(
+            step, (_paddle.to_tensor(_lint_ids),
+                   _paddle.to_tensor(_np.roll(_lint_ids, -1, axis=1))),
+            full=not on_accel))
         if info.get("persisted"):
             del step
             gc.collect()  # free the first model before building the second
@@ -611,8 +649,24 @@ def _tp_derate_main(tp: int, batch: int, seq: int) -> None:
     ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype("int32")
     lbl = np.roll(ids, -1, axis=1)
     grad_fn = jax.jit(jax.value_and_grad(loss_fn))
-    txt = grad_fn.lower([p._value for p in params], ids, lbl) \
-                 .compile().as_text()
+    lowered = grad_fn.lower([p._value for p in params], ids, lbl)
+    # shardlint rides the compile this harness already pays: capture the
+    # partitioner diagnostics, run the full HLO rule set over the same
+    # optimized module the byte walk reads, report counts in the JSON
+    from paddle_tpu.analysis import (ProgramArtifacts,
+                                     capture_compile_diagnostics, lint)
+
+    with capture_compile_diagnostics() as diag:
+        compiled = lowered.compile()
+    txt = compiled.as_text()
+    art = ProgramArtifacts(name=f"tp_derate_mp{tp}", hlo_text=txt,
+                           diagnostics=diag.text, n_devices=tp,
+                           source_fns=[loss_fn])
+    # donation rule skipped on purpose: this is a measurement-only
+    # program that deliberately keeps params alive (no donate_argnums)
+    lint_report = lint(art, rules=["involuntary-remat",
+                                   "replication-blowup",
+                                   "ring-consistency", "host-sync"])
 
     # sum wire bytes per chip over the collectives in the optimized HLO;
     # ring costs for n participants: all-reduce 2(n-1)/n * S, gather /
@@ -661,6 +715,9 @@ def _tp_derate_main(tp: int, batch: int, seq: int) -> None:
         "wire_bytes_overlappable": int(wire_overlappable),
         "wire_bytes_exposed": int(wire - wire_overlappable),
         "decomposed": counts.get("collective-permute", 0) > 0,
+        "lint_findings": sum(lint_report.counts.values()),
+        "lint_counts": lint_report.counts,
+        "lint_exempted": sum(f.count for f in lint_report.exempted),
         "tp": tp, "batch": batch, "seq": seq,
         "note": "bytes from optimized HLO of the mp-sharded fwd+bwd at "
                 "slice dims; ring-cost weighted, per chip; collective-"
@@ -991,6 +1048,10 @@ def bench_gpt_tp_pp(on_accel: bool, peak: float):
                    "virtual_mesh_crosscheck": crosscheck,
                    "tp_derate": round(tp_derate, 4),
                    "overlap_fraction": round(overlap_fraction, 4),
+                   # shardlint over the slice program's optimized HLO +
+                   # captured partitioner diagnostics (baseline applied)
+                   "lint_findings": tp_eff.get("lint_findings"),
+                   "lint_counts": tp_eff.get("lint_counts"),
                    "tp_parity": {"ok": True,
                                  "losses": parity["losses_overlap"],
                                  "max_abs_diff": parity["max_abs_diff"]},
@@ -1271,7 +1332,7 @@ _COMPACT_KEYS = (
     "slice_tokens_per_sec", "virtual_stages", "micro_batches",
     "cache_gb_read_per_step", "norm_target", "device", "hbm_peak_gb",
     "resume_ok", "steps_skipped", "rewinds", "compile_time_s",
-    "compile_mode", "warm_ok", "fault_domain",
+    "compile_mode", "warm_ok", "fault_domain", "lint_findings",
 )
 
 

@@ -1,0 +1,55 @@
+"""paddle_tpu.analysis — **shardlint**, the SPMD/HLO static linter.
+
+The repo inspected optimized HLO in three ad-hoc places (the bench
+``--tp-derate`` wire-byte walk, the hand-written ``ParallelCrossEntropy``
+no-``[B,V]``-all-gather assert, the compile-metrics cost crosscheck);
+this subsystem promotes that pattern into a first-class tool: anything
+the ``compile/`` subsystem can lower — an
+:class:`~paddle_tpu.jit.TrainStep` /
+:class:`~paddle_tpu.distributed.engine.DistributedTrainStep`, an
+:class:`~paddle_tpu.compile.AOTFunction`, a jitted callable, a raw
+lowered/compiled object — runs through a rule set over the optimized HLO
+text, the jaxpr, the compiled memory analysis and the captured
+partitioner diagnostics, emitting structured findings (rule id,
+severity, op/tensor, priced byte cost, suggested fix).
+
+Layers:
+
+- :mod:`.findings`     — :class:`Finding` / :class:`LintReport`;
+- :mod:`.program`      — artifact collection incl. fd-level capture of
+  the XLA compile diagnostics (:func:`capture_compile_diagnostics`);
+- :mod:`.rules`        — the rule registry (see its docstring for the
+  rule table);
+- :mod:`.baseline`     — the committed exemption table
+  (``baseline.json``): known debt pinned with justifications, new
+  findings fail, fixes shrink the file;
+- :mod:`.source_check` — the repo-source AST check enforcing the
+  ``framework/jax_compat`` shard_map/pcast seam;
+- :mod:`.linter`       — :func:`lint`, the one entry point.
+
+Gates wired on top: ``__graft_entry__.dryrun_multichip`` fails loudly on
+unexempted involuntary-remat findings in every factorization, ``bench.py``
+reports ``lint_findings`` per point, and the tier-1 ``analysis`` pytest
+marker runs the fixture + clean-program suites.
+"""
+
+from .baseline import (Baseline, DEFAULT_BASELINE_PATH,  # noqa: F401
+                       load_baseline)
+from .findings import Finding, LintReport, Severity  # noqa: F401
+from .linter import lint  # noqa: F401
+from .program import (ProgramArtifacts, capture_compile_diagnostics,  # noqa: F401
+                      collect, jaxpr_primitives)
+from .rules import RULES, run_rules  # noqa: F401
+from .rules.remat import parse_partitioner_diagnostics  # noqa: F401
+from .rules.ring import analyze_perm, check_overlap_rings  # noqa: F401
+from .source_check import (check_jax_compat_seam,  # noqa: F401
+                           check_source_text)
+
+__all__ = [
+    "lint", "collect", "run_rules", "RULES",
+    "Finding", "LintReport", "Severity", "ProgramArtifacts",
+    "Baseline", "load_baseline", "DEFAULT_BASELINE_PATH",
+    "capture_compile_diagnostics", "jaxpr_primitives",
+    "parse_partitioner_diagnostics", "analyze_perm", "check_overlap_rings",
+    "check_jax_compat_seam", "check_source_text",
+]

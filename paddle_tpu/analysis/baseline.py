@@ -1,0 +1,95 @@
+"""Baseline / exemption table for shardlint findings — the
+``white_list`` pattern (ROADMAP item 5) applied to static analysis:
+existing known debt is PINNED in a committed file with a per-entry
+justification, NEW findings fail, and fixes shrink the baseline.
+
+File format (JSON, committed next to this module as ``baseline.json``;
+override with ``PADDLE_TPU_LINT_BASELINE``)::
+
+    {
+      "version": 1,
+      "exemptions": [
+        {"rule": "involuntary-remat",
+         "match": "distributed/engine\\.py",
+         "reason": "one line saying WHY this debt is accepted"}
+      ]
+    }
+
+``match`` is a regex searched against the finding's ``signature``
+(``rule|subject|source|extra``) — broad enough to survive compiler op
+renumbering, narrow enough that a new defect in a new site does not
+match.  An exemption whose ``rule`` does not equal the finding's rule
+never matches, whatever its regex.  Unused exemptions are reported so a
+fixed defect's entry gets deleted instead of rotting.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from .findings import Finding
+
+__all__ = ["Baseline", "load_baseline", "DEFAULT_BASELINE_PATH"]
+
+DEFAULT_BASELINE_PATH = os.path.join(os.path.dirname(__file__),
+                                     "baseline.json")
+
+
+class Baseline:
+    """Loaded exemption table; tracks which entries matched."""
+
+    def __init__(self, exemptions: Optional[List[Dict[str, Any]]] = None,
+                 path: Optional[str] = None):
+        self.path = path
+        self.exemptions: List[Dict[str, Any]] = []
+        for e in exemptions or []:
+            entry = dict(e)
+            entry["_re"] = re.compile(entry.get("match", "$^"))
+            entry["_used"] = 0
+            self.exemptions.append(entry)
+
+    def exempt(self, finding: Finding) -> Optional[Dict[str, Any]]:
+        sig = finding.signature
+        for e in self.exemptions:
+            if e.get("rule") not in (None, finding.rule):
+                continue
+            if e["_re"].search(sig):
+                e["_used"] += 1
+                return e
+        return None
+
+    def apply(self, findings: List[Finding]
+              ) -> Tuple[List[Finding], List[Finding]]:
+        """Split ``findings`` into (new, exempted); exempted findings gain
+        the matching entry in ``context['exemption']``."""
+        new, exempted = [], []
+        for f in findings:
+            e = self.exempt(f)
+            if e is None:
+                new.append(f)
+            else:
+                f.context["exemption"] = {
+                    "match": e.get("match"), "reason": e.get("reason")}
+                exempted.append(f)
+        return new, exempted
+
+    def unused(self) -> List[Dict[str, Any]]:
+        return [{k: v for k, v in e.items() if not k.startswith("_")}
+                for e in self.exemptions if e["_used"] == 0]
+
+
+def load_baseline(path: Optional[str] = None) -> Baseline:
+    """Load the exemption table.  ``path=None`` resolves
+    ``PADDLE_TPU_LINT_BASELINE`` then the committed default; a missing
+    file is an EMPTY baseline (nothing exempted), not an error."""
+    if path is None:
+        path = os.environ.get("PADDLE_TPU_LINT_BASELINE",
+                              DEFAULT_BASELINE_PATH)
+    if not os.path.exists(path):
+        return Baseline([], path=path)
+    with open(path) as f:
+        data = json.load(f)
+    return Baseline(data.get("exemptions", []), path=path)

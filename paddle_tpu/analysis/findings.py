@@ -1,0 +1,149 @@
+"""Structured lint findings: the unit every shardlint rule emits and every
+consumer (dryrun gate, bench detail, CI baseline diff) operates on.
+
+A :class:`Finding` is one defect instance: rule id, severity, the op/tensor
+it anchors to, a priced byte cost where the rule can compute one (wire
+bytes for resharding rules, HBM bytes for donation/replication rules), a
+suggested fix, and a ``signature`` — the stable string the baseline
+exemption table matches against.  Identical defects repeated by the
+compiler (the partitioner re-warns per occurrence) fold into one finding
+with ``count`` > 1; the priced cost is the per-occurrence cost times the
+count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional
+
+__all__ = ["Severity", "Finding", "LintReport"]
+
+
+class Severity:
+    """Finding severities, ordered: ``error`` findings gate (dryrun exits
+    non-zero, CI fails); ``warning`` findings report but do not gate on
+    their own; ``info`` is advisory."""
+
+    ERROR = "error"
+    WARNING = "warning"
+    INFO = "info"
+
+    _ORDER = {ERROR: 0, WARNING: 1, INFO: 2}
+
+    @classmethod
+    def rank(cls, sev: str) -> int:
+        return cls._ORDER.get(sev, 99)
+
+
+@dataclasses.dataclass
+class Finding:
+    """One lint defect.
+
+    ``subject`` names the op/tensor (``reshape f32[64,64]``,
+    ``all-gather f32[8,512]``, parameter index, perm table); ``source`` is
+    the python ``file:line`` when the compiler metadata carries one;
+    ``cost_bytes`` prices the defect (wire bytes for resharding, HBM bytes
+    for replication/donation) per the rule's documented model."""
+
+    rule: str
+    severity: str
+    subject: str
+    message: str
+    cost_bytes: Optional[int] = None
+    fix: Optional[str] = None
+    source: Optional[str] = None
+    count: int = 1
+    context: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def signature(self) -> str:
+        """Stable identity string the baseline exemption regexes match:
+        ``rule|subject|source|extra`` — enough to pin a known defect
+        without pinning compiler-generated op numbering."""
+        extra = self.context.get("signature_extra", "")
+        return f"{self.rule}|{self.subject}|{self.source or '?'}" + (
+            f"|{extra}" if extra else "")
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["signature"] = self.signature
+        return d
+
+    def format(self) -> str:
+        cost = (f"  [{_fmt_bytes(self.cost_bytes)}]"
+                if self.cost_bytes else "")
+        n = f"  x{self.count}" if self.count > 1 else ""
+        src = f"  ({self.source})" if self.source else ""
+        fix = f"\n      fix: {self.fix}" if self.fix else ""
+        return (f"[{self.severity:7s}] {self.rule}: {self.subject}{n}{cost}"
+                f"{src}\n      {self.message}{fix}")
+
+
+def _fmt_bytes(n: Optional[int]) -> str:
+    if n is None:
+        return "?"
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(n) < 1024.0 or unit == "GB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024.0
+    return f"{n}B"
+
+
+@dataclasses.dataclass
+class LintReport:
+    """The result of one :func:`paddle_tpu.analysis.lint` run.
+
+    ``findings`` are the NEW (unexempted) defects; ``exempted`` carry the
+    baseline entry that matched them in ``context['exemption']``.  ``ok``
+    is the gate consumers branch on: no unexempted finding at ``error``
+    severity.  ``gate_rules`` optionally narrows the gate to a rule subset
+    (the dryrun gates on involuntary-remat only)."""
+
+    name: str
+    findings: List[Finding] = dataclasses.field(default_factory=list)
+    exempted: List[Finding] = dataclasses.field(default_factory=list)
+    unused_exemptions: List[Dict[str, Any]] = \
+        dataclasses.field(default_factory=list)
+    meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.failures()
+
+    def failures(self, rules: Optional[List[str]] = None) -> List[Finding]:
+        """Unexempted error-severity findings, optionally restricted to a
+        rule subset (the caller's gate policy)."""
+        return [f for f in self.findings
+                if f.severity == Severity.ERROR
+                and (rules is None or f.rule in rules)]
+
+    @property
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + f.count
+        return out
+
+    def format(self) -> str:
+        lines = [f"shardlint report: {self.name} — "
+                 f"{len(self.findings)} finding(s), "
+                 f"{len(self.exempted)} exempted"]
+        for f in sorted(self.findings,
+                        key=lambda f: (Severity.rank(f.severity), f.rule)):
+            lines.append(f.format())
+        for f in self.exempted:
+            ex = f.context.get("exemption", {})
+            lines.append(f"[exempt ] {f.rule}: {f.subject}  x{f.count}"
+                         f"  — {ex.get('reason', 'baselined')}")
+        return "\n".join(lines)
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "name": self.name,
+            "ok": self.ok,
+            "findings": [f.to_dict() for f in self.findings],
+            "exempted": [f.to_dict() for f in self.exempted],
+            "counts": self.counts,
+            "meta": self.meta,
+        }, default=repr, indent=2)

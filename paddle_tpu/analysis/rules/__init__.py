@@ -1,0 +1,70 @@
+"""shardlint rule registry.
+
+A rule is ``fn(artifacts, config) -> list[Finding]`` registered under a
+stable kebab-case id (the id is what baselines, gates, and bench details
+reference — never rename one without migrating baselines).  Rules must be
+silent (return ``[]``) when the artifact they read is missing: the same
+rule set runs against a fully-compiled TrainStep and a bare lowered
+module.
+
+Shipped rules:
+
+====================  ========  =================================================
+id                    severity  detects
+====================  ========  =================================================
+involuntary-remat     error     SPMD partitioner full-remat resharding (parsed
+                                from compile diagnostics + the all-gather→
+                                dynamic-slice HLO pattern), priced in wire bytes
+replication-blowup    error     tensors above a size threshold materialized
+                                fully replicated on a >1-device mesh (the
+                                generalized no-[B,V]-all-gather guarantee)
+donation              error     params/opt-state inputs not donated or dropped
+                                by XLA, priced per-buffer from memory_analysis
+host-sync             warning   implicit device→host transfers inside step
+                                functions (float()/np.asarray in source, callback
+                                primitives in the jaxpr)
+ring-consistency      error     ppermute/collective-permute tables that do not
+                                form clean rings (duplicate endpoints, broken
+                                cycles) — silent deadlocks on real chips
+====================  ========  =================================================
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from ..findings import Finding
+from ..program import ProgramArtifacts
+
+__all__ = ["RULES", "rule", "run_rules"]
+
+RULES: Dict[str, Callable[[ProgramArtifacts, dict], List[Finding]]] = {}
+
+
+def rule(rule_id: str):
+    """Register a rule function under ``rule_id``."""
+
+    def deco(fn):
+        fn.rule_id = rule_id
+        RULES[rule_id] = fn
+        return fn
+
+    return deco
+
+
+def run_rules(artifacts: ProgramArtifacts, rules: Optional[List[str]] = None,
+              config: Optional[dict] = None) -> List[Finding]:
+    """Run ``rules`` (default: all registered) over ``artifacts``."""
+    config = config or {}
+    out: List[Finding] = []
+    for rid in (rules if rules is not None else list(RULES)):
+        fn = RULES.get(rid)
+        if fn is None:
+            raise KeyError(f"unknown lint rule {rid!r}; "
+                           f"registered: {sorted(RULES)}")
+        out.extend(fn(artifacts, config))
+    return out
+
+
+# importing the submodules populates the registry
+from . import remat, replication, donation, host_sync, ring  # noqa: E402,F401

@@ -1,0 +1,130 @@
+"""Rule ``donation``: param/opt-state input buffers that are NOT donated
+(or whose donation XLA dropped) — every undonated training-state buffer
+is a full extra copy of that state resident in HBM across the update.
+
+Evidence, in order of strength:
+
+- the step wrapper's own intent (``TrainStep(donate=False)`` surfaces as
+  ``donate_expected=False`` on the artifacts) — reported as a warning:
+  deliberate, but priced so the cost is visible;
+- the ``input_output_alias`` header of the optimized module vs the
+  compiled ``memory_analysis()``: ``alias_bytes`` is what XLA actually
+  aliased; ``argument_bytes - alias_bytes`` above a threshold on a
+  program that SHOULD donate (``donate_expected`` is True or unknown)
+  means donation was requested but did not materialize (dropped by a
+  layout mismatch, a consumed-after-donate use, or never requested);
+- XLA's own donation complaints in the captured compile diagnostics
+  ("buffer donation" / "Donation" lines).
+
+Config: ``donation_threshold_bytes`` (default 1 MiB) — below it a
+program is considered too small for donation to matter (eval fns, tiny
+probes).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from ..findings import Finding, Severity
+from ..program import ProgramArtifacts
+from . import rule
+
+_DEFAULT_THRESHOLD = 1 << 20
+
+_ALIAS_HEADER_RE = re.compile(r"input_output_alias=\{([^}]*(?:\{[^}]*\}[^}]*)*)\}")
+_DONATION_DIAG_RE = re.compile(r"donat", re.IGNORECASE)
+
+
+def _alias_entries(hlo_text: str) -> int:
+    """Number of aliased buffers declared in the module header (0 when the
+    header is absent — nothing donated)."""
+    head = hlo_text.split("\n", 1)[0]
+    m = _ALIAS_HEADER_RE.search(head)
+    if not m:
+        return 0
+    return m.group(1).count("(")
+
+
+@rule("donation")
+def check_donation(art: ProgramArtifacts, config: dict) -> List[Finding]:
+    findings: List[Finding] = []
+    thresh = int(config.get("donation_threshold_bytes", _DEFAULT_THRESHOLD))
+
+    mem = art.memory or {}
+    arg_bytes = mem.get("argument_bytes")
+    alias_bytes = mem.get("alias_bytes")
+
+    if art.donate_expected is False and arg_bytes and arg_bytes >= thresh:
+        findings.append(Finding(
+            rule="donation",
+            severity=Severity.WARNING,
+            subject="step built with donate=False",
+            message=(
+                f"donation disabled on a program holding {arg_bytes} "
+                "argument bytes — params/opt-state keep a second full "
+                "HBM copy across the update"),
+            cost_bytes=int(arg_bytes),
+            fix="construct the TrainStep with donate=True unless the old "
+                "state must outlive the call (check_nan_inf-style paths)",
+            context={"argument_bytes": arg_bytes},
+        ))
+        return findings
+
+    if arg_bytes is None or alias_bytes is None or not art.hlo_text:
+        return findings
+    if arg_bytes < thresh:
+        return findings
+
+    undonated = int(arg_bytes) - int(alias_bytes)
+    n_alias = _alias_entries(art.hlo_text)
+    if alias_bytes == 0 and n_alias == 0:
+        findings.append(Finding(
+            rule="donation",
+            severity=Severity.ERROR,
+            subject="no donated buffers",
+            message=(
+                f"no input_output_alias in the optimized module: all "
+                f"{arg_bytes} argument bytes (params/opt-state included) "
+                "stay live alongside their updated copies"),
+            cost_bytes=int(arg_bytes),
+            fix="pass donate_argnums for the state arguments "
+                "(TrainStep does this by default) and keep in/out "
+                "shardings+layouts identical so XLA can alias",
+            context={"argument_bytes": arg_bytes,
+                     "alias_bytes": alias_bytes},
+        ))
+    elif n_alias > 0 and undonated >= max(thresh, int(arg_bytes) // 2):
+        # donation requested and partially honored — more than half the
+        # argument bytes still unaliased means XLA dropped big buffers
+        findings.append(Finding(
+            rule="donation",
+            severity=Severity.WARNING,
+            subject="donation partially dropped",
+            message=(
+                f"{undonated} of {arg_bytes} argument bytes are not "
+                f"aliased ({n_alias} buffers aliased) — XLA dropped "
+                "donation for large state buffers (layout or sharding "
+                "mismatch between the input and its updated output)"),
+            cost_bytes=undonated,
+            fix="pin identical in/out shardings for state "
+                "(DistributedTrainStep._sharding_pins) so donated "
+                "buffers stay alias-compatible",
+            context={"argument_bytes": arg_bytes,
+                     "alias_bytes": alias_bytes, "aliased": n_alias},
+        ))
+
+    if art.diagnostics:
+        for line in art.diagnostics.splitlines():
+            if _DONATION_DIAG_RE.search(line) and \
+                    ("not" in line.lower() or "drop" in line.lower()):
+                findings.append(Finding(
+                    rule="donation",
+                    severity=Severity.WARNING,
+                    subject="XLA donation complaint",
+                    message=line.strip()[:300],
+                    fix="align the donated buffer's layout/sharding with "
+                        "its output",
+                    context={"diagnostic": True},
+                ))
+    return findings

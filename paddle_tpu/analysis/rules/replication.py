@@ -1,0 +1,133 @@
+"""Rule ``replication-blowup``: a tensor above a size threshold
+materialized FULLY REPLICATED on a >1-device mesh.
+
+The canonical instance is the ``[B, V]`` one-hot / logits row in a
+vocab-parallel loss: one misplaced constraint and GSPMD inserts an
+all-gather of the full row on every chip — at 7B scale that is gigabytes
+of wire and HBM per step.  PR 5 guarded exactly one such site with a
+hand-written HLO assert on ``ParallelCrossEntropy``; this rule is that
+assert generalized to every program the linter sees.
+
+Detection, over the optimized HLO:
+
+- every ``all-gather`` (sync or async ``-start`` form; ``-done`` halves
+  repeat the type and are skipped) whose RESULT is at least
+  ``replication_threshold_bytes`` — an all-gather's output is by
+  construction the gathered tensor materialized in full on every
+  participant;
+- every entry parameter whose input sharding is fully replicated while
+  its (per-replica) size is at least the threshold, when input shardings
+  are available from the compiled executable.
+
+Config: ``replication_threshold_bytes`` (default from
+``PADDLE_TPU_LINT_REPL_MB``, 64 MiB) — callers guarding a specific
+tensor (the ParallelCrossEntropy test pins the full ``[B, V]`` row size)
+pass their own threshold.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import List
+
+from ..findings import Finding, Severity
+from ..program import DTYPE_BYTES, ProgramArtifacts, shape_bytes
+from . import rule
+
+_DEFAULT_MB = 64.0
+
+# "%name = TYPE all-gather(...)" — TYPE may be a variadic tuple for the
+# -start form; every shape in the LHS is summed (bench --tp-derate's walk)
+_AG_RE = re.compile(
+    r"%([\w.\-]+)\s*=\s*(.*?)\s+all-gather(?:-start)?\(")
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+
+def threshold_bytes(config: dict) -> int:
+    if "replication_threshold_bytes" in config:
+        return int(config["replication_threshold_bytes"])
+    try:
+        mb = float(os.environ.get("PADDLE_TPU_LINT_REPL_MB", _DEFAULT_MB))
+    except ValueError:
+        mb = _DEFAULT_MB
+    return int(mb * 1024 * 1024)
+
+
+def _lhs_bytes(lhs_type: str) -> int:
+    size = 0
+    for dm in _SHAPE_RE.finditer(lhs_type):
+        dtype, dims = dm.group(1), dm.group(2)
+        if dtype not in DTYPE_BYTES and not dtype.startswith(
+                ("f", "s", "u", "pred", "bf")):
+            continue  # not a data shape (e.g. a token word the regex ate)
+        size += shape_bytes(dtype, dims)
+    return size
+
+
+def _is_replicated(sharding) -> bool:
+    try:
+        if hasattr(sharding, "is_fully_replicated"):
+            return bool(sharding.is_fully_replicated)
+    except Exception:
+        pass
+    return False
+
+
+@rule("replication-blowup")
+def check_replication_blowup(art: ProgramArtifacts,
+                             config: dict) -> List[Finding]:
+    if art.n_devices <= 1:
+        return []
+    thresh = threshold_bytes(config)
+    findings: List[Finding] = []
+
+    if art.hlo_text:
+        for line in art.hlo_text.splitlines():
+            m = _AG_RE.search(line)
+            if m is None or "all-gather-done(" in line:
+                continue
+            name, lhs = m.group(1), m.group(2)
+            size = _lhs_bytes(lhs)
+            if size < thresh:
+                continue
+            findings.append(Finding(
+                rule="replication-blowup",
+                severity=Severity.ERROR,
+                subject=f"all-gather {lhs.strip()}",
+                message=(
+                    f"all-gather materializes {size} bytes in full on "
+                    f"every device of a {art.n_devices}-device program "
+                    f"(threshold {thresh})"),
+                cost_bytes=size,
+                fix=("keep the tensor sharded through the op: constrain "
+                     "the small operand BEFORE it meets the sharded one "
+                     "(cf. ParallelCrossEntropy's one_hot) or express the "
+                     "computation as elementwise ops + reductions"),
+                context={"instruction": name, "threshold": thresh},
+            ))
+
+    if art.input_shardings is not None and \
+            config.get("report_replicated_inputs"):
+        try:
+            import jax
+
+            flat = jax.tree_util.tree_leaves(art.input_shardings)
+        except Exception:
+            flat = []
+        for i, sh in enumerate(flat):
+            if not _is_replicated(sh):
+                continue
+            # per-buffer sizes aren't carried on the sharding; report the
+            # replication without a priced cost (the HLO walk above owns
+            # the priced path)
+            findings.append(Finding(
+                rule="replication-blowup",
+                severity=Severity.INFO,
+                subject=f"input #{i} fully replicated",
+                message=(f"entry buffer #{i} is fully replicated on a "
+                         f"{art.n_devices}-device mesh"),
+                fix="shard the input over a mesh axis if it is large",
+                context={"input_index": i},
+            ))
+    return findings

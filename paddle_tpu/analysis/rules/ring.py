@@ -1,0 +1,246 @@
+"""Rule ``ring-consistency``: manual ring collectives whose permutation
+tables do not form clean rings.
+
+The overlap layer's collective matmuls, the compiled pipeline engines and
+ring flash attention all move data with ``ppermute`` hops.  XLA will
+happily compile ANY source→target pair list — a malformed table (a
+duplicate target, a chain that never closes, two half-rings where one was
+intended) is not an error to the compiler; on real chips it is silently
+dropped data or a rank waiting forever on a hop that never arrives — a
+deadlock with no diagnostic.  This rule types the tables:
+
+- duplicate sources or targets in one permute → **error** (data race:
+  two payloads land in one buffer / one rank sends twice);
+- an open chain (a node sends but the component never cycles back) →
+  **error** (the ring's tail waits on a hop nobody issues — the fwd/vjp
+  mirrored-ring pattern requires every hop to be part of a cycle);
+- cycles of mixed length inside one permute → **warning** (legal, but
+  never what a decomposed collective means).
+
+Evidence: ``collective-permute`` ``source_target_pairs`` in the optimized
+HLO, ``ppermute`` ``perm`` tables in the jaxpr (when collected), plus
+:func:`check_overlap_rings` — a direct audit of the shipped
+``distributed/overlap`` collective-matmul primitives proving the forward
+and custom-vjp backward programs run MIRRORED rings off the same
+canonical rotation table.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..findings import Finding, Severity
+from ..program import ProgramArtifacts, jaxpr_primitives
+from . import rule
+
+__all__ = ["analyze_perm", "check_overlap_rings"]
+
+# the pair list is brace-nested: match the WHOLE {{a,b},{c,d},...} block
+# (a lazy .*? to the first bare } would truncate every multi-pair table
+# to its first entry and silently verify nothing)
+_CP_RE = re.compile(
+    r"collective-permute(?:-start)?\([^)]*\).*?"
+    r"source_target_pairs=\{((?:\{\d+,\d+\},?)+)\}")
+_PAIR_RE = re.compile(r"\{(\d+),(\d+)\}")
+
+
+def analyze_perm(pairs: Sequence[Tuple[int, int]],
+                 axis_size: Optional[int] = None) -> List[str]:
+    """Classify one permutation table; returns a list of defect strings
+    (empty = a clean union of equal-length cycles covering whole rings)."""
+    defects: List[str] = []
+    if not pairs:
+        return defects
+    srcs = [s for s, _ in pairs]
+    dsts = [d for _, d in pairs]
+    if len(set(srcs)) != len(srcs):
+        dup = sorted({s for s in srcs if srcs.count(s) > 1})
+        defects.append(f"duplicate sources {dup} (one rank sends twice)")
+    if len(set(dsts)) != len(dsts):
+        dup = sorted({d for d in dsts if dsts.count(d) > 1})
+        defects.append(f"duplicate targets {dup} (two payloads collide)")
+    if defects:
+        return defects
+
+    nxt: Dict[int, int] = dict(pairs)
+    unvisited = set(nxt)
+    cycle_lengths: List[int] = []
+    while unvisited:
+        start = min(unvisited)
+        node, steps = start, 0
+        path = []
+        while node in nxt and node in unvisited:
+            unvisited.discard(node)
+            path.append(node)
+            node = nxt[node]
+            steps += 1
+        if node != start:
+            defects.append(
+                f"open chain {path + [node]} — the ring never closes; "
+                "on hardware the tail blocks on a hop nobody issues")
+        else:
+            cycle_lengths.append(steps)
+    if not defects and len(set(cycle_lengths)) > 1:
+        defects.append(
+            f"mixed cycle lengths {sorted(set(cycle_lengths))} in one "
+            "permute — parallel rings of different sizes")
+    if not defects and axis_size and cycle_lengths and \
+            sum(cycle_lengths) % axis_size:
+        defects.append(
+            f"partial ring: {sum(cycle_lengths)} participants do not "
+            f"tile the {axis_size}-wide axis")
+    return defects
+
+
+def _severity(defect: str) -> str:
+    return Severity.WARNING if defect.startswith("mixed") or \
+        defect.startswith("partial") else Severity.ERROR
+
+
+@rule("ring-consistency")
+def check_ring_consistency(art: ProgramArtifacts,
+                           config: dict) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: set = set()
+
+    if art.hlo_text:
+        # HLO layer: only DUPLICATE endpoints are defects here — GSPMD
+        # itself routinely emits open-chain / self-loop / mixed-length
+        # collective-permutes for legitimate point-to-point resharding
+        # (absent pairs mean zeros, by spec). Ring-shape defects (chains,
+        # mixed cycles) are only bugs in MANUAL collectives, which the
+        # jaxpr layer below and check_overlap_rings see as ppermutes.
+        for line in art.hlo_text.splitlines():
+            if "collective-permute-done(" in line:
+                continue
+            m = _CP_RE.search(line)
+            if m is None:
+                continue
+            pairs = tuple((int(a), int(b))
+                          for a, b in _PAIR_RE.findall(m.group(1)))
+            if not pairs or pairs in seen:
+                continue
+            seen.add(pairs)
+            for defect in analyze_perm(pairs):
+                if not defect.startswith("duplicate"):
+                    continue
+                findings.append(Finding(
+                    rule="ring-consistency",
+                    severity=Severity.ERROR,
+                    subject=f"collective-permute {list(pairs)}",
+                    message=defect,
+                    fix="every source and target may appear at most once "
+                        "per permute",
+                    context={"pairs": list(pairs), "layer": "hlo"},
+                ))
+
+    for prim_name, params in art.jaxpr_prims:
+        if prim_name != "ppermute":
+            continue
+        perm = tuple(tuple(p) for p in params.get("perm", ()))
+        if not perm or ("jaxpr", perm) in seen:
+            continue
+        seen.add(("jaxpr", perm))
+        axis = params.get("axis_name")
+        axis_size = None
+        if art.mesh_shape and isinstance(axis, str):
+            axis_size = art.mesh_shape.get(axis)
+        for defect in analyze_perm(perm, axis_size):
+            findings.append(Finding(
+                rule="ring-consistency",
+                severity=_severity(defect),
+                subject=f"ppermute over {axis!r} {list(perm)}",
+                message=defect,
+                fix="rebuild the table as one rotation "
+                    "[(r, (r±1) % p) for r in range(p)]",
+                context={"pairs": [list(p) for p in perm],
+                         "axis": repr(axis), "layer": "jaxpr"},
+            ))
+    return findings
+
+
+def check_overlap_rings(mesh, axis: str = "model") -> List[Finding]:
+    """Audit the shipped collective-matmul ring programs on ``mesh``: the
+    forward and custom-vjp backward of both primitives must run rings
+    built from the SAME canonical rotation table (the mirrored-ring
+    contract — a fwd/bwd mismatch is exactly the silent real-chip
+    deadlock this rule exists for).  Returns findings (empty = clean)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...distributed.overlap import collective_matmul as cm
+
+    p = int(mesh.shape[axis])
+    if p < 2:
+        return []
+    # the canonical tables are the MATHEMATICAL ±1 rotations, computed
+    # here rather than read from the overlap module — the audit must
+    # catch a corrupted _ring_perm, not inherit it
+    rot_bwd = tuple((r, (r - 1) % p) for r in range(p))
+    rot_fwd = tuple((r, (r + 1) % p) for r in range(p))
+    canonical = (rot_bwd, rot_fwd)
+    row_prod = 1
+    for a in cm._row_axes(mesh):
+        row_prod *= mesh.shape[a]
+    rows, k, n = p * row_prod * 2, p * 2, p * 2
+    x = jax.ShapeDtypeStruct((rows, k), jnp.float32)
+    w = jax.ShapeDtypeStruct((k, n), jnp.float32)
+    g_ag = jax.ShapeDtypeStruct((rows, n), jnp.float32)
+
+    findings: List[Finding] = []
+    for name, fn, gshape in (
+            ("all_gather_matmul", cm._ag_mm_fn(mesh, axis), g_ag),
+            ("matmul_reduce_scatter", cm._mm_rs_fn(mesh, axis), g_ag)):
+        legs = {
+            "fwd": lambda xx, ww, f=fn: f(xx, ww),
+            "vjp": lambda xx, ww, gg, f=fn: jax.vjp(f, xx, ww)[1](gg),
+        }
+        leg_args = {"fwd": (x, w), "vjp": (x, w, gshape)}
+        tables: Dict[str, List[Tuple]] = {}
+        for leg, lf in legs.items():
+            try:
+                prims = jaxpr_primitives(
+                    jax.make_jaxpr(lf)(*leg_args[leg]))
+            except Exception as e:
+                findings.append(Finding(
+                    rule="ring-consistency",
+                    severity=Severity.WARNING,
+                    subject=f"{name}.{leg} untraceable",
+                    message=f"could not trace the {leg} ring program: "
+                            f"{e!r:.200}",
+                    context={"primitive": name, "leg": leg},
+                ))
+                continue
+            tables[leg] = [tuple(tuple(q) for q in params.get("perm", ()))
+                           for pn, params in prims if pn == "ppermute"]
+        for leg, perms in tables.items():
+            for perm in perms:
+                defects = analyze_perm(perm, p)
+                if perm not in canonical and not defects:
+                    defects = [
+                        f"{leg} ring table {list(perm)} deviates from the "
+                        f"canonical ±1 rotation {list(rot_bwd)} — fwd and "
+                        "vjp rings no longer mirror"]
+                for defect in defects:
+                    findings.append(Finding(
+                        rule="ring-consistency",
+                        severity=Severity.ERROR,
+                        subject=f"{name}.{leg} ppermute {list(perm)}",
+                        message=defect,
+                        fix="route every ring through "
+                            "collective_matmul._ring_perm",
+                        context={"primitive": name, "leg": leg,
+                                 "pairs": [list(q) for q in perm]},
+                    ))
+        if tables.get("fwd") and not tables.get("vjp"):
+            findings.append(Finding(
+                rule="ring-consistency",
+                severity=Severity.ERROR,
+                subject=f"{name}.vjp has no ring",
+                message="the custom-vjp backward traced to a program with "
+                        "no ppermute ring — the mirrored backward "
+                        "decomposition is not engaged",
+                context={"primitive": name},
+            ))
+    return findings

@@ -207,9 +207,10 @@ class AOTFunction:
                                 fingerprint=fp, program=self._name)
         compiled = self._try_deserialize(fp) if persist_ok else None
         persisted = None
+        remats = None
         if compiled is None:
             mode = "cold"
-            compiled = lowered.compile()
+            compiled, remats = self._compile_with_diagnostics(lowered)
             persisted = self._try_serialize(fp, compiled) if persist_ok \
                 else False
         else:
@@ -218,8 +219,11 @@ class AOTFunction:
         flops = metrics.flops_of(compiled)
         metrics.compile_end(self._name, fp, mode, seconds, flops=flops,
                             persisted=persisted)
+        if remats:
+            metrics.remat_diagnostics(self._name, fp, remats)
         info = {"name": self._name, "fingerprint": fp, "mode": mode,
-                "seconds": seconds, "flops": flops, "persisted": persisted}
+                "seconds": seconds, "flops": flops, "persisted": persisted,
+                "partitioner_remats": remats}
         self.last_compile = info
         if self._on_compile is not None:
             try:
@@ -227,6 +231,33 @@ class AOTFunction:
             except Exception:
                 pass
         return compiled
+
+    def _compile_with_diagnostics(self, lowered):
+        """Cold compile with the SPMD partitioner's stderr diagnostics
+        captured (the shardlint involuntary-remat evidence — C++ glog
+        lines no python hook sees) and parsed to a count. Degrades to a
+        plain compile when the analysis layer is unavailable; the
+        diagnostics are telemetry here, never a compile dependency."""
+        try:
+            from ..analysis import (capture_compile_diagnostics,
+                                    parse_partitioner_diagnostics)
+        except Exception:
+            return lowered.compile(), None
+        with capture_compile_diagnostics() as diag:
+            compiled = lowered.compile()  # compile errors propagate as-is
+        if diag.text:
+            # replay EVERYTHING captured back to the real stderr: the
+            # capture window spans a (multi-minute at scale) compile and
+            # fd 2 is process-global — a watchdog dump or any other
+            # thread's output must not be swallowed by this telemetry
+            try:
+                os.write(2, diag.text.encode(errors="replace"))
+            except OSError:
+                pass
+        try:
+            return compiled, len(parse_partitioner_diagnostics(diag.text))
+        except Exception:
+            return compiled, None
 
     def _try_deserialize(self, fp: str):
         """Warm path: payload → (exe bytes, in_tree, out_tree) →

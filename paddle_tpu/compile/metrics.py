@@ -28,7 +28,8 @@ from __future__ import annotations
 from typing import Any, Dict, Optional
 
 __all__ = ["flops_of", "compile_begin", "compile_end",
-           "crosscheck_stepmeter", "bump_counter", "cache_event"]
+           "crosscheck_stepmeter", "bump_counter", "cache_event",
+           "remat_diagnostics"]
 
 
 def flops_of(compiled) -> Optional[float]:
@@ -91,6 +92,22 @@ def compile_end(name: str, fingerprint: str, mode: str, seconds: float,
         t.set_gauge("compile_seconds_last", seconds)
         if flops:
             t.set_gauge("compile_cost_flops_last", flops)
+    except Exception:
+        pass
+
+
+def remat_diagnostics(name: str, fingerprint: str, count: int) -> None:
+    """Record the SPMD partitioner's involuntary-remat warning count for
+    one cold compile (captured by the AOT service, priced fully by the
+    shardlint ``involuntary-remat`` rule): a nonzero
+    ``compile_partitioner_remats_last`` gauge is the cheap always-on
+    tripwire; ``paddle_tpu.analysis.lint`` is the detailed follow-up."""
+    try:
+        t = _telemetry()
+        t.record_event("compile_diagnostics", name,
+                       fingerprint=fingerprint, partitioner_remats=count)
+        t.bump("compile_partitioner_remats_total", count)
+        t.set_gauge("compile_partitioner_remats_last", count)
     except Exception:
         pass
 

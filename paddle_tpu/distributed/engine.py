@@ -358,16 +358,22 @@ class DistributedTrainStep(TrainStep):
                 a, NamedSharding(self.mesh, P(None, *spec))))
         return out
 
-    def __call__(self, *batch) -> Tensor:
-        batch_arrays = []
+    def _prepare_batch(self, batch):
+        """Pin every batch arg's mesh sharding (explicit ``batch_specs``
+        or the default data×sharding/sep layout) — the one marshalling
+        hook, shared by ``__call__`` and the linter's ``lower()``."""
+        arrays = []
         for i, b in enumerate(batch):
             v = b._value if isinstance(b, Tensor) else jnp.asarray(b)
             if self._batch_specs is not None:
                 sh = NamedSharding(self.mesh, self._batch_specs[i])
             else:
                 sh = self._batch_sharding(v)
-            batch_arrays.append(jax.device_put(v, sh))
-        out = super().__call__(*[Tensor(a) for a in batch_arrays])
+            arrays.append(jax.device_put(v, sh))
+        return arrays
+
+    def __call__(self, *batch) -> Tensor:
+        out = super().__call__(*batch)
         if self._telemetry_program is not None:
             self._telemetry_program.record_execution()
         return out

@@ -666,6 +666,42 @@ class TrainStep:
             states.append(st)
         return states
 
+    def _prepare_batch(self, batch) -> List:
+        """Batch Tensors/arrays → raw arrays; the hook
+        DistributedTrainStep overrides to pin mesh shardings via
+        device_put. One home for the marshalling __call__ and lower()
+        share."""
+        return [b._value if isinstance(b, Tensor) else jnp.asarray(b)
+                for b in batch]
+
+    def _marshal_args(self, batch, key=None):
+        """The full argument tuple of one compiled-step invocation —
+        exactly what ``self._compiled`` is called (or lowered) with."""
+        states = self._opt_states()
+        param_arrays = [p._value for p in self._params]
+        buffer_arrays = [b._value for b in self._buffers]
+        batch_arrays = self._prepare_batch(batch)
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        if key is None:
+            key = next_key()
+        return (param_arrays, states, buffer_arrays, key, lr, batch_arrays)
+
+    def lower(self, *batch):
+        """AOT-lower the fused step program at these example batch
+        shapes WITHOUT executing or compiling it — the entry point the
+        static linter (:mod:`paddle_tpu.analysis`) and ahead-of-time
+        inspection use. The lowered object carries the exact donation
+        and sharding pins of the step's own compiled variant (it IS the
+        same jit object), so what the linter sees is what runs. Uses a
+        fixed PRNG key (key VALUES never affect lowering) so a lint/
+        inspection pass does not advance the training RNG stream."""
+        args = self._marshal_args(batch, key=jax.random.PRNGKey(0))
+        target = self._compiled
+        # unwrap the AOT service: AOTFunction.lower delegates, but going
+        # straight to the jit object keeps this free of cache effects
+        jitted = getattr(target, "_jitted", target)
+        return jitted.lower(*args)
+
     def __call__(self, *batch) -> Tensor:
         from ..framework.flags import get_flags
         from ..incubate.asp import ASPHelper
@@ -680,28 +716,24 @@ class TrainStep:
                     "changed after this TrainStep was compiled; call "
                     "asp.prune_model BEFORE building the TrainStep (or "
                     "rebuild it)")
-        states = self._opt_states()
-        param_arrays = [p._value for p in self._params]
-        buffer_arrays = [b._value for b in self._buffers]
-        batch_arrays = [b._value if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
+        args = self._marshal_args(batch)
+        batch_arrays = args[-1]
         if self._merge_k > 1:
             for a in batch_arrays:
                 if a.ndim == 0 or a.shape[0] % self._merge_k:
                     raise ValueError(
                         f"gradient_merge k={self._merge_k} needs every batch "
                         f"arg's dim0 divisible by k, got shape {a.shape}")
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         guard = self._health_guard
         probe = None
         if guard is not None and guard.active:
             # guarded path wins over check_nan_inf: it subsumes the check
             # (detects the same non-finites) and recovers instead of raising
-            loss, new_params, new_states, new_buf, probe = self._get_guarded()(
-                param_arrays, states, buffer_arrays, next_key(), lr,
-                batch_arrays)
+            loss, new_params, new_states, new_buf, probe = \
+                self._get_guarded()(*args)
         elif get_flags("check_nan_inf")["check_nan_inf"]:
-            loss, new_params, new_states, new_buf, finite = self._compiled_checked(
-                param_arrays, states, buffer_arrays, next_key(), lr, batch_arrays)
+            loss, new_params, new_states, new_buf, finite = \
+                self._compiled_checked(*args)
             flags = list(map(bool, finite))
             if not all(flags):
                 bad = (["loss"] if not flags[0] else []) + [
@@ -710,8 +742,7 @@ class TrainStep:
                     "check_nan_inf: non-finite values in compiled train step "
                     f"(gradients of: {', '.join(bad)})")
         else:
-            loss, new_params, new_states, new_buf = self._compiled(
-                param_arrays, states, buffer_arrays, next_key(), lr, batch_arrays)
+            loss, new_params, new_states, new_buf = self._compiled(*args)
         for p, arr, st in zip(self._params, new_params, new_states):
             mw = st.pop("@master", None)
             if mw is not None:

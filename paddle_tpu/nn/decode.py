@@ -182,6 +182,56 @@ class BeamSearchDecoder(Decoder):
         return True
 
 
+_KW_ARRAY_KEY_MAX = 4096  # value-hash small array kwargs; bigger opt out
+
+_DYNDEC_CACHE_MAX = 8  # compiled scans retained per decoder (LRU evict)
+
+_KW_VALUE_TYPES = (int, float, bool, complex, str, bytes, type(None))
+
+
+def _kwargs_cache_key(kwargs):
+    """Hashable BY-VALUE key for constant step kwargs, or None when any
+    leaf cannot be keyed safely.
+
+    The kwargs are closed over by the traced ``run`` (baked as
+    constants), so two calls may only share a compiled program when every
+    kwarg leaf is VALUE-identical — shape/dtype alone would silently
+    reuse a stale constant.  Value-semantic scalars/strings (and enum
+    members, which are singletons) key as (type, value); small
+    array-likes (Tensor/jnp/np, up to ``_KW_ARRAY_KEY_MAX`` elements) key
+    as (shape, dtype, content bytes).  Everything else — large arrays,
+    and ANY object whose hash is identity-based (a mutated config object
+    would silently reuse a stale trace; a fresh closure per call would
+    leak one cache entry per call) — returns None: those calls re-trace
+    exactly as before this cache existed."""
+    import enum
+
+    import numpy as np
+
+    if not kwargs:
+        return ()
+    leaves, treedef = jax.tree_util.tree_flatten(
+        kwargs, is_leaf=lambda x: isinstance(x, Tensor))
+    keyed = []
+    for leaf in leaves:
+        v = leaf._value if isinstance(leaf, Tensor) else leaf
+        if hasattr(v, "shape") and hasattr(v, "dtype"):
+            size = int(np.prod(v.shape)) if v.shape else 1
+            if size > _KW_ARRAY_KEY_MAX:
+                return None
+            try:
+                content = np.asarray(v).tobytes()
+            except Exception:
+                return None
+            keyed.append(("arr", tuple(v.shape), str(v.dtype), content))
+            continue
+        if not isinstance(v, _KW_VALUE_TYPES) and \
+                not isinstance(v, enum.Enum):
+            return None
+        keyed.append(("val", type(v).__name__, v))
+    return (repr(treedef), tuple(keyed))
+
+
 def dynamic_decode(decoder: Decoder, inits=None,
                    max_step_num: Optional[int] = None,
                    output_time_major: bool = False, impute_finished: bool = False,
@@ -209,6 +259,12 @@ def dynamic_decode(decoder: Decoder, inits=None,
             init_finished):  # compiled once per signature (cache below)
         from ..jit import _StateSwap
 
+        # host-side trace counter (body runs at trace time only): the
+        # kwargs-cache regression test asserts one trace across an eval
+        # loop's repeated same-kwarg calls
+        decoder.__dict__["_dyndec_traces"] = \
+            decoder.__dict__.get("_dyndec_traces", 0) + 1
+
         with _StateSwap(params, param_arrays), \
                 _StateSwap(buffers, buffer_arrays):
             def body(carry, t):
@@ -234,19 +290,31 @@ def dynamic_decode(decoder: Decoder, inits=None,
         return outputs, final_states, lengths
 
     # cache the compiled program on the decoder: an eval loop calling
-    # dynamic_decode per batch must not re-trace the whole scan each call
+    # dynamic_decode per batch must not re-trace the whole scan each call.
+    # Step kwargs are BAKED into the trace as constants, so they join the
+    # cache key BY VALUE (_kwargs_cache_key): a fixed kwarg passed every
+    # batch reuses one compiled program, a changed value re-traces, and an
+    # unkeyable kwarg (a large array constant) opts out of caching.
     in_vals = (_map(_val, init_inputs), _map(_val, init_states),
                init_finished)
-    if kwargs:  # extra step args are BAKED into the trace: never reuse
+    kw_key = _kwargs_cache_key(kwargs)
+    if kw_key is None:  # unkeyable step kwarg: bake-and-discard as before
         prog = jax.jit(run)
     else:
         flat, treedef = jax.tree_util.tree_flatten(in_vals)
         key = (steps, impute_finished, treedef,
                tuple((tuple(a.shape), str(a.dtype)) for a in flat),
-               len(params), len(buffers))
+               len(params), len(buffers), kw_key)
         cache = decoder.__dict__.setdefault("_dyndec_cache", {})
         if key not in cache:
             cache[key] = jax.jit(run)
+            # bounded LRU-ish: a per-call-VARYING kwarg (annealed
+            # temperature) keys fresh every call — without a cap each
+            # entry would retain a full compiled scan forever
+            while len(cache) > _DYNDEC_CACHE_MAX:
+                cache.pop(next(iter(cache)))
+        else:
+            cache[key] = cache.pop(key)  # refresh recency
         prog = cache[key]
     outputs, final_states, lengths = prog(
         [p._value for p in params], [b._value for b in buffers], *in_vals)

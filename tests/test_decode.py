@@ -131,3 +131,127 @@ def test_tile_beam_merge_with_batch():
     assert tiled.shape == (6, 2)
     np.testing.assert_array_equal(tiled[0], tiled[1])
     np.testing.assert_array_equal(tiled[4], tiled[5])
+
+
+class _KwDecoder(nn.Decoder):
+    """Minimal decoder whose step consumes a constant kwarg — the shape of
+    an eval loop passing a fixed knob (temperature, penalty) every batch."""
+
+    def initialize(self, inits):
+        import jax.numpy as jnp
+
+        h = inits._value if isinstance(inits, Tensor) else jnp.asarray(inits)
+        finished = jnp.zeros((h.shape[0],), bool)
+        return Tensor(h), Tensor(h), finished
+
+    def step(self, time, inputs, states, scale=1.0):
+        import jax.numpy as jnp
+
+        iv = inputs._value if isinstance(inputs, Tensor) \
+            else jnp.asarray(inputs)
+        sv = scale._value if isinstance(scale, Tensor) else jnp.asarray(scale)
+        out = iv * sv
+        fin = jnp.zeros((iv.shape[0],), bool)
+        return out, out, out, fin
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+
+def test_dynamic_decode_constant_kwargs_do_not_retrace():
+    """PR-7 satellite (nn/decode.py kwargs path): a FIXED step kwarg must
+    reuse one compiled scan across repeated calls (one trace total), a
+    CHANGED kwarg value must re-trace (the constant is baked), and the
+    baked constant must never go stale."""
+    dec = _KwDecoder()
+    h0 = np.ones((2, 4), np.float32)
+
+    for _ in range(3):
+        out2, _ = nn.dynamic_decode(dec, inits=Tensor(h0), max_step_num=2,
+                                    is_test=True, scale=2.0)
+    assert dec._dyndec_traces == 1, \
+        f"fixed-kwarg eval loop re-traced: {dec._dyndec_traces} traces"
+    assert len(dec._dyndec_cache) == 1
+
+    # changed value: MUST re-trace (a shape-keyed cache would silently
+    # reuse the stale baked 2.0) and must produce the new math
+    out3, _ = nn.dynamic_decode(dec, inits=Tensor(h0), max_step_num=2,
+                                is_test=True, scale=3.0)
+    assert dec._dyndec_traces == 2
+    np.testing.assert_allclose(out3.numpy()[:, 0], h0 * 3.0)
+    np.testing.assert_allclose(out2.numpy()[:, 0], h0 * 2.0)
+
+    # small array kwargs key by VALUE: same content reuses, new content
+    # re-traces
+    arr = np.full((1,), 2.0, np.float32)
+    nn.dynamic_decode(dec, inits=Tensor(h0), max_step_num=2, is_test=True,
+                      scale=Tensor(arr.copy()))
+    nn.dynamic_decode(dec, inits=Tensor(h0), max_step_num=2, is_test=True,
+                      scale=Tensor(arr.copy()))
+    traces_after_arr = dec._dyndec_traces
+    assert traces_after_arr == 3
+    nn.dynamic_decode(dec, inits=Tensor(h0), max_step_num=2, is_test=True,
+                      scale=Tensor(np.full((1,), 5.0, np.float32)))
+    assert dec._dyndec_traces == 4
+
+
+def test_dynamic_decode_no_kwargs_still_cached(setup):
+    dec, _, _, _, (V, E, H, K) = setup
+    dec.__dict__.pop("_dyndec_cache", None)
+    dec.__dict__.pop("_dyndec_traces", None)
+    h0 = np.zeros((2, H), np.float32)
+    for _ in range(2):
+        nn.dynamic_decode(dec, inits=Tensor(h0), max_step_num=3,
+                          is_test=True)
+    assert dec._dyndec_traces == 1
+
+
+def test_dynamic_decode_identity_hashed_kwarg_not_cached():
+    """A mutable object kwarg (identity-based hash) must OPT OUT of the
+    kwargs cache: mutating it between calls would otherwise silently
+    reuse the stale baked constant. Expect a re-trace per call and the
+    fresh value in the output."""
+
+    class Knob:
+        def __init__(self, s):
+            self.s = s
+
+    class KDec(_KwDecoder):
+        def step(self, time, inputs, states, knob=None):
+            import jax.numpy as jnp
+
+            iv = inputs._value if isinstance(inputs, Tensor) else \
+                jnp.asarray(inputs)
+            out = iv * knob.s
+            return out, out, out, jnp.zeros((iv.shape[0],), bool)
+
+    dec = KDec()
+    h0 = np.ones((2, 4), np.float32)
+    knob = Knob(2.0)
+    out2, _ = nn.dynamic_decode(dec, inits=Tensor(h0), max_step_num=2,
+                                is_test=True, knob=knob)
+    knob.s = 5.0  # mutate IN PLACE — same object, same id-hash
+    out5, _ = nn.dynamic_decode(dec, inits=Tensor(h0), max_step_num=2,
+                                is_test=True, knob=knob)
+    np.testing.assert_allclose(out2.numpy()[:, 0], h0 * 2.0)
+    np.testing.assert_allclose(out5.numpy()[:, 0], h0 * 5.0)
+    assert dec._dyndec_traces == 2          # re-traced, not stale-cached
+    assert not dec.__dict__.get("_dyndec_cache")  # and nothing retained
+
+
+def test_dynamic_decode_kwargs_cache_is_bounded():
+    """A per-call-varying scalar kwarg (annealed temperature) must not
+    retain one compiled scan per distinct value forever."""
+    from paddle_tpu.nn.decode import _DYNDEC_CACHE_MAX
+
+    dec = _KwDecoder()
+    h0 = np.ones((2, 4), np.float32)
+    for i in range(_DYNDEC_CACHE_MAX + 5):
+        nn.dynamic_decode(dec, inits=Tensor(h0), max_step_num=1,
+                          is_test=True, scale=float(i))
+    assert len(dec._dyndec_cache) <= _DYNDEC_CACHE_MAX
+    # the most recent value is still cached: repeating it adds no trace
+    traces = dec._dyndec_traces
+    nn.dynamic_decode(dec, inits=Tensor(h0), max_step_num=1,
+                      is_test=True, scale=float(_DYNDEC_CACHE_MAX + 4))
+    assert dec._dyndec_traces == traces

@@ -271,10 +271,13 @@ class TestMpLayersIntegration:
         np.testing.assert_allclose(dw1, dw0, rtol=1e-6, atol=1e-6)
 
     def test_parallel_ce_never_gathers_logits(self, hcg_mp2):
-        """Satellite: the one_hot is constrained BEFORE it meets the
-        logits, so the compiled loss+grad program contains no all-gather
-        of a full [B, V] tensor (walked from the optimized HLO — the
-        collective-bytes assertion)."""
+        """Satellite (PR 7: via the shared linter instead of a hand-rolled
+        HLO walk): the one_hot is constrained BEFORE it meets the logits,
+        so the compiled loss+grad program materializes no full [B, V]
+        tensor — the replication-blowup rule with the threshold pinned at
+        the full row size gives the exact same guarantee, now machine-
+        checked by the same rule every other program lints against."""
+        from paddle_tpu.analysis import lint
         from paddle_tpu.distributed.meta_parallel import ParallelCrossEntropy
         from paddle_tpu.tensor.tensor import Tensor
 
@@ -290,18 +293,12 @@ class TestMpLayersIntegration:
 
         logits = jnp.asarray(np.random.default_rng(3)
                              .standard_normal((B, V)).astype(np.float32))
-        txt = jax.jit(jax.grad(loss)).lower(logits).compile().as_text()
         full_row_bytes = B * V * 4
-        for m in re.finditer(r"=\s*(.*?)\s+all-gather(?:-start)?\(", txt):
-            size = 0
-            for dm in re.finditer(r"(f32|bf16|f16)\[([\d,]*)\]", m.group(1)):
-                s = 4 if dm.group(1) == "f32" else 2
-                for d in dm.group(2).split(","):
-                    if d.strip():
-                        s *= int(d)
-                size += s
-            assert size < full_row_bytes, \
-                f"full logits row gathered: {m.group(0)}"
+        report = lint(jax.jit(jax.grad(loss)), args=(logits,),
+                      rules=["replication-blowup"], baseline=False,
+                      config={"replication_threshold_bytes": full_row_bytes})
+        assert report.ok, \
+            f"full logits row gathered:\n{report.format()}"
 
 
 # ---------------------------------------------------------------------------
