@@ -33,17 +33,39 @@ reports ``lint_findings`` per point, and the tier-1 ``analysis`` pytest
 marker runs the fixture + clean-program suites.
 """
 
-from .baseline import (Baseline, DEFAULT_BASELINE_PATH,  # noqa: F401
-                       load_baseline)
-from .findings import Finding, LintReport, Severity  # noqa: F401
-from .linter import lint  # noqa: F401
-from .program import (ProgramArtifacts, capture_compile_diagnostics,  # noqa: F401
-                      collect, jaxpr_primitives)
-from .rules import RULES, run_rules  # noqa: F401
-from .rules.remat import parse_partitioner_diagnostics  # noqa: F401
-from .rules.ring import analyze_perm, check_overlap_rings  # noqa: F401
-from .source_check import (check_jax_compat_seam,  # noqa: F401
-                           check_source_text)
+from .annotations import host_sync_ok, is_host_sync_ok  # noqa: F401
+
+# everything else resolves lazily (PEP 562): runtime code that only wants
+# the import-light annotations (the snapshot capture path marks itself
+# @host_sync_ok) must not drag the linter's jax-lowering machinery into
+# every `import paddle_tpu`
+_LAZY = {
+    "lint": ".linter",
+    "ProgramArtifacts": ".program", "collect": ".program",
+    "capture_compile_diagnostics": ".program",
+    "jaxpr_primitives": ".program",
+    "RULES": ".rules", "run_rules": ".rules",
+    "Finding": ".findings", "LintReport": ".findings",
+    "Severity": ".findings",
+    "Baseline": ".baseline", "load_baseline": ".baseline",
+    "DEFAULT_BASELINE_PATH": ".baseline",
+    "parse_partitioner_diagnostics": ".rules.remat",
+    "analyze_perm": ".rules.ring", "check_overlap_rings": ".rules.ring",
+    "check_jax_compat_seam": ".source_check",
+    "check_source_text": ".source_check",
+}
+
+
+def __getattr__(name: str):
+    try:
+        target = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    from importlib import import_module
+
+    return getattr(import_module(target, __name__), name)
+
 
 __all__ = [
     "lint", "collect", "run_rules", "RULES",
@@ -52,4 +74,5 @@ __all__ = [
     "capture_compile_diagnostics", "jaxpr_primitives",
     "parse_partitioner_diagnostics", "analyze_perm", "check_overlap_rings",
     "check_jax_compat_seam", "check_source_text",
+    "host_sync_ok", "is_host_sync_ok",
 ]

@@ -1,0 +1,44 @@
+"""Source-level annotations the shardlint rules honor.
+
+Kept import-light (stdlib only) so runtime code — e.g. the snapshot
+capture path in :mod:`paddle_tpu.distributed.checkpoint.snapshot` — can
+mark itself without dragging the linter (and its jax-lowering machinery)
+into the hot import path.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+__all__ = ["host_sync_ok", "is_host_sync_ok", "HOST_SYNC_OK_ATTR"]
+
+HOST_SYNC_OK_ATTR = "_paddle_tpu_host_sync_ok"
+
+
+def host_sync_ok(fn: Optional[Callable] = None, *, reason: str = ""):
+    """Mark a function as a DELIBERATE device→host synchronization point,
+    scoped-exempt from the ``host-sync`` shardlint rule.
+
+    The rule exists to catch accidental per-step queue stalls inside step
+    functions; some transfers are the design — the snapshot capture path
+    device-gets shards into host RAM *off* the step's critical cadence
+    (every ``PADDLE_TPU_SNAP_EVERY`` steps, amortized).  Decorating the
+    function records the justification on the object and skips it in the
+    AST walk, while strays in undecorated step functions keep flagging.
+
+    Usable bare (``@host_sync_ok``) or with a reason
+    (``@host_sync_ok(reason="...")``).  The exemption is per-FUNCTION, not
+    per-module: anything the decorated function *calls* is still linted
+    when handed to the linter on its own."""
+
+    def mark(f: Callable) -> Callable:
+        setattr(f, HOST_SYNC_OK_ATTR, reason or True)
+        return f
+
+    if fn is not None:
+        return mark(fn)
+    return mark
+
+
+def is_host_sync_ok(fn) -> bool:
+    return bool(getattr(fn, HOST_SYNC_OK_ATTR, False))

@@ -12,6 +12,11 @@ I/O retries with backoff (``storage.py``), async-save failures re-raise
 on the main thread instead of dying with the daemon writer, and
 ``faults.py`` is a seeded injector that makes all of it testable:
 
+- :class:`Snapshotter` / :func:`resume` — in-memory peer-replicated
+  snapshots (``snapshot.py`` + ``replicator.py``): host-RAM capture every
+  ``PADDLE_TPU_SNAP_EVERY`` steps with ring-neighbor replication, and the
+  recovery ladder own-RAM → depot copy → peer replica → committed disk
+  (``resume_source=memory|peer|disk``, RPO = steps not intervals);
 - :func:`latest_checkpoint` — newest *committed* checkpoint under a root
   (interrupted saves are invisible to resume);
 - :func:`gc_checkpoints` — keep-N retention sweep;
@@ -21,6 +26,7 @@ on the main thread instead of dying with the daemon writer, and
 """
 
 from . import faults  # noqa: F401  (fault-injection API: faults.inject(...))
+from . import replicator  # noqa: F401  (snapshot replication transports)
 from .commit import (gc_checkpoints, is_committed,  # noqa: F401
                      latest_checkpoint)
 from .errors import (AsyncSaveError, CheckpointCorruptionError,  # noqa: F401
@@ -28,9 +34,12 @@ from .errors import (AsyncSaveError, CheckpointCorruptionError,  # noqa: F401
 from .load_state_dict import load_state_dict
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
 from .save_state_dict import save_state_dict
+from .snapshot import (ResumeInfo, Snapshotter,  # noqa: F401
+                       SnapshotRestoreError, resume)
 
 __all__ = ["save_state_dict", "load_state_dict", "Metadata",
            "LocalTensorMetadata", "LocalTensorIndex",
            "latest_checkpoint", "gc_checkpoints", "is_committed",
            "CheckpointError", "CheckpointCorruptionError", "AsyncSaveError",
-           "faults"]
+           "faults", "replicator",
+           "Snapshotter", "SnapshotRestoreError", "ResumeInfo", "resume"]

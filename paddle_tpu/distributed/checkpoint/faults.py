@@ -25,7 +25,8 @@ operations fail in a controlled, reproducible way:
       :class:`~paddle_tpu.distributed.fleet.elastic.PreemptionGuard`).
 
 ``op`` selects the protocol step (``"write"``, ``"read"``, ``"rename"``,
-``"commit"`` — the marker write — or ``"any"``); ``pattern`` is an
+``"commit"`` — the marker write — ``"snap"`` — the in-memory snapshot
+capture/ship path — or ``"any"``); ``pattern`` is an
 ``fnmatch`` over the file's basename (or full path). ``after``/``times``
 window which matching calls fire, and ``p``/``seed`` make probabilistic
 campaigns reproducible.
@@ -56,7 +57,7 @@ __all__ = ["FaultSpec", "InjectedIOError", "InjectedCrash", "inject",
            "scope", "fire", "active", "reset"]
 
 _MODES = ("error", "crash", "truncate", "delay", "sigterm")
-_OPS = ("write", "read", "rename", "commit", "any")
+_OPS = ("write", "read", "rename", "commit", "snap", "any")
 
 
 class InjectedIOError(OSError):

@@ -83,7 +83,7 @@ class DistributedTrainStep(TrainStep):
                  batch_specs: Optional[Sequence[P]] = None, donate: bool = True,
                  offload: Optional[bool] = None,
                  gradient_merge: Optional[int] = None, health_guard=None,
-                 persistent_cache=None):
+                 persistent_cache=None, snapshotter=None):
         self.hcg = hcg
         self.mesh = hcg.mesh
         if sharding_stage is None:
@@ -106,7 +106,8 @@ class DistributedTrainStep(TrainStep):
         super().__init__(model, loss_fn, optimizer, donate=donate,
                          gradient_merge=gradient_merge,
                          health_guard=health_guard,
-                         persistent_cache=persistent_cache)
+                         persistent_cache=persistent_cache,
+                         snapshotter=snapshotter)
         self._grad_bucketer = self._build_bucketer()
         self._place_state()
         # every compiled variant must pin the SAME shardings (else XLA is
@@ -501,6 +502,13 @@ class GPipeLayers(ScannedLayers):
             return h
 
         def sharded_body(xv_, *stacks):
+            # NB: axis_index is fine HERE (this program is differentiated
+            # through apply_op, and shard_map's JVP rejects non-float
+            # operands like an arange stage input); the 1F1B engine — whose
+            # backward is hand-written, never autodiff'd through — routes
+            # stage in as an arange(p) input instead, because axis_index
+            # under a partial-manual region lowers to a PartitionId op
+            # jaxlib 0.4.36's SPMD partitioner cannot partition
             stage = jax.lax.axis_index(axis)
             mb = xv_.shape[0] // m
             xs = xv_.reshape((m, mb) + xv_.shape[1:])

@@ -22,7 +22,17 @@ above it must relaunch the whole gang.  That something is
   (``nproc_per_node - 1`` per degrade step, floored at
   ``GangPolicy.min_procs``), shrinking the DP degree — the relaunched ranks
   ride the checkpoint reshard-on-load path under the smaller mesh (the
-  "resume under a different mesh" property PR 2's tests established).
+  "resume under a different mesh" property PR 2's tests established);
+- relaunched ranks resume through the **in-memory snapshot ladder**
+  (:func:`~....checkpoint.snapshot.resume`: own RAM → snapshot-store copy
+  → peer replica → committed disk checkpoint).  The supervisor hosts the
+  snapshot depot (:func:`~....checkpoint.replicator.ensure_host_store`) in
+  ITS process so copies survive gang teardown, exports
+  ``PADDLE_TPU_SNAP_STORE`` to every launch, and after each attempt reads
+  the ranks' resume reports back — ``gang_restart`` /
+  ``fleet_supervisor_done`` events carry ``resume_sources``
+  (memory|peer|disk per rank) and ``steps_lost`` so the goodput trail
+  shows WHAT each restart actually cost.
 
 usage::
 
@@ -39,16 +49,10 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence
 
-from .supervisor import RestartPolicy
+from ...checkpoint.replicator import env_int as _env_int
+from .supervisor import RestartPolicy, worst_resume_source
 
 __all__ = ["GangPolicy", "FleetSupervisor"]
-
-
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, default))
-    except (TypeError, ValueError):
-        return default
 
 
 @dataclass
@@ -121,6 +125,22 @@ class FleetSupervisor:
         self.degrades = 0
         self.world_size = self.nnodes * self.nproc_per_node
         self.exit_codes: List[int] = []
+        # in-memory snapshot depot: hosted HERE (this process outlives
+        # every gang epoch) so peer replicas survive a full teardown and
+        # the relaunch can resume from memory instead of disk
+        self.resume_reports: Dict[int, Dict[int, dict]] = {}
+        self._snap_addr: Optional[str] = None
+        if os.environ.get("PADDLE_TPU_SNAP", "1") not in ("0", "false"):
+            # an already-exported depot (outer supervisor, test harness)
+            # wins; otherwise host the process-global one here
+            self._snap_addr = os.environ.get("PADDLE_TPU_SNAP_STORE")
+            if not self._snap_addr:
+                try:
+                    from ...checkpoint.replicator import ensure_host_store
+
+                    _, self._snap_addr = ensure_host_store()
+                except Exception:
+                    self._snap_addr = None
 
     # -- one launch --------------------------------------------------------
     def _argv(self) -> List[str]:
@@ -142,8 +162,41 @@ class FleetSupervisor:
         }
         if self.compile_cache:
             env["PADDLE_TPU_COMPILE_CACHE"] = self.compile_cache
+        if self._snap_addr:
+            env["PADDLE_TPU_SNAP_STORE"] = self._snap_addr
         env.update(self.env)
         return env
+
+    def _collect_resume(self, epoch: int) -> dict:
+        """Ranks report how they resumed (source + steps_lost) into the
+        snapshot depot at epoch start; read it back after the attempt so
+        the restart events narrate the recovery ladder's outcome."""
+        if not self._snap_addr:
+            return {}
+        try:
+            from ...checkpoint.replicator import SnapshotClient
+
+            client = SnapshotClient.from_address(self._snap_addr)
+            try:
+                reports = client.resume_reports(epoch)
+            finally:
+                client.close()
+        except Exception:
+            return {}
+        if not reports:
+            return {}
+        self.resume_reports[epoch] = reports
+        lost = [d.get("steps_lost") for d in reports.values()
+                if d.get("steps_lost") is not None]
+        return {
+            # worst rung scalar first — uniform with the single-process
+            # Supervisor's restart events, what telemetry filters on
+            "resume_source": worst_resume_source(
+                d.get("source") for d in reports.values()),
+            "resume_sources": {r: d.get("source")
+                               for r, d in sorted(reports.items())},
+            "steps_lost": max(lost) if lost else None,
+        }
 
     def _launch_once(self) -> int:
         self.epoch += 1
@@ -191,15 +244,16 @@ class FleetSupervisor:
         while True:
             rc = self._launch_once()
             self.exit_codes.append(rc)
+            resume = self._collect_resume(self.epoch)
             if rc == 0:
                 self._event("fleet_supervisor_done", epoch=self.epoch,
                             restarts=self.epoch - 1,
                             degrades=self.degrades,
-                            world=self.world_size)
+                            world=self.world_size, **resume)
                 return 0
             if rc in self.fatal_codes:
                 self._event("fleet_supervisor_fatal", exit_code=rc,
-                            epoch=self.epoch)
+                            epoch=self.epoch, **resume)
                 return rc
             if self.gang_restarts >= self.policy.max_gang_restarts:
                 # budget for this world size is spent: a persistently
@@ -213,7 +267,8 @@ class FleetSupervisor:
                 self.gang_restarts += 1
             delay = self.policy.backoff.delay(self.epoch)
             self._event("gang_restart", attempt=self.epoch, exit_code=rc,
-                        backoff_s=round(delay, 3), world=self.world_size)
+                        backoff_s=round(delay, 3), world=self.world_size,
+                        **resume)
             if self.ckpt_root and self.keep_n:
                 try:
                     from ...checkpoint import gc_checkpoints

@@ -85,7 +85,22 @@ from typing import Callable, List, Optional, Sequence, Union
 
 from . import ELASTIC_EXIT_CODE
 
-__all__ = ["RestartPolicy", "Supervisor", "emergency_handler"]
+__all__ = ["RestartPolicy", "Supervisor", "emergency_handler",
+           "RESUME_LADDER", "worst_resume_source"]
+
+# recovery rungs from cheapest to most degraded — a multi-rank launch
+# reports its WORST rung (the one that actually bounded the restart)
+RESUME_LADDER = ("memory", "peer", "disk", "none")
+
+
+def worst_resume_source(sources) -> Optional[str]:
+    """The most-degraded rung among per-rank resume sources (unknown
+    strings rank below every known rung)."""
+    sources = [s for s in sources if s is not None]
+    if not sources:
+        return None
+    return max(sources, key=lambda s: RESUME_LADDER.index(s)
+               if s in RESUME_LADDER else len(RESUME_LADDER))
 
 
 @dataclass
@@ -145,6 +160,7 @@ class Supervisor:
         self.restarts = 0
         self.exit_codes: List[int] = []
         self.time_to_first_step_s: Optional[float] = None
+        self.last_resume: Optional[dict] = None  # {"source","steps_lost",…}
         self._stamp_dir: Optional[str] = None
 
     # -- first-step goodput probe ------------------------------------------
@@ -167,10 +183,46 @@ class Supervisor:
         except (OSError, ValueError):
             return None
 
+    def _read_resume_report(self, base: str) -> Optional[dict]:
+        """The child's resume ladder (``checkpoint.snapshot.resume``)
+        writes ``<base>.<rank>`` with its resolved source + steps_lost —
+        read it back so restart events narrate memory-vs-disk recovery.
+        With several ranks the scalar fields aggregate deterministically
+        (most-degraded source, earliest step, max steps_lost) and the
+        per-rank map rides along as ``resume_sources``."""
+        import glob
+        import json
+
+        docs = {}
+        for path in sorted(glob.glob(base + ".*")):
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+                os.remove(path)
+            except (OSError, ValueError):
+                continue
+            docs[doc.get("rank", len(docs))] = doc
+        if not docs:
+            return None
+
+        lost = [d.get("steps_lost") for d in docs.values()
+                if d.get("steps_lost") is not None]
+        steps = [d.get("step") for d in docs.values()
+                 if d.get("step") is not None]
+        out = {"resume_source": worst_resume_source(
+                   d.get("source") for d in docs.values()),
+               "resume_step": min(steps) if steps else None,
+               "steps_lost": max(lost) if lost else None}
+        if len(docs) > 1:
+            out["resume_sources"] = {r: d.get("source")
+                                     for r, d in sorted(docs.items())}
+        return out
+
     # -- one launch --------------------------------------------------------
     def _launch_once(self) -> int:
         stamp = self._next_stamp_path()
-        extra_env = {"PADDLE_TPU_FIRST_STEP_STAMP": stamp}
+        extra_env = {"PADDLE_TPU_FIRST_STEP_STAMP": stamp,
+                     "PADDLE_TPU_RESUME_REPORT": stamp + ".resume"}
         if self.compile_cache:
             extra_env["PADDLE_TPU_COMPILE_CACHE"] = self.compile_cache
         launch_wall = time.time()
@@ -178,6 +230,7 @@ class Supervisor:
             return self._launch_raw(extra_env)
         finally:
             self.time_to_first_step_s = self._read_stamp(stamp, launch_wall)
+            self.last_resume = self._read_resume_report(stamp + ".resume")
 
     def _launch_raw(self, extra_env: dict) -> int:
         if callable(self.target):
@@ -218,26 +271,27 @@ class Supervisor:
                 self.exit_codes.append(rc)
                 ttfs = None if self.time_to_first_step_s is None else \
                     round(self.time_to_first_step_s, 3)
+                resume = self.last_resume or {}
                 if rc == 0:
                     self._event("supervisor_done", restarts=self.restarts,
-                                time_to_first_step_s=ttfs)
+                                time_to_first_step_s=ttfs, **resume)
                     return 0
                 if rc not in self.restart_codes:
                     self._event("supervisor_fatal", exit_code=rc,
                                 restarts=self.restarts,
-                                time_to_first_step_s=ttfs)
+                                time_to_first_step_s=ttfs, **resume)
                     return rc
                 if self.restarts >= self.policy.max_restarts:
                     self._event("supervisor_giveup", exit_code=rc,
                                 restarts=self.restarts,
-                                time_to_first_step_s=ttfs)
+                                time_to_first_step_s=ttfs, **resume)
                     return rc
                 self.restarts += 1
                 delay = self.policy.delay(self.restarts)
                 self._event("supervisor_restart", attempt=self.restarts,
                             exit_code=rc, backoff_s=round(delay, 3),
                             health_rewinds=self._rewind_count(),
-                            time_to_first_step_s=ttfs)
+                            time_to_first_step_s=ttfs, **resume)
                 if self.ckpt_root and self.keep_n:
                     try:
                         from ...checkpoint import gc_checkpoints

@@ -119,6 +119,17 @@ class RewindLedger:
         ends = [e["step"] for e in self.rewinds_at(resume_step)]
         return max(0, max(ends) - int(resume_step)) if ends else 0
 
+    def poisoned(self, step: int) -> bool:
+        """True when ``step`` falls inside any recorded poisoned window
+        ``(resume_step, step]`` — the snapshot resolution ladder
+        (:func:`~..checkpoint.snapshot.resume`) consults this so an
+        in-memory snapshot generation captured between a rewind's anchor
+        and its escalation is never resumed into: those snapshots hold the
+        very state the rewind exists to discard."""
+        s = int(step)
+        return any(e["window"][0] < s <= e["window"][1]
+                   for e in self.entries() if e.get("window"))
+
     def check_restart(self, resume_step: int,
                       max_rewinds: int = 2) -> int:
         """Validate that restarting at ``resume_step`` can make progress

@@ -22,6 +22,20 @@ healthy.  Teardown is TERM → ``PADDLE_TPU_TEARDOWN_GRACE`` seconds → KILL,
 after an initial self-exit window so ranks get to finish their emergency
 checkpoints.
 
+In-memory snapshots (``PADDLE_TPU_SNAP``, default on): the launcher hosts
+the :class:`~..checkpoint.replicator.SnapshotStore` — a process-global
+depot standing in for per-host RAM, so workers' snapshot copies survive a
+SIGKILL'd rank — and exports ``PADDLE_TPU_SNAP_STORE`` so every rank's
+:class:`~..checkpoint.Snapshotter` can ship its own copy plus the
+ring-neighbor replica.  The watch loop models host loss faithfully: a
+child that dies UNCOORDINATED (a signal, any exit other than 0/101) has
+its *held* copies dropped (its own snapshot AND the replica it kept for
+its ring predecessor), which is exactly what makes the double-fault case
+— a rank and its replica holder dying in the same window — fall back to
+the committed disk checkpoint instead of silently resuming torn state.
+Coordinated exits (the poison-poll's 101) keep their holdings: the "host"
+is fine, only the process restarts.
+
 On TPU the normal deployment is ONE process per host owning all local chips
 (`--nproc_per_node 1`, the default); multi-process-per-host is used by the
 CPU "fake cluster" tests."""
@@ -85,6 +99,66 @@ def _record_event(name: str, **data) -> None:
         telemetry.record_event("gang", name, **data)
     except Exception:
         pass
+
+
+class _SnapWatch:
+    """The launcher's snapshot-store membership: host (or address) the
+    depot and translate uncoordinated child deaths into holder drops.
+    Best-effort throughout — snapshots degrading must never take a pod
+    down."""
+
+    def __init__(self, fleet_kv=None, advertise_host: Optional[str] = None):
+        from ..checkpoint import replicator
+
+        self.addr = os.environ.get("PADDLE_TPU_SNAP_STORE")
+        if not self.addr and fleet_kv is not None:
+            # multi-node: ONE depot for the whole gang, or per-node depots
+            # could never assemble a complete generation and a peer
+            # replica for a cross-node ring neighbor would die with its
+            # own node. The pod hosting the rendezvous store (the master
+            # host) hosts the depot too — the SnapshotStore binds wildcard
+            # — and publishes its REACHABLE address through the store.
+            if getattr(fleet_kv, "is_master", False):
+                depot, local = replicator.ensure_host_store()
+                self.addr = (f"{advertise_host}:{depot.port}"
+                             if advertise_host else local)
+                fleet_kv.set("snap/store", self.addr)
+            else:
+                self.addr = fleet_kv.get("snap/store",
+                                         timeout=60.0).decode()
+        if not self.addr:
+            # single node: host the process-global one (FleetSupervisor
+            # epochs re-enter launch() in this same process and find the
+            # SAME depot — that persistence is what memory recovery
+            # rides on)
+            _, self.addr = replicator.ensure_host_store()
+        self._client = replicator.SnapshotClient.from_address(self.addr)
+
+    def note_child_exit(self, rank: Optional[int], code: int) -> None:
+        """Exit 0 = done, 101 = coordinated abort (poison poll / health
+        rewind): the conceptual host RAM survives, holdings stay.  Anything
+        else — a signal (negative code), an uncaught crash — models host
+        loss: every copy this rank HELD goes, so recovery can only use the
+        surviving peer replica (or disk)."""
+        if rank is None or code in (0, 101):
+            return
+        try:
+            dropped = self._client.drop_holder(rank)
+        except Exception:
+            return
+        if dropped:
+            _record_event("snapshot_holder_dropped", rank=rank,
+                          exit_code=code, copies_dropped=dropped)
+
+    def stop(self) -> None:
+        if self._client is not None:
+            try:
+                self._client.close()
+            except Exception:
+                pass
+        # a locally hosted depot is process-global ON PURPOSE: it must
+        # outlive this launch() so the FleetSupervisor's next gang epoch
+        # finds the copies — never closed here
 
 
 class _PodWatch:
@@ -173,6 +247,20 @@ def launch(argv=None) -> int:
         except Exception as e:
             sys.stderr.write(f"[launch] fault domain unavailable: {e!r}\n")
             fleet_store_addr, watch = None, None
+
+    # in-memory snapshot depot: hosted here (or addressed, when a
+    # FleetSupervisor/test exported PADDLE_TPU_SNAP_STORE already) and
+    # handed to every rank; uncoordinated child deaths drop their holdings
+    snap: Optional[_SnapWatch] = None
+    if os.environ.get("PADDLE_TPU_SNAP", "1") not in ("0", "false"):
+        try:
+            snap = _SnapWatch(
+                fleet_kv=store if args.nnodes > 1 else None,
+                advertise_host=(master.rsplit(":", 1)[0]
+                                if args.nnodes > 1 else None))
+        except Exception as e:
+            sys.stderr.write(f"[launch] snapshot store unavailable: {e!r}\n")
+            snap = None
     os.makedirs(args.log_dir, exist_ok=True)
 
     grace = 10.0
@@ -201,6 +289,7 @@ def launch(argv=None) -> int:
                 **({"PADDLE_TPU_FLEET_STORE": fleet_store_addr,
                     "PADDLE_TPU_FLEET_MONITOR": "launcher"}
                    if fleet_store_addr else {}),
+                **({"PADDLE_TPU_SNAP_STORE": snap.addr} if snap else {}),
                 # multi-process-per-host (CPU fake cluster): keep each worker
                 # to its own slice of host devices
                 "PADDLE_NPROC_PER_NODE": str(nproc),
@@ -226,6 +315,8 @@ def launch(argv=None) -> int:
             f.close()
         if watch is not None:
             watch.stop()
+        if snap is not None:
+            snap.stop()
         if fleet_store is not None:
             fleet_store.close()
         raise
@@ -262,6 +353,10 @@ def launch(argv=None) -> int:
                 procs.remove(pr)
                 _record_event("gang_child_exit", rank=ranks.get(pr.pid),
                               exit_code=code)
+                if snap is not None:
+                    # spontaneous deaths only — teardown TERM/KILLs below
+                    # are launcher-coordinated, the "host RAM" stays
+                    snap.note_child_exit(ranks.get(pr.pid), code)
                 if code == 0 and watch is not None and \
                         ranks.get(pr.pid) is not None:
                     # a clean exit that never stopped its domain must not
@@ -269,6 +364,17 @@ def launch(argv=None) -> int:
                     watch.domain.release_rank(ranks[pr.pid])
                 if code != 0:
                     rc = code
+                    if snap is not None:
+                        # siblings that ALSO died spontaneously in this
+                        # same window (double fault: a rank and its
+                        # replica holder) lose their holdings too —
+                        # sweep BEFORE teardown marks everyone else's
+                        # exit as launcher-coordinated
+                        for other in procs:
+                            oc = other.poll()
+                            if oc is not None:
+                                snap.note_child_exit(
+                                    ranks.get(other.pid), oc)
                     # first failure tears down the pod (reference
                     # CollectiveController watch loop) — poison FIRST so
                     # ranks wedged inside a collective convert the hang
@@ -306,6 +412,8 @@ def launch(argv=None) -> int:
             f.close()
         if watch is not None:
             watch.stop()
+        if snap is not None:
+            snap.stop()
         if fleet_store is not None:
             fleet_store.close()
     return rc

@@ -515,10 +515,16 @@ class OneFOneBLayers(GPipeLayers):
         tab_consts = [jnp.asarray(tbl[k]) for k in tab_names]
         tdbox = {}  # vjp treedef, filled while tracing do_f (before do_b)
 
-        def sharded_step(xv, yv, *tabs_and_stacks):
+        def sharded_step(stage_arr, xv, yv, *tabs_and_stacks):
             tabs = dict(zip(tab_names, tabs_and_stacks[:n_tab]))
             stacks = tabs_and_stacks[n_tab:]
-            stage = jax.lax.axis_index(axis)
+            # stage position arrives as an arange(p) input sharded over the
+            # pipe axis (each shard sees its own [1] slice) instead of
+            # lax.axis_index: under this PARTIAL-manual region axis_index
+            # lowers to a PartitionId op jaxlib 0.4.36's SPMD partitioner
+            # cannot partition (UNIMPLEMENTED) — same technique as the
+            # collective-matmul rings (overlap/collective_matmul.py)
+            stage = stage_arr[0]
             mb = xv.shape[0] // m
             xs = xv.reshape((m, mb) + xv.shape[1:])
             ys = yv.reshape((m, mb) + yv.shape[1:])
@@ -751,14 +757,24 @@ class OneFOneBLayers(GPipeLayers):
             return (loss,) + gacc
 
         n_stacks = len(self._stack_names)
+        # FULL-manual region (all mesh axes bound), like the collective-
+        # matmul rings: under jaxlib 0.4.36 a *partial*-manual region with
+        # real-sized auto axes (pp>1 alongside mp/dp/sharding>1) trips the
+        # partitioner's IsManualSubgroup check on the ring ppermutes. The
+        # body touches no non-pipe axis — batch/tables replicate, stacks
+        # shard over pipe — so binding every axis costs nothing; check_vma
+        # off because the replicated loss output is psum-produced, which
+        # the rep checker cannot type (same as collective_matmul).
         smapped = _shard_map(
-            sharded_step, mesh=mesh, axis_names={axis},
-            in_specs=(P(), P()) + (P(),) * n_tab + (P(axis),) * n_stacks,
-            out_specs=(P(),) + (P(axis),) * n_stacks, check_vma=True)
+            sharded_step, mesh=mesh,
+            in_specs=(P(axis), P(), P()) + (P(),) * n_tab
+            + (P(axis),) * n_stacks,
+            out_specs=(P(),) + (P(axis),) * n_stacks, check_vma=False)
+        stage_iota = jnp.arange(p, dtype=jnp.int32)
 
         @jax.jit
         def step(xv, yv, *stacks):
-            return smapped(xv, yv, *tab_consts, *stacks)
+            return smapped(stage_iota, xv, yv, *tab_consts, *stacks)
 
         return step
 

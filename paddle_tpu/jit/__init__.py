@@ -394,12 +394,13 @@ class TrainStep:
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer, donate: bool = True,
                  gradient_merge: Optional[int] = None, health_guard=None,
-                 persistent_cache=None):
+                 persistent_cache=None, snapshotter=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self._donate = donate
         self._health_guard = health_guard
+        self._snapshotter = snapshotter
         if persistent_cache is not None:
             from ..compile import resolve_cache
 
@@ -449,6 +450,16 @@ class TrainStep:
         already-built step (the ``health_guard=`` ctor arg is equivalent).
         The next call traces the guarded program variant."""
         self._health_guard = guard
+
+    # -- in-memory snapshots -----------------------------------------------
+    def attach_snapshotter(self, snapshotter) -> None:
+        """Arm a :class:`~paddle_tpu.distributed.checkpoint.Snapshotter`
+        (``snapshotter=`` ctor arg is equivalent): every
+        ``PADDLE_TPU_SNAP_EVERY``-th completed step triggers a host-RAM
+        snapshot + peer replication.  Pure host-side hook AFTER the state
+        rebind — the compiled program, its fingerprint, and the trace are
+        untouched, so attaching/detaching never recompiles."""
+        self._snapshotter = snapshotter
 
     def _make_guarded_jit(self):
         """Compiled variant with the fused health probe. Donation is safe:
@@ -759,6 +770,15 @@ class TrainStep:
             # guard resolves the probe max_lag steps late and may raise
             # SystemExit(101) here to hand control to the Supervisor
             guard.on_step(probe, step=self.optimizer._step_count)
+        # in-memory snapshot cadence: the capture device-gets the JUST
+        # REBOUND state synchronously (the next step donates these arrays,
+        # so a lazy capture would read invalidated buffers); serialization
+        # + peer replication leave on the snapshotter's background thread
+        if self._snapshotter is not None:
+            try:
+                self._snapshotter.on_step(self.optimizer._step_count)
+            except Exception:
+                pass  # degraded RPO must never kill the step
         # supervisor goodput probe: first completed step of this process
         # (relaunch → here is time_to_first_step_s in restart events)
         _stamp_first_step()

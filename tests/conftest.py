@@ -41,7 +41,12 @@ for _k, _v in (("PADDLE_TPU_HB_INTERVAL", "0.25"),
                ("PADDLE_TPU_POISON_POLL", "0.2"),
                ("PADDLE_TPU_ABORT_DEADLINE", "5"),
                ("PADDLE_TPU_GANG_BARRIER_DEADLINE", "20"),
-               ("PADDLE_TPU_TEARDOWN_GRACE", "4")):
+               ("PADDLE_TPU_TEARDOWN_GRACE", "4"),
+               # in-memory snapshot chaos suite: production cadence (every
+               # 10 steps) and 30s client deadlines would blow the tier-1
+               # budget — snapshot every 2 steps, fail transports fast
+               ("PADDLE_TPU_SNAP_EVERY", "2"),
+               ("PADDLE_TPU_SNAP_TIMEOUT", "10")):
     os.environ.setdefault(_k, _v)
 
 import jax  # noqa: E402

@@ -107,26 +107,49 @@ class TestHeartbeatLease:
 
 class TestLeaseMonitor:
     def test_dead_lease_is_poisoned_stragglers_are_not(self, master):
+        """Load-proof by construction (the old version flaked under full-
+        suite load: 0.4s TTLs + fixed sleeps meant a stalled beat thread
+        could age a LIVE lease past expiry and poison the wrong rank).
+        Live leases now carry a 30s TTL — only the lease we deliberately
+        stop can ever expire (its ttl is shrunk via the payload right
+        before the stop, since the monitor honors per-lease ttl) — and
+        every phase gates on observed store/monitor state instead of
+        sleeping a wall-clock budget."""
         poisons = []
-        h0 = HeartbeatLease(master, "hb/0", ttl=0.4, interval=0.05).start()
-        h1 = HeartbeatLease(master, "hb/1", ttl=0.4, interval=0.05).start()
-        mon = LeaseMonitor(master, 2, ttl=0.4, straggler_after=0.3,
+        h0 = HeartbeatLease(master, "hb/0", ttl=30.0, interval=0.05).start()
+        h1 = HeartbeatLease(master, "hb/1", ttl=30.0, interval=0.05).start()
+        mon = LeaseMonitor(master, 2, ttl=30.0, straggler_after=0.3,
                            poison_fn=lambda **kw: poisons.append(kw))
         h0.note_step(1)
         h1.note_step(1)
-        time.sleep(0.15)
+        t1 = time.time()  # upper bound on h1's step-stamp age start
+        assert _wait_for(  # both stamps visible in the store
+            lambda: (json.loads(master.get("hb/0")).get("step") == 1
+                     and json.loads(master.get("hb/1")).get("step") == 1))
         assert mon.scan_once() == {"dead": [], "stragglers": []}
-        # rank 1 keeps heartbeating but stops stepping → straggler, observed
-        # not poisoned; rank 0 keeps stepping
-        for i in range(2, 10):
-            h0.note_step(i)
-            time.sleep(0.08)
-        found = mon.scan_once()
-        assert found["stragglers"] == [1] and found["dead"] == []
+        # rank 1 keeps heartbeating but stops stepping → straggler,
+        # observed not poisoned; rank 0 keeps stepping.  Event-gated: step
+        # h0 inside the poll until the monitor flags exactly rank 1.
+        step = [1]
+
+        def h1_flagged_straggler():
+            step[0] += 1
+            h0.note_step(step[0])
+            if time.time() - t1 <= mon.straggler_after:
+                return False  # h1's stamp cannot be stale yet
+            found = mon.scan_once()
+            assert found["dead"] == []  # 30s ttl: nothing may die here
+            return found["stragglers"] == [1]
+
+        assert _wait_for(h1_flagged_straggler, timeout=20, interval=0.05)
         assert poisons == []
-        # rank 1's heartbeat dies entirely → dead → poisoned with culprit
+        # rank 1's heartbeat dies entirely → dead → poisoned with culprit:
+        # shrink ITS ttl (payload write confirmed in-store), then stop it
+        h1.update_payload(ttl=0.4)
+        assert _wait_for(
+            lambda: json.loads(master.get("hb/1")).get("ttl") == 0.4)
         h1.stop()
-        assert _wait_for(lambda: mon.scan_once()["dead"] == [1], timeout=5)
+        assert _wait_for(lambda: mon.scan_once()["dead"] == [1], timeout=20)
         assert poisons and poisons[0]["reason"] == "lease_expired"
         assert poisons[0]["culprit"] == 1
         # poisoning is once per dead rank, not once per scan
