@@ -21,6 +21,14 @@ renumbering, narrow enough that a new defect in a new site does not
 match.  An exemption whose ``rule`` does not equal the finding's rule
 never matches, whatever its regex.  Unused exemptions are reported so a
 fixed defect's entry gets deleted instead of rotting.
+
+Finding classes that flow through this table include the serving path:
+``serving.engine.check_decode_donation`` lints the compiled decode
+program (report name ``serving_decode``) with the ``donation`` rule, so
+its findings are exemptable here like any training step's.  The gate's
+own KV-arena alias check (aliased bytes must cover the page arenas) is
+deliberately NOT baselinable — it raises regardless of exemptions,
+because an unaliased serving cache re-copies itself every decode step.
 """
 
 from __future__ import annotations

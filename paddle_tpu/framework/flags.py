@@ -185,6 +185,16 @@ define_flag("flash_block_q", 512,
             "sweeps higher values at 8K.")
 define_flag("flash_block_k", 512,
             "Pallas flash attention key-block rows (see flash_block_q).")
+define_flag("use_decode_attention", True,
+            "Dispatch single-token KV-cache decode attention to the fused "
+            "Pallas kernel with the aliased in-place cache append "
+            "(reference: masked_multihead_attention_kernel.cu). Off falls "
+            "back to the grouped-einsum path, which copies the full cache "
+            "every scan step.")
+define_flag("decode_block_k", 256,
+            "Pallas decode-attention cache-block rows; the dispatcher uses "
+            "the largest sublane-aligned divisor of the cache length up to "
+            "this value.")
 define_flag("use_fused_layernorm", False,
             "Dispatch residual-add+LayerNorm to the fused Pallas kernel on "
             "TPU (reference: fused_layernorm_kernel.cu surface). Default "

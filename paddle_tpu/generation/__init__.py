@@ -51,9 +51,39 @@ def cached_attention(q, k_new, v_new, cache_k, cache_v, pos, pad_lens=None):
     Match: masked_multihead_attention_kernel.cu:1 (the decode s=1 case) —
     one fused cache-update + attention, no [C, C] matrix, no dynamic shape.
     """
+    from ..ops import pallas_mode
+
     b, s, h, d = q.shape
     kv = k_new.shape[2]
     C = cache_k.shape[1]
+    if s == 1:
+        # DECODE fast path: the fused Pallas kernel appends k/v via an
+        # input_output-ALIASED single-block write, so the compiled scan
+        # keeps the cache in place instead of copying all C slots every
+        # step (the 0.576-MBU-at-8K ceiling, BENCH_r05).
+        mode = pallas_mode("use_decode_attention")
+        if mode is not None:
+            kind, _mesh, interp = mode
+            from ..framework.flags import get_flags
+            from ..ops.pallas import (decode_attention,
+                                      decode_attention_supported)
+            from ..ops.sharded import _auto_block
+            from ..telemetry import kernel_fallback
+
+            blk = _auto_block(
+                C, int(get_flags("decode_block_k")["decode_block_k"]))
+            if kind != "local":
+                # multi-chip decode composes through the sharded einsum
+                # path; the shard-local kernel wrapper is future work
+                kernel_fallback("decode_attention", "mesh", cache_len=C)
+            elif blk is not None and decode_attention_supported(
+                    q.shape, cache_k.shape, block_k=blk):
+                return decode_attention(q, k_new, v_new, cache_k, cache_v,
+                                        pos, pad_lens, block_k=blk,
+                                        interpret=interp)
+            else:
+                kernel_fallback("decode_attention", "shape",
+                                q_shape=list(q.shape), cache_len=C)
     cache_k = jax.lax.dynamic_update_slice_in_dim(
         cache_k, k_new.astype(cache_k.dtype), pos, 1)
     cache_v = jax.lax.dynamic_update_slice_in_dim(
@@ -65,13 +95,38 @@ def cached_attention(q, k_new, v_new, cache_k, cache_v, pos, pad_lens=None):
         # (at an 8K prompt that matrix is the exact blow-up the reference
         # built masked_multihead/flash kernels to avoid).  The dense
         # masked path below stays for decode steps (s small, prefix
-        # large) and padded prefills (flash takes no mask).
+        # large).
         from ..nn.functional import scaled_dot_product_attention
         from ..tensor.tensor import Tensor as _T
 
         out = scaled_dot_product_attention(_T(q), _T(k_new), _T(v_new),
                                            is_causal=True, training=False)
         return out._value.astype(q.dtype), cache_k, cache_v
+    if s > 1 and pad_lens is not None and isinstance(pos, int) and pos == 0:
+        # LEFT-PADDED bucketed prefill: the varlen flash kernel carries the
+        # per-row valid-length mask in its online-softmax loop, so ragged
+        # serving prefill no longer falls back to the dense [s, C] einsum
+        mode = pallas_mode("use_flash_attention")
+        if mode is not None:
+            kind, _mesh, interp = mode
+            from ..framework.flags import get_flags
+            from ..ops.pallas import (flash_attention_varlen,
+                                      flash_attention_varlen_supported)
+            from ..ops.sharded import _auto_block
+            from ..telemetry import kernel_fallback
+
+            bq = _auto_block(s, int(get_flags("flash_block_q")["flash_block_q"]))
+            bk = _auto_block(s, int(get_flags("flash_block_k")["flash_block_k"]))
+            if kind == "local" and bq is not None and bk is not None and \
+                    flash_attention_varlen_supported(
+                        q.shape, k_new.shape, block_q=bq, block_k=bk):
+                out = flash_attention_varlen(q, k_new, v_new, pad_lens,
+                                             causal=True, block_q=bq,
+                                             block_k=bk, interpret=interp)
+                return out.astype(q.dtype), cache_k, cache_v
+            kernel_fallback("flash_attention_varlen",
+                            "mesh" if kind != "local" else "shape",
+                            q_shape=list(q.shape))
     # decode attention as a grouped-head einsum in the CACHE dtype with
     # fp32 ACCUMULATION (preferred_element_type), never casting the cache:
     # an .astype(f32) materializes a second full-cache copy — measured on
@@ -127,6 +182,21 @@ class GenerationMixin:
         kv = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
         return cfg.num_hidden_layers, kv, cfg.head_dim
 
+    @staticmethod
+    def _kernel_flags_key():
+        """Kernel dispatch state that changes what a generate program
+        TRACES: it must be part of the compile-cache key, or flipping a
+        flag after the first compile silently reuses the stale program
+        (and a kernel-vs-einsum parity test compares a program to
+        itself)."""
+        from ..framework.flags import get_flags
+
+        names = ("use_decode_attention", "decode_block_k",
+                 "use_flash_attention", "flash_block_q", "flash_block_k",
+                 "pallas_interpret")
+        f = get_flags(list(names))
+        return tuple(f[n] for n in names)
+
     def _cached_program(self, sig, build):
         """LRU-bounded compile cache (``generate_cache_size`` flag): every
         distinct signature compiles one program; a serving process must not
@@ -138,6 +208,7 @@ class GenerationMixin:
         from ..framework.flags import get_flags
 
         cache = self.__dict__.setdefault("_generate_cache", OrderedDict())
+        sig = sig + (self._kernel_flags_key(),)
         if sig in cache:
             cache.move_to_end(sig)
             return cache[sig]
@@ -318,7 +389,10 @@ class GenerationMixin:
         params = [p for _, p in self.named_parameters()]
         buffers = [bf for _, bf in self.named_buffers()]
         n_layers, kv_heads, head_dim = self._kv_cache_spec()
-        total = prompt + max_new
+        # cache capacity rounds up to a sublane multiple so the Pallas
+        # decode kernel tiles it for ANY (prompt, max_new); the extra
+        # slots stay masked (col <= pos) and contribute exact zeros
+        total = -(-(prompt + max_new) // 8) * 8
         model = self
 
         def sample_tok(logits, key, seen=None, step=0):
@@ -436,7 +510,7 @@ class GenerationMixin:
         params = [p for _, p in self.named_parameters()]
         buffers = [bf for _, bf in self.named_buffers()]
         n_layers, kv_heads, head_dim = self._kv_cache_spec()
-        total = prompt + max_new
+        total = -(-(prompt + max_new) // 8) * 8  # sublane-aligned capacity
         K = int(num_beams)
         model = self
 

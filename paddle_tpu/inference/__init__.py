@@ -152,8 +152,10 @@ class Predictor:
         attention-mask machinery makes every row decode exactly as if
         unpadded), pad partial batches up to ``max_batch`` rows, and run
         each group through ONE compiled program per (bucket, max_batch)
-        signature.  The model's LRU program cache (``generate_cache_size``
-        flag) bounds retention.
+        signature.  Under-full chunks MERGE upward into the next bucket
+        (their rows just left-pad further), so a trace of many distinct
+        lengths never runs a batch-of-1 program per length.  The model's
+        LRU program cache (``generate_cache_size`` flag) bounds retention.
 
         ``prompts``: list of 1-D int sequences (python lists / numpy
         arrays of varying length).  Returns a list of per-prompt
@@ -184,25 +186,44 @@ class Predictor:
                 blen = max(min(blen, cap - max_new), len(a))
             buckets.setdefault(blen, []).append(i)
         results: dict = {}
-        for blen, idxs in sorted(buckets.items()):
-            for c0 in range(0, len(idxs), max_batch):
-                chunk = idxs[c0:c0 + max_batch]
-                rows, mask = [], []
-                for i in chunk:
-                    a = arrs[i]
-                    rows.append(np.concatenate(
-                        [np.zeros(blen - len(a), np.int32), a]))
-                    mask.append(np.concatenate(
-                        [np.zeros(blen - len(a), np.int32),
-                         np.ones(len(a), np.int32)]))
-                while len(rows) < max_batch:  # dummy rows share the program
-                    rows.append(rows[0])
-                    mask.append(mask[0])
-                ids, scores = gen(np.stack(rows),
-                                  attention_mask=np.stack(mask), **kwargs)
-                ids, scores = np.asarray(ids.numpy()), np.asarray(scores.numpy())
-                for r, i in enumerate(chunk):
-                    results[i] = (ids[r], scores[r])
+
+        def dispatch(chunk, blen):
+            rows, mask = [], []
+            for i in chunk:
+                a = arrs[i]
+                rows.append(np.concatenate(
+                    [np.zeros(blen - len(a), np.int32), a]))
+                mask.append(np.concatenate(
+                    [np.zeros(blen - len(a), np.int32),
+                     np.ones(len(a), np.int32)]))
+            while len(rows) < max_batch:  # dummy rows share the program
+                rows.append(rows[0])
+                mask.append(mask[0])
+            ids, scores = gen(np.stack(rows),
+                              attention_mask=np.stack(mask), **kwargs)
+            ids, scores = np.asarray(ids.numpy()), np.asarray(scores.numpy())
+            for r, i in enumerate(chunk):
+                results[i] = (ids[r], scores[r])
+
+        # merge adjacent under-full buckets: an under-full chunk rides up
+        # into the next bucket (its rows just left-pad further — the
+        # pad-exactness machinery keeps outputs row-identical), so a trace
+        # of many distinct lengths runs full-batch programs instead of a
+        # batch-of-1 program per bucket
+        # (merging can never drag a row past the position budget: a bucket
+        # whose blen was floored at a long prompt's length necessarily has
+        # len(a) + max_new > max_position_embeddings, which generate()
+        # rejects loudly for the whole trace before any row dispatches)
+        order = sorted(buckets)
+        pending: list = []
+        for j, blen in enumerate(order):
+            pending.extend(buckets[blen])
+            while len(pending) >= max_batch:
+                dispatch(pending[:max_batch], blen)
+                pending = pending[max_batch:]
+            if pending and j + 1 == len(order):
+                dispatch(pending, blen)
+                pending = []
         return [results[i] for i in range(len(arrs))]
 
     def __init__(self, config: Config):

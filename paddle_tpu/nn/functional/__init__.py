@@ -1064,6 +1064,15 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
                                            interpret=interp)
 
                 return apply_op("flash_attn", flash_fn, tensors)
+        # the Pallas path was enabled but the gate rejected this call —
+        # narrate it (silent dense-einsum fallbacks are how the 8K decode
+        # regression hid); gates run at trace time, so once per signature
+        from ...telemetry import kernel_fallback
+
+        reason = ("mask" if mask_val is not None
+                  else "dropout" if p > 0.0 else "shape")
+        kernel_fallback("flash_attention", reason,
+                        q_shape=list(query.shape), k_shape=list(key.shape))
 
     def fn(q, k, v):
         return sdpa_reference(q, k, v, mask=mask_val, is_causal=is_causal,

@@ -64,6 +64,19 @@ def _bcast_lanes(col):
     return jnp.broadcast_to(col, (col.shape[0], _LANES))
 
 
+def flash_attention_varlen_supported(q_shape, k_shape, *,
+                                     block_q: int = DEFAULT_BLOCK_Q,
+                                     block_k: int = DEFAULT_BLOCK_K) -> bool:
+    """Gate for the left-padded (per-row valid-length) forward: the varlen
+    path is causal square prefill over a left-padded batch — sq == sk, both
+    tile-divisible.  Backward is not implemented (serving prefill runs under
+    ``no_grad``), so training callers must not route masked calls here."""
+    b, sq, hq, d = q_shape
+    _, sk, hkv, _ = k_shape
+    return (sq == sk and sq % block_q == 0 and sk % block_k == 0
+            and d % 8 == 0 and d <= 256 and hq % hkv == 0)
+
+
 # Causal masking uses bottom-right alignment (FA2 convention, matching
 # `sdpa_reference`'s tril(k=sk-sq)): q row i attends to k cols <= i + sk - sq.
 def _causal_live(iq, ik, block_q, block_k, offset):
@@ -82,9 +95,17 @@ def _causal_mask(s, iq, ik, block_q, block_k, offset):
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref, *, scale: float, causal: bool,
-                block_q: int, block_k: int, offset: int):
+def _fwd_kernel(*refs, scale: float, causal: bool,
+                block_q: int, block_k: int, offset: int, padded: bool):
+    # with ``padded`` a per-row valid-length scalar rides in SMEM ahead of
+    # the tensor operands (varlen serving prefill; left-pad convention)
+    if padded:
+        (pad_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+         acc_ref, m_ref, l_ref) = refs
+    else:
+        pad_ref = None
+        (q_ref, k_ref, v_ref, o_ref, lse_ref,
+         acc_ref, m_ref, l_ref) = refs
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -95,6 +116,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
 
     live = _causal_live(iq, ik, block_q, block_k, offset) if causal else True
+    if padded:
+        # blocks entirely left of the row's first valid key are dead
+        live = jnp.logical_and(live, (ik + 1) * block_k > pad_ref[0])
 
     @pl.when(live)
     def _step():
@@ -105,12 +129,23 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                                 preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask(s, iq, ik, block_q, block_k, offset)
+        if padded:
+            k_pos = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos >= pad_ref[0], s, _NEG_INF)
         m_prev = m_ref[:, :1]                      # (Bq, 1)
         l_prev = l_ref[:, :1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)                     # (Bq, Bk) f32
-        alpha = jnp.exp(m_prev - m_new)
+        # a row with every score masked so far keeps m == -inf, and
+        # exp(-inf - -inf) is NaN — NaN that later poisons VALID rows
+        # downstream (0 * NaN in the next layer's dot).  Happens for query
+        # rows inside the left-padding (padded) and empty causal rows
+        # (sq > sk); a finite reference point collapses p/alpha to exact
+        # zeros so the row finalizes through the l == 0 guard to zeros.
+        m_ok = jnp.where(m_new == _NEG_INF, 0.0, m_new)
+        p = jnp.exp(s - m_ok)                      # (Bq, Bk) f32
+        alpha = jnp.exp(m_prev - m_ok)
         l_ref[:] = _bcast_lanes(l_prev * alpha + jnp.sum(p, axis=1, keepdims=True))
         m_ref[:] = _bcast_lanes(m_new)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
@@ -126,19 +161,27 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0] = _bcast_lanes(m_ref[:, :1] + jnp.log(l))
 
 
-def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
+def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret,
+         pad_lens=None):
     """q [b, hq, sq, d]; k/v [b, hkv, sk, d] → out [b, hq, sq, d],
-    lse [b, hq, sq, 128] (value broadcast along the minor dim)."""
+    lse [b, hq, sq, 128] (value broadcast along the minor dim).
+    ``pad_lens`` [b] int32: per-row LEFT-padding — keys below it masked."""
     b, hq, sq, d = q.shape
     _, hkv, sk, _ = k.shape
     rep = hq // hkv
     grid = (b, hq, sq // block_q, sk // block_k)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k, offset=sk - sq)
+                               block_q=block_q, block_k=block_k,
+                               offset=sk - sq, padded=pad_lens is not None)
+    pad_specs = [] if pad_lens is None else [
+        pl.BlockSpec((1,), lambda ib, ih, iq, ik: (ib,),
+                     memory_space=pltpu.SMEM)]
+    pad_args = [] if pad_lens is None else [
+        jnp.asarray(pad_lens, jnp.int32).reshape(b)]
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
+        in_specs=pad_specs + [
             pl.BlockSpec((1, 1, block_q, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
             pl.BlockSpec((1, 1, block_k, d),
                          lambda ib, ih, iq, ik: (ib, ih // rep, ik, 0)),
@@ -166,7 +209,7 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
             bytes_accessed=(b * sq * hq * d + 2 * b * sk * hkv * d) * q.dtype.itemsize,
             transcendentals=b * hq * sq * sk),
         interpret=interpret,
-    )(q, k, v)
+    )(*pad_args, q, k, v)
     return out, lse
 
 
@@ -361,6 +404,26 @@ def flash_attention(q, k, v, scale: Optional[float] = None, causal: bool = False
     """q [b, sq, hq, d]; k/v [b, sk, hkv, d] (GQA: hkv | hq) → [b, sq, hq, d]."""
     out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
     return out
+
+
+def flash_attention_varlen(q, k, v, pad_lens, scale: Optional[float] = None,
+                           causal: bool = True,
+                           block_q: int = DEFAULT_BLOCK_Q,
+                           block_k: int = DEFAULT_BLOCK_K,
+                           interpret: bool = False):
+    """Left-padded prefill attention: row ``b`` attends keys in
+    ``[pad_lens[b], i]`` (causal, bottom-right aligned).  q [b, s, hq, d];
+    k/v [b, s, hkv, d]; ``pad_lens`` [b] int32 counts LEFT padding per row.
+    Rows whose query position lies inside the padding have no valid keys
+    and produce zeros (their outputs are never consumed — their own keys
+    are masked for every later query).  FORWARD ONLY (``no_grad`` serving
+    prefill); the trainable path keeps the unmasked ``flash_attention``."""
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    out, _ = _fwd(_to_internal(q), _to_internal(k), _to_internal(v),
+                  scale=s, causal=causal, block_q=block_q, block_k=block_k,
+                  interpret=interpret, pad_lens=pad_lens)
+    return _from_internal(out)
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):

@@ -1,0 +1,29 @@
+"""paddle_tpu.serving — production inference: paged KV pool + continuous
+batching over the decode kernels.
+
+The serving half of the reference's fusion set rebuilt TPU-native
+(`masked_multihead_attention_kernel.cu` → the Pallas decode kernel with the
+aliased in-place cache append, `block_multi_head_attention_kernel.cu` →
+:class:`PagedKVPool` page arenas, the `fused_multi_transformer` loop →
+:class:`ServingEngine`'s two compiled programs), plus the production
+surface: per-request SLO metrics (:class:`SLOMeter`: TTFT, TPOT, p50/p99
+latency, queue depth, KV-pool occupancy) through telemetry, and a donation
+lint gate (:func:`check_decode_donation`) proving the compiled decode
+program updates its cache in place.
+
+    engine = ServingEngine(model, max_batch=8)
+    rid = engine.submit(prompt_ids, max_new_tokens=64, eos_token_id=2)
+    outputs = engine.run()          # {rid: generated token array}
+    engine.meter.summary()          # ttft_ms_p99, tpot_ms_p99, ...
+"""
+
+from .kv_pool import PagedKVPool, PoolExhausted, TRASH_PAGE, \
+    default_page_tokens  # noqa: F401
+from .metrics import RequestClock, SLOMeter  # noqa: F401
+from .engine import Request, ServingEngine, check_decode_donation  # noqa: F401
+
+__all__ = [
+    "PagedKVPool", "PoolExhausted", "TRASH_PAGE", "default_page_tokens",
+    "RequestClock", "SLOMeter",
+    "Request", "ServingEngine", "check_decode_donation",
+]

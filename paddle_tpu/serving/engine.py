@@ -1,0 +1,503 @@
+"""Continuous-batching serving engine over a paged KV pool.
+
+Reference capability: the serving half of the fusion set —
+`masked_multihead_attention_kernel.cu` (single-token cached attention, here
+the Pallas decode kernel / grouped einsum), the paged
+`block_multi_head_attention_kernel.cu` cache (here the page arenas +
+:class:`PagedKVPool` tables) and the `fused_multi_transformer` serving loop
+(here TWO compiled XLA programs reused across the whole request stream).
+
+Design (TPU-first: *nothing* recompiles as traffic changes shape):
+
+- **Physical cache** — per layer, ``k_pages``/``v_pages`` arenas of shape
+  ``[num_pages, page_tokens, kv_heads, head_dim]``.  Both compiled
+  programs take the arenas DONATED, update them with scatter-writes, and
+  return them; XLA aliases the buffers so the cache never copies (the
+  donation lint below enforces exactly this).
+- **One decode program** per ``(max_batch, pages_per_seq)`` signature:
+  every active request is a row; a row's block table gathers its pages
+  into a ``[rows, pages_per_seq * page_tokens, kv, d]`` view, masked by
+  the row's position.  Idle rows point at the reserved trash page, so
+  admit/finish/evict never changes the compiled shape.
+- **One prefill program**: prompts stream through in fixed
+  ``page_tokens``-sized chunks (each chunk fills exactly one page), so
+  ragged prompt lengths share a single compiled signature instead of one
+  per length; junk tail slots of the last chunk are overwritten by the
+  first decode steps before the position mask ever exposes them.
+- **Scheduler** — FIFO admission gated on free page count, eviction under
+  pool pressure (youngest-admitted victim; the evictee requeues at the
+  front and recomputes from its prompt — deterministic greedy decode makes
+  the replay byte-identical), per-request SLO milestones through
+  :class:`SLOMeter` and the flight recorder.
+
+Env knobs: ``PADDLE_TPU_SERVE_MAX_BATCH`` (decode rows, default 4),
+``PADDLE_TPU_PAGE_TOKENS`` (page size, default 16),
+``PADDLE_TPU_SERVE_PAGES`` (arena pages incl. trash page, default 64),
+``PADDLE_TPU_SERVE_MAX_PAGES_PER_SEQ`` (per-request budget, default 8),
+``PADDLE_TPU_SERVE_LINT`` (=0 skips the decode-program donation gate).
+"""
+
+from __future__ import annotations
+
+import os
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..distributed.checkpoint.replicator import env_int as _env_int
+from .kv_pool import PagedKVPool, PoolExhausted, TRASH_PAGE, \
+    default_page_tokens
+from .metrics import SLOMeter
+
+__all__ = ["Request", "ServingEngine", "check_decode_donation"]
+
+QUEUED, RUNNING, FINISHED = "queued", "running", "finished"
+
+
+class Request:
+    """One generation request riding the engine."""
+
+    _next_rid = 0
+
+    def __init__(self, prompt, max_new_tokens: int,
+                 eos_token_id: Optional[int]):
+        self.rid = Request._next_rid
+        Request._next_rid += 1
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if self.prompt.size == 0:
+            raise ValueError("empty prompt")
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.eos_token_id = None if eos_token_id is None else int(eos_token_id)
+        self.state = QUEUED
+        self.generated: List[int] = []
+        self.row: Optional[int] = None
+        self.evictions = 0
+
+    @property
+    def pos(self) -> int:
+        """Cache position the NEXT decode step writes (the position of the
+        last generated token)."""
+        return len(self.prompt) + len(self.generated) - 1
+
+    def done(self) -> bool:
+        return len(self.generated) >= self.max_new_tokens or (
+            self.eos_token_id is not None and bool(self.generated)
+            and self.generated[-1] == self.eos_token_id)
+
+
+def check_decode_donation(compiled, arena_bytes: int, name: str = "serving_decode"):
+    """Shardlint gate for the serving path: run the ``donation`` rule over
+    the compiled decode program and additionally require the KV arenas to
+    be ALIASED (donated in, updated in place) — an unaliased arena means
+    the program copies the whole cache every step, the exact defect the
+    subsystem exists to delete.  Returns the :class:`LintReport`; raises
+    ``RuntimeError`` when the arenas are not aliased or an unexempted
+    donation error fires."""
+    from ..analysis import lint
+
+    report = lint(compiled, rules=["donation"], name=name)
+    mem = None
+    try:
+        ma = compiled.memory_analysis()
+        mem = {"alias_bytes": int(ma.alias_size_in_bytes),
+               "argument_bytes": int(ma.argument_size_in_bytes)}
+    except Exception:
+        pass
+    if mem is not None and mem["alias_bytes"] < arena_bytes:
+        raise RuntimeError(
+            f"serving decode program does not alias its KV arenas: "
+            f"{mem['alias_bytes']} bytes aliased < {arena_bytes} arena "
+            f"bytes — the cache is being copied every step (donation "
+            f"dropped; check donate_argnums and that arena shapes/dtypes "
+            f"are unchanged between input and output)")
+    if not report.ok:
+        raise RuntimeError(
+            "serving decode program failed the donation lint:\n" +
+            "\n".join(f.format() for f in report.failures()))
+    return report
+
+
+class ServingEngine:
+    """Continuous batching over a causal-LM with llama-family structure
+    (``model.llama.layers`` / ``embed_tokens`` / ``norm`` / rope buffers;
+    the flagship serving target).  Greedy decoding — determinism is what
+    makes eviction-replay byte-exact."""
+
+    def __init__(self, model, *, max_batch: Optional[int] = None,
+                 page_tokens: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 max_pages_per_seq: Optional[int] = None,
+                 lint: Optional[bool] = None):
+        import jax.numpy as jnp
+
+        base = getattr(model, "llama", None)
+        if base is None or not hasattr(base, "layers"):
+            raise TypeError(
+                "ServingEngine serves llama-family causal LMs "
+                "(model.llama.layers); got " + type(model).__name__)
+        self.model = model
+        self.max_batch = max_batch if max_batch is not None else \
+            _env_int("PADDLE_TPU_SERVE_MAX_BATCH", 4)
+        P = page_tokens if page_tokens is not None else default_page_tokens()
+        N = num_pages if num_pages is not None else \
+            _env_int("PADDLE_TPU_SERVE_PAGES", 64)
+        MP = max_pages_per_seq if max_pages_per_seq is not None else \
+            _env_int("PADDLE_TPU_SERVE_MAX_PAGES_PER_SEQ", 8)
+        max_pos = model.config.max_position_embeddings
+        if MP * P > max_pos:
+            MP = max(1, max_pos // P)
+        self.page_tokens, self.num_pages, self.max_pages_per_seq = P, N, MP
+        self.pool = PagedKVPool(N, P)
+        self.meter = SLOMeter()
+        self._lint = (os.environ.get("PADDLE_TPU_SERVE_LINT", "1") != "0"
+                      if lint is None else bool(lint))
+
+        self._params = [p for _, p in model.named_parameters()]
+        self._buffers = [b for _, b in model.named_buffers()]
+        cdt = next((p._value.dtype for p in self._params
+                    if jnp.issubdtype(p._value.dtype, jnp.floating)),
+                   jnp.float32)
+        n_layers, kv_heads, head_dim = model._kv_cache_spec()
+        self._arena_shape = (N, P, kv_heads, head_dim)
+        self._ks = [jnp.zeros(self._arena_shape, cdt) for _ in range(n_layers)]
+        self._vs = [jnp.zeros(self._arena_shape, cdt) for _ in range(n_layers)]
+        self._arena_bytes = 2 * n_layers * int(np.prod(self._arena_shape)) \
+            * self._ks[0].dtype.itemsize
+
+        self._queue: deque = deque()
+        self._active: Dict[int, Request] = {}          # row -> Request
+        self._results: Dict[int, np.ndarray] = {}
+        self._decode_exec = None
+        self._prefill_exec = None
+        self._decode_compiles = 0
+        self.lint_report = None
+
+    # -- public API --------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 64,
+               eos_token_id: Optional[int] = None) -> int:
+        r = Request(prompt, max_new_tokens, eos_token_id)
+        budget = self.max_pages_per_seq * self.page_tokens
+        if len(r.prompt) + r.max_new_tokens > budget:
+            raise ValueError(
+                f"prompt ({len(r.prompt)}) + max_new_tokens "
+                f"({r.max_new_tokens}) exceeds the per-request page budget "
+                f"{budget} (= {self.max_pages_per_seq} pages x "
+                f"{self.page_tokens} tokens)")
+        need_max = self.pool.pages_for(len(r.prompt) + r.max_new_tokens)
+        if need_max > self.pool.capacity:
+            # an unservable request must be rejected HERE: admitted, it
+            # would either block the FIFO head forever (never enough free
+            # pages) or evict everyone and still starve mid-decode,
+            # crashing run() and discarding other requests' work
+            raise ValueError(
+                f"request needs up to {need_max} pages but the pool only "
+                f"has {self.pool.capacity} — raise PADDLE_TPU_SERVE_PAGES "
+                f"or lower max_new_tokens")
+        self._queue.append(r)
+        self.meter.submit(r.rid)
+        self.meter.set_queue_depth(len(self._queue))
+        return r.rid
+
+    def run(self, max_steps: int = 100000) -> Dict[int, np.ndarray]:
+        """Drive the scheduler until every submitted request finishes;
+        returns {rid: generated token array}.  Verifies the pool quiesced
+        with zero leaked pages."""
+        steps = 0
+        while self._queue or self._active:
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(f"serving loop did not quiesce in "
+                                   f"{max_steps} steps")
+        self.pool.check_leaks()
+        return dict(self._results)
+
+    def step(self) -> None:
+        """One scheduler iteration: admit what fits, prefill the newly
+        admitted, take one decode step for every active row, retire
+        finished rows."""
+        self._admit()
+        for r in [r for r in self._active.values() if not r.generated]:
+            self._prefill(r)
+            self._retire_if_done(r)
+        if self._active:
+            self._decode_step()
+        self.meter.set_queue_depth(len(self._queue))
+        self.meter.set_occupancy(self.pool.occupancy())
+
+    # -- scheduling --------------------------------------------------------
+    def _free_rows(self) -> List[int]:
+        return [i for i in range(self.max_batch) if i not in self._active]
+
+    def _admit(self) -> None:
+        rows = self._free_rows()
+        while self._queue and rows:
+            r = self._queue[0]
+            need = self.pool.pages_for(len(r.prompt) + 1)
+            if not self.pool.can_alloc(need):
+                break
+            self._queue.popleft()
+            self.pool.alloc(r.rid, need)
+            r.row = rows.pop(0)
+            r.state = RUNNING
+            self._active[r.row] = r
+            self.meter.admit(r.rid, queue_depth=len(self._queue), pages=need)
+            self.meter.set_occupancy(self.pool.occupancy())
+
+    def _evict(self, victim: Request) -> None:
+        """Preempt ``victim``: free its pages, requeue it at the front; the
+        deterministic greedy replay regenerates the same tokens."""
+        freed = self.pool.free(victim.rid)
+        del self._active[victim.row]
+        victim.row = None
+        victim.state = QUEUED
+        victim.generated = []        # replayed from the prompt on re-admit
+        victim.evictions += 1
+        self._queue.appendleft(victim)
+        self.meter.evict(victim.rid, reason="pool_pressure",
+                         pages_freed=freed)
+
+    def _ensure_page(self, r: Request) -> bool:
+        """Make sure the page holding ``r.pos`` exists.  Under pool
+        pressure the YOUNGEST-admitted active request is preempted — older
+        requests' accumulated decode progress is worth more; when ``r``
+        itself is the youngest it self-preempts (returns False) and waits
+        in the queue for pages to free up."""
+        need = r.pos // self.page_tokens + 1
+        while len(self.pool.table(r.rid)) < need:
+            if self.pool.can_alloc(1):
+                self.pool.alloc(r.rid, 1)
+                continue
+            live = [x for x in self._active.values() if x.state == RUNNING]
+            if live == [r]:  # r alone owns the pool and still starves:
+                # no amount of preemption can ever satisfy it
+                raise PoolExhausted(
+                    f"request {r.rid} needs page {need} but the pool is "
+                    f"exhausted — raise PADDLE_TPU_SERVE_PAGES or lower "
+                    f"the per-request budget")
+            victim = max(live,
+                         key=lambda x: self.meter.clock(x.rid).admit_t or 0.0)
+            self._evict(victim)
+            if victim is r:
+                return False
+        return True
+
+    def _retire_if_done(self, r: Request) -> None:
+        if not r.done():
+            return
+        freed = self.pool.free(r.rid)
+        del self._active[r.row]
+        r.row = None
+        r.state = FINISHED
+        self._results[r.rid] = np.asarray(r.generated, np.int32)
+        self.meter.finish(r.rid, n_tokens=len(r.generated))
+        self.meter.set_occupancy(self.pool.occupancy())
+        del freed
+
+    # -- compiled programs -------------------------------------------------
+    def _padded_table(self, rid) -> np.ndarray:
+        t = np.full((self.max_pages_per_seq,), TRASH_PAGE, np.int32)
+        pages = self.pool.table(rid)
+        t[:len(pages)] = pages
+        return t
+
+    def _prefill(self, r: Request) -> None:
+        import jax.numpy as jnp
+
+        P = self.page_tokens
+        prompt = r.prompt
+        n_chunks = -(-len(prompt) // P)
+        table = jnp.asarray(self._padded_table(r.rid)[None])
+        logits = None
+        for c in range(n_chunks):
+            chunk = np.zeros((1, P), np.int32)
+            part = prompt[c * P:(c + 1) * P]
+            chunk[0, :len(part)] = part
+            take = (len(prompt) - 1 - c * P) if c == n_chunks - 1 else 0
+            out = self._run_prefill(
+                jnp.asarray(chunk), jnp.int32(c * P), table,
+                jnp.int32(max(take, 0)))
+            logits = out
+        tok = int(np.argmax(np.asarray(logits)))
+        r.generated.append(tok)
+        self.meter.first_token(r.rid)
+
+    def _decode_step(self) -> None:
+        import jax.numpy as jnp
+
+        R, MP = self.max_batch, self.max_pages_per_seq
+        tokens = np.zeros((R,), np.int32)
+        positions = np.zeros((R,), np.int32)
+        tables = np.full((R, MP), TRASH_PAGE, np.int32)
+        stepped: List[Request] = []
+        for r in [self._active[row] for row in sorted(self._active)]:
+            # _ensure_page can evict LATER snapshot entries; skip anything
+            # no longer running so an evictee never allocates while queued
+            if r.state != RUNNING or r.row is None or r.done():
+                continue
+            self._ensure_page(r)
+        # _ensure_page may have evicted rows; rebuild the live view
+        for row, r in sorted(self._active.items()):
+            if r.done():
+                continue
+            tokens[row] = r.generated[-1]
+            positions[row] = r.pos
+            tables[row] = self._padded_table(r.rid)
+            stepped.append(r)
+        if not stepped:
+            for r in list(self._active.values()):
+                self._retire_if_done(r)
+            return
+        logits = self._run_decode(jnp.asarray(tokens),
+                                  jnp.asarray(positions),
+                                  jnp.asarray(tables))
+        logits = np.asarray(logits)
+        for r in stepped:
+            tok = int(np.argmax(logits[r.row]))
+            r.generated.append(tok)
+            self.meter.token(r.rid)
+        for r in list(self._active.values()):
+            self._retire_if_done(r)
+
+    # -- traced functions --------------------------------------------------
+    def _paged_attention(self, q, k_new, v_new, kp, vp, tables, positions):
+        """Scatter this step's k/v into the page arenas and attend each row
+        over its gathered pages.  Mirrors ``generation.cached_attention``'s
+        grouped einsum (cache dtype multiplies, f32 accumulation, no cache
+        cast) so outputs are bit-identical to the contiguous-cache path —
+        junk cols (trash page, unwritten slots) mask to exact zeros."""
+        import jax.numpy as jnp
+
+        R, s, h, d = q.shape
+        kv = k_new.shape[2]
+        P = self.page_tokens
+        MP = tables.shape[1]
+        rows = jnp.arange(R)
+        if s == 1:
+            page = tables[rows, positions // P]
+            slot = positions % P
+            kp = kp.at[page, slot].set(k_new[:, 0].astype(kp.dtype))
+            vp = vp.at[page, slot].set(v_new[:, 0].astype(vp.dtype))
+        else:
+            # prefill chunk: R == 1, the chunk fills exactly one page
+            page = tables[0, positions[0] // P]
+            kp = kp.at[page].set(k_new[0].astype(kp.dtype))
+            vp = vp.at[page].set(v_new[0].astype(vp.dtype))
+        C = MP * P
+        kk = kp[tables].reshape(R, C, kv, d)
+        vv = vp[tables].reshape(R, C, kv, d)
+        g = h // kv
+        q5 = q.reshape(R, s, kv, g, d).astype(kk.dtype)
+        scores = jnp.einsum("bskgd,bckd->bkgsc", q5, kk,
+                            preferred_element_type=jnp.float32) \
+            / jnp.sqrt(float(d))
+        col = jnp.arange(C)[None, None, None, None, :]
+        row_pos = (positions[:, None] + jnp.arange(s)[None, :]) \
+            [:, None, None, :, None]
+        scores = jnp.where(col <= row_pos, scores,
+                           jnp.finfo(jnp.float32).min)
+        import jax
+
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bkgsc,bckd->bskgd", probs.astype(vv.dtype), vv,
+                         preferred_element_type=jnp.float32)
+        return out.reshape(R, s, h, d).astype(q.dtype), kp, vp
+
+    def _forward(self, param_arrays, buffer_arrays, ks, vs, tokens,
+                 positions, tables):
+        """Shared transformer step for both programs.  ``tokens`` [R, s]
+        (decode: s=1; prefill: R=1, s=page_tokens); ``positions`` [R]
+        absolute position of each row's first token."""
+        import jax.numpy as jnp
+
+        from ..autograd import no_grad
+        from ..jit import _StateSwap
+        from ..models.llama import rotate_half_apply
+        from ..nn import functional as F
+        from ..tensor.manipulation import reshape
+        from ..tensor.tensor import Tensor
+
+        model = self.model
+        with _StateSwap(self._params, param_arrays), \
+                _StateSwap(self._buffers, buffer_arrays), no_grad():
+            base = model.llama
+            R, s = tokens.shape
+            cfg = model.config
+            h, kvh, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                         cfg.head_dim)
+            cos = base.rope_cos._value
+            sin = base.rope_sin._value
+            pos_ids = jnp.clip(positions[:, None] + jnp.arange(s)[None, :],
+                               0, cos.shape[0] - 1)          # [R, s]
+            cos_s = jnp.take(cos, pos_ids, axis=0)[:, :, None, :]
+            sin_s = jnp.take(sin, pos_ids, axis=0)[:, :, None, :]
+            x = base.embed_tokens(Tensor(tokens))
+            new_ks, new_vs = [], []
+            for li, layer in enumerate(base.layers):
+                xin = layer.input_layernorm(x)
+                q = reshape(layer.self_attn.q_proj(xin), [R, s, h, d])
+                k = reshape(layer.self_attn.k_proj(xin), [R, s, kvh, d])
+                v = reshape(layer.self_attn.v_proj(xin), [R, s, kvh, d])
+                qv, kv_ = rotate_half_apply(q._value, k._value, cos_s, sin_s)
+                out_v, nk, nv = self._paged_attention(
+                    qv, kv_, v._value, ks[li], vs[li], tables, positions)
+                new_ks.append(nk)
+                new_vs.append(nv)
+                x = x + layer.self_attn.o_proj(
+                    Tensor(out_v.reshape(R, s, h * d)))
+                x = x + layer.mlp(layer.post_attention_layernorm(x))
+            hidden = base.norm(x)
+            if model.lm_head is not None:
+                logits = model.lm_head(hidden)
+            else:
+                logits = F.linear(hidden, base.embed_tokens.weight.T)
+            return logits._value, new_ks, new_vs
+
+    def _decode_fn(self, param_arrays, buffer_arrays, ks, vs, tokens,
+                   positions, tables):
+        logits, ks, vs = self._forward(param_arrays, buffer_arrays, ks, vs,
+                                       tokens[:, None], positions, tables)
+        return logits[:, 0], ks, vs
+
+    def _prefill_fn(self, param_arrays, buffer_arrays, ks, vs, tokens,
+                    chunk_start, tables, take_idx):
+        import jax.numpy as jnp
+
+        positions = chunk_start[None]                 # [1]
+        logits, ks, vs = self._forward(param_arrays, buffer_arrays, ks, vs,
+                                       tokens, positions, tables)
+        return jnp.take(logits[0], take_idx, axis=0), ks, vs
+
+    def _param_arrays(self):
+        return ([p._value for p in self._params],
+                [b._value for b in self._buffers])
+
+    def _run_decode(self, tokens, positions, tables):
+        import jax
+
+        pa, ba = self._param_arrays()
+        args = (pa, ba, self._ks, self._vs, tokens, positions, tables)
+        if self._decode_exec is None:
+            self._decode_compiles += 1
+            jitted = jax.jit(self._decode_fn, donate_argnums=(2, 3))
+            self._decode_exec = jitted.lower(*args).compile()
+            if self._lint:
+                self.lint_report = check_decode_donation(
+                    self._decode_exec, self._arena_bytes)
+        logits, self._ks, self._vs = self._decode_exec(*args)
+        return logits
+
+    def _run_prefill(self, tokens, chunk_start, tables, take_idx):
+        import jax
+
+        pa, ba = self._param_arrays()
+        args = (pa, ba, self._ks, self._vs, tokens, chunk_start, tables,
+                take_idx)
+        if self._prefill_exec is None:
+            jitted = jax.jit(self._prefill_fn, donate_argnums=(2, 3))
+            self._prefill_exec = jitted.lower(*args).compile()
+        logits, self._ks, self._vs = self._prefill_exec(*args)
+        return logits

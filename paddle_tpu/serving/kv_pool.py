@@ -1,0 +1,125 @@
+"""Paged KV pool: fixed-size token blocks, per-request block tables.
+
+Reference capability: the paged serving cache behind
+`paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu:1` —
+the KV cache is an arena of fixed-size pages; each request owns a block
+table mapping its logical token range onto physical pages, so admission is
+a page-count check and eviction frees pages without moving anyone else's
+data.
+
+This module is pure accounting (no arrays): the :class:`ServingEngine`
+owns the physical ``[num_pages, page_tokens, kv_heads, head_dim]`` arenas
+and indexes them with the tables handed out here.  Page 0 is RESERVED as
+the trash page — inactive batch rows in the compiled decode program write
+their (ignored) k/v there, so a row going idle never needs a reshape or a
+recompile.
+
+Env: ``PADDLE_TPU_PAGE_TOKENS`` sets the default page size (tokens per
+page).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List
+
+__all__ = ["PagedKVPool", "PoolExhausted", "default_page_tokens",
+           "TRASH_PAGE"]
+
+TRASH_PAGE = 0
+
+
+def default_page_tokens() -> int:
+    return int(os.environ.get("PADDLE_TPU_PAGE_TOKENS", "16"))
+
+
+class PoolExhausted(RuntimeError):
+    """No free pages: the caller must evict a request (or reject the
+    admission) before retrying."""
+
+
+class PagedKVPool:
+    """Page allocator over ``num_pages`` fixed blocks of ``page_tokens``
+    token slots each.  Page 0 is the reserved trash page and is never
+    handed out, so ``capacity`` is ``num_pages - 1``."""
+
+    def __init__(self, num_pages: int, page_tokens: int):
+        if num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is reserved)")
+        if page_tokens < 1:
+            raise ValueError("page_tokens must be >= 1")
+        self.num_pages = int(num_pages)
+        self.page_tokens = int(page_tokens)
+        self._free: List[int] = list(range(num_pages - 1, TRASH_PAGE, -1))
+        self._tables: Dict[object, List[int]] = {}
+        self._peak_used = 0
+
+    # -- capacity ----------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self.num_pages - 1
+
+    @property
+    def pages_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_used(self) -> int:
+        return self.capacity - len(self._free)
+
+    def occupancy(self) -> float:
+        """Fraction of allocatable pages currently owned by requests."""
+        return self.pages_used / max(self.capacity, 1)
+
+    @property
+    def peak_used(self) -> int:
+        return self._peak_used
+
+    def pages_for(self, n_tokens: int) -> int:
+        """Pages needed to hold ``n_tokens`` token slots."""
+        return -(-max(int(n_tokens), 0) // self.page_tokens)
+
+    def can_alloc(self, n_pages: int) -> bool:
+        return len(self._free) >= int(n_pages)
+
+    # -- alloc / free ------------------------------------------------------
+    def alloc(self, rid, n_pages: int = 1) -> List[int]:
+        """Append ``n_pages`` fresh pages to ``rid``'s block table and
+        return the page ids.  All-or-nothing: raises :class:`PoolExhausted`
+        without allocating when fewer than ``n_pages`` are free."""
+        n = int(n_pages)
+        if n < 0:
+            raise ValueError("n_pages must be >= 0")
+        if len(self._free) < n:
+            raise PoolExhausted(
+                f"need {n} pages, {len(self._free)} free "
+                f"({self.pages_used}/{self.capacity} in use)")
+        got = [self._free.pop() for _ in range(n)]
+        self._tables.setdefault(rid, []).extend(got)
+        self._peak_used = max(self._peak_used, self.pages_used)
+        return got
+
+    def table(self, rid) -> List[int]:
+        """The request's block table: physical page of logical page ``j``
+        (token range ``[j*page_tokens, (j+1)*page_tokens)``)."""
+        return list(self._tables.get(rid, ()))
+
+    def free(self, rid) -> int:
+        """Release every page ``rid`` owns; returns the count.  Unknown
+        ``rid`` raises — a double-free is always an engine bug."""
+        if rid not in self._tables:
+            raise KeyError(f"free of unknown/already-freed request {rid!r}")
+        pages = self._tables.pop(rid)
+        self._free.extend(reversed(pages))
+        return len(pages)
+
+    def check_leaks(self) -> None:
+        """Assert the quiesced-pool invariant: every page either free or on
+        the free list exactly once, no table left behind."""
+        if self._tables:
+            raise AssertionError(
+                f"leaked block tables: { {k: len(v) for k, v in self._tables.items()} }")
+        if sorted(self._free) != list(range(1, self.num_pages)):
+            raise AssertionError(
+                f"free list corrupt: {len(self._free)} pages, "
+                f"expected {self.capacity}")

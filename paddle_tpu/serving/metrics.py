@@ -1,0 +1,190 @@
+"""Per-request SLO metrics for the serving engine.
+
+The serving analogue of :class:`telemetry.StepMeter`: where the train
+meter prices a step (tokens/s, MFU), the SLO meter prices a REQUEST —
+TTFT (arrival → first token), TPOT (mean inter-token gap over the decode
+phase), end-to-end latency — and the fleet-level gauges a capacity planner
+reads: queue depth, KV-pool occupancy, sustained requests/s.
+
+Everything flows through the telemetry runtime so the existing surfaces
+pick it up for free: gauges/counters land in ``telemetry.counters()`` (and
+therefore ``prometheus_text()``), and admit/evict/finish transitions are
+narrated into the flight recorder (``serve_admit`` / ``serve_evict`` /
+``serve_finish`` events) so a hung or thrashing server dumps its recent
+scheduling story the same way a hung train step dumps its collectives.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..telemetry import record_event
+from ..telemetry.runtime import bump, set_gauge
+
+__all__ = ["RequestClock", "SLOMeter"]
+
+
+@dataclass
+class RequestClock:
+    """Wall-clock milestones of one request's life (monotonic seconds)."""
+
+    rid: object
+    submit_t: float
+    admit_t: Optional[float] = None
+    first_token_t: Optional[float] = None
+    finish_t: Optional[float] = None
+    token_times: List[float] = field(default_factory=list)
+    n_tokens: int = 0
+    evictions: int = 0
+    replay_watermark: int = 0   # tokens produced before the last eviction
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_t is None:
+            return None
+        return self.first_token_t - self.submit_t
+
+    @property
+    def tpot_s(self) -> Optional[float]:
+        """Mean inter-token gap over the decode phase (first token
+        excluded — that one is priced by TTFT)."""
+        if self.finish_t is None or self.first_token_t is None \
+                or self.n_tokens < 2:
+            return None
+        return (self.finish_t - self.first_token_t) / (self.n_tokens - 1)
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.finish_t is None:
+            return None
+        return self.finish_t - self.submit_t
+
+
+def _pct(xs: List[float], q: float) -> Optional[float]:
+    if not xs:
+        return None
+    s = sorted(xs)
+    idx = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
+    return s[idx]
+
+
+class SLOMeter:
+    """Aggregates :class:`RequestClock` milestones into p50/p99 SLO lines
+    and exports live gauges through telemetry."""
+
+    def __init__(self, now=time.monotonic):
+        self._now = now
+        self._clocks: Dict[object, RequestClock] = {}
+        self._finished: List[RequestClock] = []
+        self._t_first_submit: Optional[float] = None
+        self._t_last_finish: Optional[float] = None
+        self.occupancy_peak = 0.0
+
+    def clock(self, rid) -> RequestClock:
+        return self._clocks[rid]
+
+    # -- lifecycle ---------------------------------------------------------
+    def submit(self, rid) -> None:
+        t = self._now()
+        self._clocks[rid] = RequestClock(rid=rid, submit_t=t)
+        if self._t_first_submit is None:
+            self._t_first_submit = t
+        bump("serving.requests_submitted")
+
+    def admit(self, rid, *, queue_depth: int, pages: int) -> None:
+        c = self._clocks[rid]
+        c.admit_t = self._now()
+        record_event("serve_admit", str(rid), pages=pages,
+                     queue_depth=queue_depth,
+                     queued_s=round(c.admit_t - c.submit_t, 6))
+        bump("serving.requests_admitted")
+
+    def first_token(self, rid) -> None:
+        t = self._now()
+        c = self._clocks[rid]
+        if c.first_token_t is None:
+            c.first_token_t = t     # an eviction-replay re-prefill must
+        c.token_times.append(t)     # not reset the client's TTFT
+        c.n_tokens += 1
+        self._count_token(c)
+
+    def token(self, rid) -> None:
+        c = self._clocks[rid]
+        c.token_times.append(self._now())
+        c.n_tokens += 1
+        self._count_token(c)
+
+    @staticmethod
+    def _count_token(c: RequestClock) -> None:
+        """Recomputing an already-produced token after an eviction is
+        replay WORK, not new output — count the two separately so the
+        bench's token totals match what the stream actually delivered."""
+        if c.n_tokens <= c.replay_watermark:
+            bump("serving.tokens_replayed")
+        else:
+            bump("serving.tokens_generated")
+
+    def evict(self, rid, *, reason: str, pages_freed: int) -> None:
+        c = self._clocks[rid]
+        c.evictions += 1
+        # the restarted prefill regenerates from scratch: token milestones
+        # reset so TTFT/TPOT price what the CLIENT observes (the retained
+        # first_token_t stands — the client saw that token)
+        c.replay_watermark = max(c.replay_watermark, c.n_tokens)
+        c.n_tokens = 0
+        c.token_times.clear()
+        record_event("serve_evict", str(rid), reason=reason,
+                     pages_freed=pages_freed, evictions=c.evictions)
+        bump("serving.evictions")
+
+    def finish(self, rid, *, n_tokens: int) -> None:
+        c = self._clocks[rid]
+        c.finish_t = self._now()
+        c.n_tokens = n_tokens
+        self._t_last_finish = c.finish_t
+        self._finished.append(c)
+        record_event("serve_finish", str(rid), n_tokens=n_tokens,
+                     latency_s=round(c.latency_s, 6),
+                     evictions=c.evictions)
+        bump("serving.requests_finished")
+
+    # -- gauges ------------------------------------------------------------
+    def set_queue_depth(self, n: int) -> None:
+        set_gauge("serving.queue_depth", float(n))
+
+    def set_occupancy(self, frac: float) -> None:
+        self.occupancy_peak = max(self.occupancy_peak, float(frac))
+        set_gauge("serving.kv_pool_occupancy", float(frac))
+
+    # -- rollup ------------------------------------------------------------
+    def summary(self) -> Dict[str, object]:
+        """SLO rollup over finished requests (milliseconds)."""
+        ttft = [c.ttft_s * 1e3 for c in self._finished
+                if c.ttft_s is not None]
+        tpot = [c.tpot_s * 1e3 for c in self._finished
+                if c.tpot_s is not None]
+        lat = [c.latency_s * 1e3 for c in self._finished
+               if c.latency_s is not None]
+        span = None
+        if self._t_first_submit is not None and \
+                self._t_last_finish is not None:
+            span = max(self._t_last_finish - self._t_first_submit, 1e-9)
+        n = len(self._finished)
+        return {
+            "requests_finished": n,
+            "requests_per_sec": round(n / span, 3) if span else None,
+            "ttft_ms_p50": _r(_pct(ttft, 50)),
+            "ttft_ms_p99": _r(_pct(ttft, 99)),
+            "tpot_ms_p50": _r(_pct(tpot, 50)),
+            "tpot_ms_p99": _r(_pct(tpot, 99)),
+            "latency_ms_p50": _r(_pct(lat, 50)),
+            "latency_ms_p99": _r(_pct(lat, 99)),
+            "evictions": sum(c.evictions for c in self._finished),
+            "kv_pool_occupancy_peak": round(self.occupancy_peak, 4),
+        }
+
+
+def _r(x: Optional[float]) -> Optional[float]:
+    return None if x is None else round(x, 3)

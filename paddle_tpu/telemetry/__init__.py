@@ -31,7 +31,7 @@ unhandled-exception dump hook.
 from .runtime import (bump, counters, disable, enable, enabled,  # noqa: F401
                       reset, set_gauge)
 from .recorder import (FlightRecorder, dump_flight_recorder,  # noqa: F401
-                       get_flight_recorder, record_event)
+                       get_flight_recorder, kernel_fallback, record_event)
 from .collectives import (ICI_GBPS_ONEWAY, PEAK_HBM_GBPS,  # noqa: F401
                           PEAK_TFLOPS, TracedProgram, chip_lookup,
                           collective_stats, ici_cost_estimate,
@@ -45,7 +45,7 @@ from .prometheus import prometheus_text  # noqa: F401
 __all__ = [
     "enable", "disable", "enabled", "reset", "bump", "set_gauge", "counters",
     "FlightRecorder", "get_flight_recorder", "record_event",
-    "dump_flight_recorder",
+    "dump_flight_recorder", "kernel_fallback",
     "record_collective", "collective_stats", "total_collective_bytes",
     "ici_cost_estimate", "ring_wire_bytes", "TracedProgram",
     "register_traced_program", "traced_programs",

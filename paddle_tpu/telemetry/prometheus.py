@@ -104,4 +104,38 @@ def prometheus_text() -> str:
             ("fleet_max_step", "Freshest per-step stamp across the gang")):
         if gauge in ctr:
             _metric(lines, gauge, "gauge", help_, [(None, ctr[gauge])])
+
+    # serving SLO surface (absent until a ServingEngine has run; dots are
+    # not legal in exposition-format metric names)
+    for name, mtype, help_ in (
+            ("serving.requests_submitted", "counter", "Requests submitted"),
+            ("serving.requests_admitted", "counter",
+             "Requests admitted (re-admits after eviction included)"),
+            ("serving.requests_finished", "counter", "Requests finished"),
+            ("serving.tokens_generated", "counter",
+             "Tokens delivered to clients"),
+            ("serving.tokens_replayed", "counter",
+             "Tokens recomputed by eviction replay"),
+            ("serving.evictions", "counter",
+             "Mid-flight evictions under KV-pool pressure"),
+            ("serving.queue_depth", "gauge",
+             "Requests waiting for admission"),
+            ("serving.kv_pool_occupancy", "gauge",
+             "Fraction of allocatable KV pages in use")):
+        if name in ctr:
+            val = ctr[name] if mtype == "gauge" else int(ctr[name])
+            _metric(lines, name.replace(".", "_"), mtype, help_,
+                    [(None, val)])
+
+    # Pallas gate rejections, labeled by kernel and reason — a silent
+    # dense-einsum fallback must be visible on the scrape, not just in a
+    # bench regression
+    fb = sorted((k.split(".", 2), v) for k, v in ctr.items()
+                if k.startswith("kernel_fallback.") and k.count(".") == 2)
+    if "kernel_fallback.total" in ctr:
+        _metric(lines, "kernel_fallback_total", "counter",
+                "Pallas kernel gate rejections (fell back to the XLA path)",
+                [({"kernel": parts[1], "reason": parts[2]}, int(v))
+                 for parts, v in fb]
+                or [(None, int(ctr["kernel_fallback.total"]))])
     return "\n".join(lines) + "\n"

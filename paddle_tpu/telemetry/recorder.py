@@ -56,7 +56,7 @@ from typing import Any, Dict, List, Optional
 from . import runtime
 
 __all__ = ["FlightRecorder", "get_flight_recorder", "record_event",
-           "dump_flight_recorder"]
+           "dump_flight_recorder", "kernel_fallback"]
 
 _DEFAULT_SIZE = 512
 
@@ -159,6 +159,20 @@ def record_event(kind: str, name: str, **data) -> None:
 def dump_flight_recorder(path: Optional[str] = None, reason: str = "on_demand",
                          extra: Optional[dict] = None) -> str:
     return _recorder.dump(path, reason, extra)
+
+
+def kernel_fallback(kernel: str, reason: str, **shape_info) -> None:
+    """A Pallas kernel gate rejected a call and the caller fell back to the
+    XLA reference path.  Silent dense-einsum fallbacks are how the 8K
+    decode regression hid until a bench caught it (round-5), so every gate
+    rejection is narrated: a ``kernel_fallback`` flight-recorder event
+    naming the kernel and the reason (``mask`` / ``dropout`` / ``shape``)
+    plus ``kernel_fallback.<kernel>.<reason>`` counters readable via
+    ``telemetry.counters()``.  Gates run at trace time, so this fires once
+    per compiled signature, not once per step."""
+    runtime.bump(f"kernel_fallback.{kernel}.{reason}")
+    runtime.bump("kernel_fallback.total")
+    record_event("kernel_fallback", kernel, reason=reason, **shape_info)
 
 
 # -- crash dump -------------------------------------------------------------

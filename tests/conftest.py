@@ -46,7 +46,15 @@ for _k, _v in (("PADDLE_TPU_HB_INTERVAL", "0.25"),
                # 10 steps) and 30s client deadlines would blow the tier-1
                # budget — snapshot every 2 steps, fail transports fast
                ("PADDLE_TPU_SNAP_EVERY", "2"),
-               ("PADDLE_TPU_SNAP_TIMEOUT", "10")):
+               ("PADDLE_TPU_SNAP_TIMEOUT", "10"),
+               # serving suite: production page/pool sizes (16-token pages,
+               # 64-page arenas) allocate real HBM-scale buffers — pin the
+               # paged-KV geometry down so the CPU tier-1 engines compile
+               # tiny arenas; tests that probe pool pressure override
+               ("PADDLE_TPU_PAGE_TOKENS", "8"),
+               ("PADDLE_TPU_SERVE_MAX_BATCH", "3"),
+               ("PADDLE_TPU_SERVE_PAGES", "24"),
+               ("PADDLE_TPU_SERVE_MAX_PAGES_PER_SEQ", "6")):
     os.environ.setdefault(_k, _v)
 
 import jax  # noqa: E402

@@ -1,0 +1,286 @@
+"""Serving engine: paged KV pool accounting, continuous-batching scheduler
+semantics (admit/evict ordering, page alloc/free never leaks), mid-flight
+eviction chaos (token-exact vs serial generation), the decode-program
+donation lint gate, and the bucket-merge dispatch fix.
+
+Tier-1 ``serving`` lane; conftest pins PADDLE_TPU_PAGE_TOKENS /
+PADDLE_TPU_SERVE_* down so the compiled engines stay CPU-sized.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import Predictor
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.serving import (PagedKVPool, PoolExhausted, ServingEngine,
+                                TRASH_PAGE)
+
+pytestmark = pytest.mark.serving
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(3)
+    cfg = llama_tiny(num_hidden_layers=2, vocab_size=96,
+                     max_position_embeddings=128)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _solo(model, prompt, max_new, eos=None):
+    ids, _ = model.generate(paddle.to_tensor(np.asarray(prompt)[None]),
+                            max_new_tokens=max_new, eos_token_id=eos,
+                            pad_token_id=0 if eos is not None else None)
+    return ids.numpy()[0]
+
+
+def _expect(model, prompt, max_new, eos=None):
+    """What the engine should emit: the generate() row truncated just
+    after the first eos (the engine frees the slot at eos)."""
+    row = _solo(model, prompt, max_new, eos)
+    if eos is not None:
+        hits = np.flatnonzero(row == eos)
+        if hits.size:
+            return row[:hits[0] + 1]
+    return row
+
+
+class TestPagedKVPool:
+    def test_alloc_free_roundtrip(self):
+        pool = PagedKVPool(num_pages=8, page_tokens=4)
+        assert pool.capacity == 7 and pool.pages_free == 7
+        a = pool.alloc("r1", 3)
+        assert len(a) == 3 and TRASH_PAGE not in a
+        assert pool.table("r1") == a and pool.pages_used == 3
+        b = pool.alloc("r2", 2)
+        assert set(a).isdisjoint(b)
+        assert pool.free("r1") == 3
+        assert pool.pages_used == 2
+        pool.alloc("r2", 1)
+        assert len(pool.table("r2")) == 3
+        assert pool.free("r2") == 3
+        pool.check_leaks()
+
+    def test_exhaustion_is_all_or_nothing(self):
+        pool = PagedKVPool(num_pages=4, page_tokens=4)
+        pool.alloc("a", 2)
+        with pytest.raises(PoolExhausted):
+            pool.alloc("b", 2)
+        assert pool.table("b") == []          # nothing partially allocated
+        assert pool.pages_free == 1
+
+    def test_double_free_raises(self):
+        pool = PagedKVPool(num_pages=4, page_tokens=4)
+        pool.alloc("a", 1)
+        pool.free("a")
+        with pytest.raises(KeyError):
+            pool.free("a")
+
+    def test_pages_for(self):
+        pool = PagedKVPool(num_pages=4, page_tokens=8)
+        assert pool.pages_for(1) == 1
+        assert pool.pages_for(8) == 1
+        assert pool.pages_for(9) == 2
+
+    def test_leak_detection(self):
+        pool = PagedKVPool(num_pages=4, page_tokens=4)
+        pool.alloc("a", 1)
+        with pytest.raises(AssertionError):
+            pool.check_leaks()
+
+
+class TestServingEngine:
+    def test_outputs_match_solo_generate(self, model):
+        eng = ServingEngine(model, max_batch=3, page_tokens=8,
+                            num_pages=32, max_pages_per_seq=6)
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(1, 96, n).astype(np.int32)
+                   for n in (5, 11, 20, 7, 13)]
+        rids = [eng.submit(p, max_new_tokens=6, eos_token_id=5)
+                for p in prompts]
+        outs = eng.run()
+        assert eng._decode_compiles == 1     # one program for the stream
+        for p, r in zip(prompts, rids):
+            np.testing.assert_array_equal(
+                outs[r], _expect(model, p, 6, eos=5), err_msg=f"rid {r}")
+        eng.pool.check_leaks()
+
+    def test_admit_ordering_fifo_and_queue_gauge(self, model):
+        """More requests than rows: admission is FIFO, the queue drains in
+        order, and everyone finishes with the pool clean."""
+        eng = ServingEngine(model, max_batch=2, page_tokens=8,
+                            num_pages=32, max_pages_per_seq=4)
+        rng = np.random.default_rng(1)
+        prompts = [rng.integers(1, 96, 6).astype(np.int32) for _ in range(5)]
+        rids = [eng.submit(p, max_new_tokens=4) for p in prompts]
+        eng.step()                            # admits exactly max_batch
+        admitted = [r.rid for r in eng._active.values()]
+        assert sorted(admitted) == rids[:2]
+        outs = eng.run()
+        assert sorted(outs) == sorted(rids)
+        eng.pool.check_leaks()
+
+    def test_eviction_mid_flight_never_corrupts_others(self, model):
+        """ACCEPTANCE: chaos — a pool too small for the offered load forces
+        mid-flight evictions; every request's final output must equal its
+        serial generation, and no page may leak."""
+        eng = ServingEngine(model, max_batch=3, page_tokens=4,
+                            num_pages=9, max_pages_per_seq=8)
+        rng = np.random.default_rng(2)
+        prompts = [rng.integers(1, 96, n).astype(np.int32)
+                   for n in (6, 9, 5)]
+        rids = [eng.submit(p, max_new_tokens=10) for p in prompts]
+        outs = eng.run()
+        assert eng.meter.summary()["evictions"] >= 1, \
+            "pool was sized to force eviction; none happened"
+        for p, r in zip(prompts, rids):
+            np.testing.assert_array_equal(outs[r], _expect(model, p, 10),
+                                          err_msg=f"rid {r}")
+        eng.pool.check_leaks()
+
+    def test_eviction_prefers_youngest(self, model):
+        """The victim under pool pressure is the youngest-admitted other
+        request (protects accumulated decode progress)."""
+        eng = ServingEngine(model, max_batch=2, page_tokens=4,
+                            num_pages=6, max_pages_per_seq=6)
+        rng = np.random.default_rng(3)
+        p_old = rng.integers(1, 96, 5).astype(np.int32)
+        p_young = rng.integers(1, 96, 5).astype(np.int32)
+        r_old = eng.submit(p_old, max_new_tokens=8)
+        eng.step()                            # old admitted + prefilled
+        r_young = eng.submit(p_young, max_new_tokens=8)
+        eng.run()
+        import paddle_tpu.telemetry as tel
+
+        evs = [e for e in tel.get_flight_recorder().events()
+               if e["kind"] == "serve_evict"
+               and e["name"] in (str(r_old), str(r_young))]
+        assert evs, "expected at least one eviction"
+        assert evs[0]["name"] == str(r_young), \
+            f"victim should be the youngest ({r_young}), got {evs[0]['name']}"
+        eng.pool.check_leaks()
+        del r_old
+
+    def test_budget_rejected_at_submit(self, model):
+        eng = ServingEngine(model, max_batch=2, page_tokens=4,
+                            num_pages=16, max_pages_per_seq=3)
+        with pytest.raises(ValueError):
+            eng.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=8)
+
+    def test_unservable_request_rejected_not_livelocked(self, model):
+        """A request within the per-seq budget but bigger than the whole
+        pool must be rejected at submit — admitted, it would block the
+        FIFO head forever (or starve mid-decode and crash run())."""
+        eng = ServingEngine(model, max_batch=2, page_tokens=4,
+                            num_pages=5, max_pages_per_seq=8)
+        with pytest.raises(ValueError, match="pool"):
+            eng.submit(np.arange(1, 21, dtype=np.int32), max_new_tokens=4)
+        # a small request still serves normally afterwards
+        rid = eng.submit(np.arange(1, 6, dtype=np.int32), max_new_tokens=3)
+        outs = eng.run()
+        assert len(outs[rid]) == 3
+        eng.pool.check_leaks()
+
+    def test_donation_lint_gate(self, model):
+        """The compiled decode program must alias its KV arenas; the gate
+        must FAIL a program that copies them (seeded-bad: no donation)."""
+        from paddle_tpu.serving import check_decode_donation
+
+        eng = ServingEngine(model, max_batch=2, page_tokens=8,
+                            num_pages=16, max_pages_per_seq=4)
+        rid = eng.submit(np.arange(1, 6, dtype=np.int32), max_new_tokens=3)
+        eng.run()
+        assert eng.lint_report is not None and eng.lint_report.ok
+        mem = eng._decode_exec.memory_analysis()
+        assert int(mem.alias_size_in_bytes) >= eng._arena_bytes
+        del rid
+
+        # seeded-bad: the same traced fn compiled WITHOUT donation must trip
+        import jax
+
+        pa, ba = eng._param_arrays()
+        import jax.numpy as jnp
+        args = (pa, ba, eng._ks, eng._vs,
+                jnp.zeros((2,), jnp.int32), jnp.zeros((2,), jnp.int32),
+                jnp.zeros((2, 4), jnp.int32))
+        bad = jax.jit(eng._decode_fn).lower(*args).compile()
+        with pytest.raises(RuntimeError, match="alias"):
+            check_decode_donation(bad, eng._arena_bytes)
+
+    def test_slo_metrics_present(self, model):
+        eng = ServingEngine(model, max_batch=2, page_tokens=8,
+                            num_pages=16, max_pages_per_seq=4)
+        rng = np.random.default_rng(4)
+        for n in (5, 9, 7):
+            eng.submit(rng.integers(1, 96, n).astype(np.int32),
+                       max_new_tokens=4)
+        eng.run()
+        s = eng.meter.summary()
+        assert s["requests_finished"] == 3
+        assert s["ttft_ms_p99"] is not None and s["ttft_ms_p99"] > 0
+        assert s["tpot_ms_p99"] is not None and s["tpot_ms_p99"] > 0
+        assert s["latency_ms_p50"] is not None
+        assert 0 < s["kv_pool_occupancy_peak"] <= 1
+        assert s["requests_per_sec"] > 0
+        import paddle_tpu.telemetry as tel
+
+        counts = tel.counters()
+        assert counts.get("serving.requests_finished", 0) >= 3
+        assert counts.get("serving.tokens_generated", 0) >= 12
+        from paddle_tpu.telemetry import prometheus_text
+
+        txt = prometheus_text()
+        assert "paddle_tpu_serving_requests_finished" in txt
+        assert "paddle_tpu_serving_kv_pool_occupancy" in txt
+
+
+class TestBucketMerge:
+    def test_sixteen_distinct_lengths_share_programs(self, model):
+        """Satellite fix: a trace of 16 all-different lengths must merge
+        under-full pow2 buckets up to max_batch instead of dispatching
+        batch-of-1 programs — and stay token-exact per row."""
+        rng = np.random.default_rng(5)
+        prompts = [rng.integers(1, 96, n).astype(np.int32)
+                   for n in range(3, 19)]          # 16 distinct lengths
+        pred = Predictor.from_model(model)
+        model._generate_cache.clear()
+        model._generate_compiles = 0
+        outs = pred.generate_batch(prompts, max_batch=16, max_new_tokens=4,
+                                   eos_token_id=5, pad_token_id=0)
+        # lengths 3..18 span pow2 buckets {16, 32}; with merging the whole
+        # trace dispatches as ONE full chunk at the largest bucket
+        assert model._generate_compiles <= 1, model._generate_compiles
+        for i in (0, 7, 15):
+            np.testing.assert_array_equal(
+                outs[i][0], _solo(model, prompts[i], 4, eos=5),
+                err_msg=f"prompt {i}")
+
+    def test_over_budget_trace_errors_loudly_not_silently(self, model):
+        """A trace holding a prompt whose bucket exceeds the position
+        budget (len + max_new > max_position_embeddings) must raise the
+        clean generate() ValueError — never silently clamp positions for
+        rows merged into that bucket."""
+        rng = np.random.default_rng(7)
+        cap = model.config.max_position_embeddings          # 128
+        short = rng.integers(1, 96, 6).astype(np.int32)
+        long = rng.integers(1, 96, cap - 10).astype(np.int32)
+        pred = Predictor.from_model(model)
+        with pytest.raises(ValueError, match="max_position_embeddings"):
+            pred.generate_batch([short, long], max_batch=2,
+                                max_new_tokens=12)
+
+    def test_partial_buckets_merge_upward(self, model):
+        """3 short + 1 long with max_batch=4: one merged dispatch at the
+        larger bucket, not two programs."""
+        rng = np.random.default_rng(6)
+        prompts = [rng.integers(1, 96, n).astype(np.int32)
+                   for n in (4, 6, 9, 20)]
+        pred = Predictor.from_model(model)
+        model._generate_cache.clear()
+        model._generate_compiles = 0
+        outs = pred.generate_batch(prompts, max_batch=4, max_new_tokens=3)
+        assert model._generate_compiles == 1, model._generate_compiles
+        for i, p in enumerate(prompts):
+            np.testing.assert_array_equal(outs[i][0], _solo(model, p, 3))
