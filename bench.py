@@ -1357,12 +1357,21 @@ def bench_serving(on_accel: bool, peak: float):
     decode lengths) so the paged pool, admission control and eviction path
     all engage; the engine runs exactly TWO compiled programs for the
     whole stream.  MBU here prices the paged decode step: every step reads
-    the params plus each row's gathered page view."""
+    the params plus each row's gathered page view.
+
+    Three legs (ISSUE 10): the NOMINAL leg above must report
+    ``shed_rate == 0`` (an admission regression that sheds in-capacity
+    traffic fails the bench); an OVER-CAPACITY leg (bounded queue +
+    deadlines, offered load past the pool) must report a positive shed
+    rate while the p99 TTFT of *accepted* requests stays inside the
+    configured deadline; and a resume smoke replays a half-served journal
+    into a fresh engine (``resume_replayed``) proving the crash-recovery
+    path end to end."""
     import numpy as np
 
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, llama_tiny
-    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.serving import (Deadline, Overloaded, ServingEngine)
 
     if on_accel:
         cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
@@ -1384,7 +1393,8 @@ def bench_serving(on_accel: bool, peak: float):
     if on_accel:
         model = paddle.amp.decorate(model, level="O2", dtype="bfloat16")
     eng = ServingEngine(model, max_batch=max_batch, page_tokens=page_tokens,
-                        num_pages=num_pages, max_pages_per_seq=mp)
+                        num_pages=num_pages, max_pages_per_seq=mp,
+                        max_queue=n_requests + 1)
     rng = np.random.default_rng(7)
     total_new = 0
     for i in range(n_requests):
@@ -1400,6 +1410,77 @@ def bench_serving(on_accel: bool, peak: float):
     wall = max(time.perf_counter() - t0, 1e-9)
     s = eng.meter.summary()
     gen_tokens = int(sum(len(v) for v in outs.values()))
+    shed_rate = (s["requests_shed"] + s["requests_rejected"]) \
+        / max(n_requests, 1)
+    if shed_rate != 0:
+        raise RuntimeError(
+            f"nominal serving leg shed/rejected {shed_rate:.2%} of an "
+            f"in-capacity trace — admission control regressed")
+
+    # --- over-capacity leg: shedding must engage, accepted TTFT must hold
+    ttft_budget_s = 60.0 if on_accel else 30.0
+    eng_ov = ServingEngine(model, max_batch=max_batch,
+                           page_tokens=page_tokens, num_pages=num_pages,
+                           max_pages_per_seq=mp,
+                           max_queue=max(2, n_requests // 4))
+    offered = rejected = 0
+    for i in range(n_requests):
+        n = int(prompt_lens[i % len(prompt_lens)])
+        # every 4th request arrives with a dead TTFT budget (stale client
+        # retry): the shedder must drop it instead of burning pool pages
+        dl = Deadline(ttft_s=1e-6) if i % 4 == 0 else \
+            Deadline(ttft_s=ttft_budget_s)
+        offered += 1
+        try:
+            eng_ov.submit(
+                rng.integers(1, cfg.vocab_size, n).astype(np.int32),
+                max_new_tokens=int(
+                    rng.integers(max_new_lo, max_new_hi + 1)),
+                deadline=dl)
+        except Overloaded:
+            rejected += 1
+    eng_ov.run()
+    s_ov = eng_ov.meter.summary()
+    overload_shed_rate = (rejected + s_ov["requests_shed"]) \
+        / max(offered, 1)
+    if overload_shed_rate <= 0:
+        raise RuntimeError("over-capacity serving leg shed nothing — "
+                           "admission control is not engaging")
+    if s_ov["ttft_ms_p99"] is not None and \
+            s_ov["ttft_ms_p99"] > ttft_budget_s * 1e3:
+        raise RuntimeError(
+            f"p99 TTFT of ACCEPTED requests ({s_ov['ttft_ms_p99']}ms) "
+            f"blew the {ttft_budget_s}s deadline under overload — "
+            f"shedding is not protecting admitted work")
+
+    # --- resume smoke: half-served journal replays into a fresh engine
+    import os
+    import shutil
+    import tempfile
+
+    jroot = tempfile.mkdtemp(prefix="paddle_tpu_serve_bench_")
+    try:
+        jdir = os.path.join(jroot, "journal")
+        eng_a = ServingEngine(model, max_batch=max_batch,
+                              page_tokens=page_tokens, num_pages=num_pages,
+                              max_pages_per_seq=mp, journal=jdir)
+        for _ in range(3):
+            eng_a.submit(
+                rng.integers(1, cfg.vocab_size,
+                             int(prompt_lens[0])).astype(np.int32),
+                max_new_tokens=max_new_lo)
+        eng_a.step()            # prefill + first decode, then "crash"
+        eng_a.step()
+        eng_b = ServingEngine(model, max_batch=max_batch,
+                              page_tokens=page_tokens, num_pages=num_pages,
+                              max_pages_per_seq=mp, journal=jdir)
+        resume_replayed = int(eng_b.recover()["replayed"])
+        eng_b.run()
+        if resume_replayed < 1:
+            raise RuntimeError("serving resume smoke replayed nothing — "
+                               "journal recovery regressed")
+    finally:
+        shutil.rmtree(jroot, ignore_errors=True)
 
     import jax
 
@@ -1432,9 +1513,16 @@ def bench_serving(on_accel: bool, peak: float):
             "decode_compiles": eng._decode_compiles,
             "donation_lint": "pass" if (eng.lint_report is None
                                         or eng.lint_report.ok) else "FAIL",
+            "shed_rate": round(shed_rate, 4),
+            "overload_shed_rate": round(overload_shed_rate, 4),
+            "deadline_miss_rate": s_ov["deadline_miss_rate"],
+            "resume_replayed": resume_replayed,
             "note": "mixed-length trace through the paged continuous-"
                     "batching engine; p99s from per-request SLO clocks; "
-                    "MBU prices params + gathered page view per step",
+                    "MBU prices params + gathered page view per step; "
+                    "shed_rate gated ==0 nominal / >0 over-capacity with "
+                    "accepted p99 TTFT inside the deadline; "
+                    "resume_replayed from the journal replay smoke",
         },
     }
 
@@ -1454,6 +1542,8 @@ _COMPACT_KEYS = (
     "snapshot_overhead_pct", "resume_source",
     "ttft_ms_p99", "tpot_ms_p99", "kv_pool_occupancy", "decode_kernel",
     "evictions", "donation_lint",
+    "shed_rate", "overload_shed_rate", "deadline_miss_rate",
+    "resume_replayed",
 )
 
 

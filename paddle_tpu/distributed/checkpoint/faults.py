@@ -31,6 +31,15 @@ capture/ship path — or ``"any"``); ``pattern`` is an
 window which matching calls fire, and ``p``/``seed`` make probabilistic
 campaigns reproducible.
 
+The ``serve`` op family covers the serving engine's hot path:
+``"serve_prefill"`` / ``"serve_decode"`` fire before the compiled
+prefill/decode programs run (state untouched — the engine's step loop
+absorbs the failure and retries), ``"serve_pool"`` before KV-page
+allocations, and ``"serve_journal"`` is the op the serving journal's
+segment writes announce through ``storage.write_bytes`` (so a flaky
+journal exercises the retry + circuit-breaker path).  A spec with
+``op="serve"`` matches the whole family.
+
 usage::
 
     from paddle_tpu.distributed.checkpoint import faults
@@ -57,7 +66,9 @@ __all__ = ["FaultSpec", "InjectedIOError", "InjectedCrash", "inject",
            "scope", "fire", "active", "reset"]
 
 _MODES = ("error", "crash", "truncate", "delay", "sigterm")
-_OPS = ("write", "read", "rename", "commit", "snap", "any")
+_OPS = ("write", "read", "rename", "commit", "snap", "serve",
+        "serve_prefill", "serve_decode", "serve_pool", "serve_journal",
+        "any")
 
 
 class InjectedIOError(OSError):
@@ -97,7 +108,10 @@ class FaultSpec:
 
     # -- matching ----------------------------------------------------------
     def _matches(self, op: str, path: str) -> bool:
-        if self.op != "any" and op != self.op:
+        if self.op == "serve":          # family spec: any serve_* step
+            if not op.startswith("serve"):
+                return False
+        elif self.op != "any" and op != self.op:
             return False
         return fnmatch.fnmatch(os.path.basename(path), self.pattern) or \
             fnmatch.fnmatch(path, self.pattern)

@@ -9,10 +9,15 @@ compose end to end without a cluster manager:
                                  └► exit ``ELASTIC_EXIT_CODE`` (101)
     PreemptionGuard SIGTERM ──► async checkpoint + dump ──► exit 101
     HealthGuard escalation ──► RewindLedger entry + dump ──► exit 101
+    ServingEngine wedge ──► dump ──► exit 101 (journal already durable)
                                       │
     Supervisor.run() ◄────────────────┘  sees 101 → backoff → relaunch
                                          child resumes via
-                                         ``latest_checkpoint(root)``
+                                         ``latest_checkpoint(root)`` (train)
+                                         or ``ServingEngine.recover()``
+                                         (serving: journal replay, reported
+                                         as ``resume_source=journal`` +
+                                         ``resume_replayed``)
 
 The third arrow is the numerical-health rewind path
 (:mod:`paddle_tpu.distributed.health`): when a
@@ -213,6 +218,13 @@ class Supervisor:
                    d.get("source") for d in docs.values()),
                "resume_step": min(steps) if steps else None,
                "steps_lost": max(lost) if lost else None}
+        # serving children resume through the request journal instead of a
+        # checkpoint: their reports carry source="journal" plus the count
+        # of in-flight requests replayed (ServingEngine.recover)
+        rep = [d.get("replayed") for d in docs.values()
+               if d.get("replayed") is not None]
+        if rep:
+            out["resume_replayed"] = sum(rep)
         if len(docs) > 1:
             out["resume_sources"] = {r: d.get("source")
                                      for r, d in sorted(docs.items())}

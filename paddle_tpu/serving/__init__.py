@@ -1,5 +1,6 @@
 """paddle_tpu.serving — production inference: paged KV pool + continuous
-batching over the decode kernels.
+batching over the decode kernels, with the resilience layer that survives
+the traffic the north star describes.
 
 The serving half of the reference's fusion set rebuilt TPU-native
 (`masked_multihead_attention_kernel.cu` → the Pallas decode kernel with the
@@ -7,23 +8,36 @@ aliased in-place cache append, `block_multi_head_attention_kernel.cu` →
 :class:`PagedKVPool` page arenas, the `fused_multi_transformer` loop →
 :class:`ServingEngine`'s two compiled programs), plus the production
 surface: per-request SLO metrics (:class:`SLOMeter`: TTFT, TPOT, p50/p99
-latency, queue depth, KV-pool occupancy) through telemetry, and a donation
-lint gate (:func:`check_decode_donation`) proving the compiled decode
-program updates its cache in place.
+latency, queue depth, KV-pool occupancy, shed/deadline-miss rates) through
+telemetry, a donation lint gate (:func:`check_decode_donation`) proving
+the compiled decode program updates its cache in place, and the ISSUE-10
+resilience layer: admission control (:class:`AdmissionController` —
+bounded queue, :class:`Deadline` budgets, deadline shedding,
+:class:`CircuitBreaker`), crash recovery (:class:`ServingJournal` +
+:class:`TokenSink` — exactly-once delivery across a Supervisor relaunch),
+and a decode-loop watchdog.
 
-    engine = ServingEngine(model, max_batch=8)
-    rid = engine.submit(prompt_ids, max_new_tokens=64, eos_token_id=2)
+    engine = ServingEngine(model, max_batch=8, journal=jdir,
+                           on_token=TokenSink(out_path))
+    engine.recover()                # replay a crashed predecessor, if any
+    rid = engine.submit(prompt_ids, max_new_tokens=64, eos_token_id=2,
+                        deadline=Deadline(ttft_s=2.0, total_s=30.0))
     outputs = engine.run()          # {rid: generated token array}
-    engine.meter.summary()          # ttft_ms_p99, tpot_ms_p99, ...
+    engine.meter.summary()          # ttft_ms_p99, deadline_miss_rate, ...
 """
 
 from .kv_pool import PagedKVPool, PoolExhausted, TRASH_PAGE, \
     default_page_tokens  # noqa: F401
 from .metrics import RequestClock, SLOMeter  # noqa: F401
+from .admission import (AdmissionController, CircuitBreaker, Deadline,  # noqa: F401
+                        Overloaded)
+from .journal import JournalState, ServingJournal, TokenSink  # noqa: F401
 from .engine import Request, ServingEngine, check_decode_donation  # noqa: F401
 
 __all__ = [
     "PagedKVPool", "PoolExhausted", "TRASH_PAGE", "default_page_tokens",
     "RequestClock", "SLOMeter",
+    "AdmissionController", "CircuitBreaker", "Deadline", "Overloaded",
+    "JournalState", "ServingJournal", "TokenSink",
     "Request", "ServingEngine", "check_decode_donation",
 ]

@@ -25,34 +25,66 @@ Design (TPU-first: *nothing* recompiles as traffic changes shape):
   per length; junk tail slots of the last chunk are overwritten by the
   first decode steps before the position mask ever exposes them.
 - **Scheduler** — FIFO admission gated on free page count, eviction under
-  pool pressure (youngest-admitted victim; the evictee requeues at the
-  front and recomputes from its prompt — deterministic greedy decode makes
-  the replay byte-identical), per-request SLO milestones through
-  :class:`SLOMeter` and the flight recorder.
+  pool pressure (youngest-admitted victim, or the most-slack victim when
+  deadlines are attached; the evictee requeues at the front and recomputes
+  from its prompt — deterministic greedy decode makes the replay
+  byte-identical), per-request SLO milestones through :class:`SLOMeter`
+  and the flight recorder.
+- **Resilience** (ISSUE 10) — the front door is an
+  :class:`~paddle_tpu.serving.admission.AdmissionController`: bounded
+  queue + circuit breaker reject at ``submit`` with ``Overloaded`` and a
+  measured retry-after hint, deadline-dead queued requests are shed each
+  step, long prompts defer under pool pressure (bounded bypass so the
+  head cannot starve).  A :class:`~paddle_tpu.serving.journal.
+  ServingJournal` makes accepted work durable (admission records +
+  delivered-token high-water marks, flushed through the checkpoint
+  storage seam every step), tokens surface to the client sink only AFTER
+  the covering flush, and :meth:`ServingEngine.recover` replays the
+  journal into a relaunched engine with every delivered token emitted
+  exactly once.  ``run()`` can arm a decode-loop watchdog whose expiry
+  exits 101 into the :class:`~paddle_tpu.distributed.fleet.elastic.
+  supervisor.Supervisor` relaunch path, and transient step failures
+  (``serve`` fault family, storage flake) are absorbed with the breaker
+  counting them.
 
 Env knobs: ``PADDLE_TPU_SERVE_MAX_BATCH`` (decode rows, default 4),
 ``PADDLE_TPU_PAGE_TOKENS`` (page size, default 16),
 ``PADDLE_TPU_SERVE_PAGES`` (arena pages incl. trash page, default 64),
 ``PADDLE_TPU_SERVE_MAX_PAGES_PER_SEQ`` (per-request budget, default 8),
-``PADDLE_TPU_SERVE_LINT`` (=0 skips the decode-program donation gate).
+``PADDLE_TPU_SERVE_LINT`` (=0 skips the decode-program donation gate),
+``PADDLE_TPU_SERVE_MAX_QUEUE`` (admission queue bound, default 64),
+``PADDLE_TPU_SERVE_BREAKER_THRESHOLD`` / ``_COOLDOWN`` (circuit breaker),
+``PADDLE_TPU_SERVE_WATCHDOG_S`` (decode-loop watchdog, 0 = off),
+``PADDLE_TPU_SERVE_MAX_STEP_FAILURES`` (consecutive absorbed step
+failures before the error propagates, default 8),
+``PADDLE_TPU_SERVE_DEFER_LOOKAHEAD`` / ``_DEFER_MAX`` (long-prompt
+deferral window / starvation cap).
 """
 
 from __future__ import annotations
 
 import os
+import threading
+import time
 from collections import deque
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..distributed.checkpoint import faults as _faults
 from ..distributed.checkpoint.replicator import env_int as _env_int
+from ..distributed.fleet.fault_domain import _env_float
+from ..telemetry import record_event as _event
+from ..telemetry.runtime import bump as _bump
+from .admission import AdmissionController, Deadline, Overloaded
+from .journal import ServingJournal
 from .kv_pool import PagedKVPool, PoolExhausted, TRASH_PAGE, \
     default_page_tokens
 from .metrics import SLOMeter
 
 __all__ = ["Request", "ServingEngine", "check_decode_donation"]
 
-QUEUED, RUNNING, FINISHED = "queued", "running", "finished"
+QUEUED, RUNNING, FINISHED, SHED = "queued", "running", "finished", "shed"
 
 
 class Request:
@@ -61,9 +93,15 @@ class Request:
     _next_rid = 0
 
     def __init__(self, prompt, max_new_tokens: int,
-                 eos_token_id: Optional[int]):
-        self.rid = Request._next_rid
-        Request._next_rid += 1
+                 eos_token_id: Optional[int],
+                 rid: Optional[int] = None):
+        if rid is None:
+            rid = Request._next_rid
+            Request._next_rid += 1
+        else:
+            rid = int(rid)
+            Request._next_rid = max(Request._next_rid, rid + 1)
+        self.rid = rid
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size == 0:
             raise ValueError("empty prompt")
@@ -75,6 +113,10 @@ class Request:
         self.generated: List[int] = []
         self.row: Optional[int] = None
         self.evictions = 0
+        self.deadline: Optional[Deadline] = None
+        self.delivered = 0                    # client-visible high-water mark
+        self.delivered_tokens: List[int] = []
+        self.defers = 0                       # FIFO-head bypasses suffered
 
     @property
     def pos(self) -> int:
@@ -130,7 +172,10 @@ class ServingEngine:
                  page_tokens: Optional[int] = None,
                  num_pages: Optional[int] = None,
                  max_pages_per_seq: Optional[int] = None,
-                 lint: Optional[bool] = None):
+                 lint: Optional[bool] = None,
+                 max_queue: Optional[int] = None,
+                 admission: Optional[AdmissionController] = None,
+                 journal=None, on_token=None, now=None):
         import jax.numpy as jnp
 
         base = getattr(model, "llama", None)
@@ -151,7 +196,13 @@ class ServingEngine:
             MP = max(1, max_pos // P)
         self.page_tokens, self.num_pages, self.max_pages_per_seq = P, N, MP
         self.pool = PagedKVPool(N, P)
-        self.meter = SLOMeter()
+        self._now = now if now is not None else time.monotonic
+        self.meter = SLOMeter(now=self._now)
+        self.admission = admission if admission is not None else \
+            AdmissionController(max_queue=max_queue, now=self._now)
+        self.journal: Optional[ServingJournal] = \
+            ServingJournal(journal) if isinstance(journal, str) else journal
+        self._on_token = on_token
         self._lint = (os.environ.get("PADDLE_TPU_SERVE_LINT", "1") != "0"
                       if lint is None else bool(lint))
 
@@ -170,15 +221,41 @@ class ServingEngine:
         self._queue: deque = deque()
         self._active: Dict[int, Request] = {}          # row -> Request
         self._results: Dict[int, np.ndarray] = {}
+        self.shed: Dict[int, str] = {}                 # rid -> reason
         self._decode_exec = None
         self._prefill_exec = None
         self._decode_compiles = 0
         self.lint_report = None
+        self.steps_total = 0
+        self._pending_delivery: List[tuple] = []       # (rid, idx, token)
+        self._work = threading.Event()
+        self._stop_flag = False
+        self._step_failures = 0
+        self._max_step_failures = _env_int(
+            "PADDLE_TPU_SERVE_MAX_STEP_FAILURES", 8)
+        self._defer_lookahead = _env_int(
+            "PADDLE_TPU_SERVE_DEFER_LOOKAHEAD", 4)
+        self._defer_max = _env_int("PADDLE_TPU_SERVE_DEFER_MAX", 8)
 
     # -- public API --------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 64,
-               eos_token_id: Optional[int] = None) -> int:
-        r = Request(prompt, max_new_tokens, eos_token_id)
+               eos_token_id: Optional[int] = None, *,
+               deadline: Optional[Deadline] = None,
+               rid: Optional[int] = None) -> int:
+        """Admit a request or refuse it.  Raises ``ValueError`` for a
+        request the engine could NEVER serve (malformed, or worst-case
+        page demand beyond the whole pool), :class:`Overloaded` for a
+        request it cannot serve NOW (bounded queue full, circuit breaker
+        open) — the latter carries ``retry_after_s``."""
+        r = Request(prompt, max_new_tokens, eos_token_id, rid=rid)
+        if rid is not None and (
+                rid in self._results or rid in self.shed or
+                any(q.rid == rid for q in list(self._queue)) or
+                any(a.rid == rid for a in list(self._active.values()))):
+            raise ValueError(f"rid {rid} already known to this engine")
+        if deadline is not None and not isinstance(deadline, Deadline):
+            raise TypeError("deadline must be a serving.Deadline")
+        r.deadline = deadline
         budget = self.max_pages_per_seq * self.page_tokens
         if len(r.prompt) + r.max_new_tokens > budget:
             raise ValueError(
@@ -196,41 +273,183 @@ class ServingEngine:
                 f"request needs up to {need_max} pages but the pool only "
                 f"has {self.pool.capacity} — raise PADDLE_TPU_SERVE_PAGES "
                 f"or lower max_new_tokens")
+        try:
+            self.admission.check(len(self._queue), self.meter)
+        except Overloaded as e:
+            self.meter.reject(reason=e.reason,
+                              retry_after_s=e.retry_after_s)
+            raise
+        if self.journal is not None:
+            # accepted work becomes durable at the admission boundary —
+            # BEFORE the request is queued, so a flush failure leaves
+            # neither a phantom queue entry (served despite the client
+            # seeing an error) nor a ghost journal record (replayed after
+            # a crash despite never being accepted)
+            self.journal.submit_durable(r.rid, r.prompt, r.max_new_tokens,
+                                        r.eos_token_id, r.deadline)
         self._queue.append(r)
         self.meter.submit(r.rid)
         self.meter.set_queue_depth(len(self._queue))
+        self._work.set()
         return r.rid
 
-    def run(self, max_steps: int = 100000) -> Dict[int, np.ndarray]:
-        """Drive the scheduler until every submitted request finishes;
-        returns {rid: generated token array}.  Verifies the pool quiesced
-        with zero leaked pages."""
+    def run(self, max_steps: int = 100000, *, forever: bool = False,
+            watchdog_s: Optional[float] = None,
+            on_wedge=None) -> Dict[int, np.ndarray]:
+        """Drive the scheduler; returns {rid: generated token array}.
+
+        ``forever=False`` (default) returns once every submitted request
+        finished (or was shed) and verifies the pool quiesced with zero
+        leaked pages.  ``forever=True`` keeps serving: an idle engine
+        blocks on an event ``submit`` sets (no busy-spin, the step counter
+        stays flat) until :meth:`stop` is called — it still drains to idle
+        before returning, and still leak-checks.
+
+        ``watchdog_s`` (default env ``PADDLE_TPU_SERVE_WATCHDOG_S``, 0 =
+        off) arms a :class:`~paddle_tpu.distributed.CommWatchdog` around
+        every step: a wedged compiled program (or a scheduler livelock)
+        dumps the flight recorder and invokes ``on_wedge`` — by default
+        ``os._exit(101)`` so a Supervisor relaunches into
+        :meth:`recover`.  The journal is flushed every step, so the exit
+        loses no accepted work and no delivered token."""
+        if watchdog_s is None:
+            watchdog_s = _env_float("PADDLE_TPU_SERVE_WATCHDOG_S", 0.0)
+        wd = None
+        if watchdog_s and watchdog_s > 0:
+            from ..distributed.watchdog import CommWatchdog
+
+            wd = CommWatchdog(timeout=watchdog_s,
+                              poll_interval=min(0.5, watchdog_s / 4),
+                              on_timeout=on_wedge or self._wedge_handler)
         steps = 0
-        while self._queue or self._active:
-            self.step()
-            steps += 1
-            if steps > max_steps:
-                raise RuntimeError(f"serving loop did not quiesce in "
-                                   f"{max_steps} steps")
+        self._stop_flag = False
+        try:
+            while True:
+                if not self._queue and not self._active:
+                    if self._undelivered():
+                        # a transient flush failure on the FINAL step left
+                        # journal records / sink tokens pending — they are
+                        # remaining work: step() retries the flush (and
+                        # still escalates after MAX_STEP_FAILURES) before
+                        # the loop may declare quiescence or park idle
+                        if wd is not None:
+                            with wd.watch("serve_step", timeout=watchdog_s):
+                                self.step()
+                        else:
+                            self.step()
+                        continue
+                    if not forever or self._stop_flag:
+                        break
+                    self._work.wait()        # event-gated idle: no spin
+                    self._work.clear()
+                    continue
+                if wd is not None:
+                    with wd.watch("serve_step", timeout=watchdog_s):
+                        self.step()
+                else:
+                    self.step()
+                steps += 1
+                # the quiesce guard bounds the BATCH mode (a finite trace
+                # that stops draining is a livelock); a forever server
+                # legitimately steps without bound — its hang guard is
+                # the watchdog
+                if not forever and steps > max_steps:
+                    raise RuntimeError(f"serving loop did not quiesce in "
+                                       f"{max_steps} steps")
+        finally:
+            if wd is not None:
+                wd.stop()
         self.pool.check_leaks()
         return dict(self._results)
 
+    def serve_forever(self, **kw) -> Dict[int, np.ndarray]:
+        """``run(forever=True)``: serve until :meth:`stop`."""
+        return self.run(forever=True, **kw)
+
+    def stop(self) -> None:
+        """Ask a ``forever`` loop to return once it drains to idle."""
+        self._stop_flag = True
+        self._work.set()
+
     def step(self) -> None:
-        """One scheduler iteration: admit what fits, prefill the newly
-        admitted, take one decode step for every active row, retire
-        finished rows."""
+        """One scheduler iteration: shed what cannot meet its deadline,
+        admit what fits, prefill the newly admitted, take one decode step
+        for every active row, retire finished rows, then flush the journal
+        and surface newly delivered tokens to the sink.
+
+        Transient (``OSError``-class) failures — storage flake on the
+        journal, injected ``serve`` faults — are absorbed: request state
+        is untouched (faults fire before the mutation they guard), the
+        circuit breaker counts the failure, and the next step retries.
+        After ``PADDLE_TPU_SERVE_MAX_STEP_FAILURES`` consecutive failures
+        the error propagates."""
+        self.steps_total += 1
+        try:
+            did_work = self._step_inner()
+        except OSError as e:
+            self._step_failures += 1
+            self.admission.breaker.note_failure()
+            _event("serve_step_error", type(e).__name__,
+                        error=repr(e)[:200],
+                        consecutive=self._step_failures)
+            _bump("serving.step_failures_total")
+            if self._step_failures >= self._max_step_failures:
+                raise
+            return
+        if did_work:
+            self._step_failures = 0
+            self.admission.breaker.note_success()
+
+    def _undelivered(self) -> bool:
+        """Tokens or journal records still awaiting a successful flush."""
+        return bool(self._pending_delivery) or (
+            self.journal is not None and self.journal.pending > 0)
+
+    def _step_inner(self) -> bool:
+        self._shed_scan()
         self._admit()
+        did_work = self._undelivered()   # a retried flush is real work:
+        # succeeding must reset the failure streak and close the breaker
         for r in [r for r in self._active.values() if not r.generated]:
             self._prefill(r)
+            did_work = True
             self._retire_if_done(r)
         if self._active:
             self._decode_step()
+            did_work = True
+        self._flush_delivery()
         self.meter.set_queue_depth(len(self._queue))
         self.meter.set_occupancy(self.pool.occupancy())
+        return did_work
 
     # -- scheduling --------------------------------------------------------
     def _free_rows(self) -> List[int]:
         return [i for i in range(self.max_batch) if i not in self._active]
+
+    def _shed_scan(self) -> None:
+        """Drop queued requests whose deadline can no longer be met —
+        serving them would burn pool pages on output nobody is waiting
+        for.  Active requests are never shed (they are producing; a miss
+        is counted at finish)."""
+        # snapshot + in-place removal: submit() may append from another
+        # thread while a forever-mode engine steps — never rebind or
+        # iterate the live deque here (a rebind would silently strand a
+        # concurrent append on the orphaned deque)
+        for r in list(self._queue):
+            reason = self.admission.shed_reason(
+                submit_t=self.meter.clock(r.rid).submit_t,
+                deadline=r.deadline, first_token_out=r.delivered > 0,
+                meter=self.meter)
+            if reason is not None:
+                self._queue.remove(r)
+                self._shed(r, reason)
+
+    def _shed(self, r: Request, reason: str) -> None:
+        r.state = SHED
+        self.shed[r.rid] = reason
+        if self.journal is not None:
+            self.journal.shed(r.rid, reason)
+        self.meter.shed(r.rid, reason=reason)
 
     def _admit(self) -> None:
         rows = self._free_rows()
@@ -238,18 +457,55 @@ class ServingEngine:
             r = self._queue[0]
             need = self.pool.pages_for(len(r.prompt) + 1)
             if not self.pool.can_alloc(need):
-                break
+                # pool pressure: a long prompt at the head must not wedge
+                # admission — try ONE shorter request from the lookahead
+                # window (bounded per-head bypass budget, no starvation)
+                if not self._admit_bypass(r, need, rows):
+                    break
+                continue
+            self._admit_one(r, need, rows, from_head=True)
+
+    def _admit_one(self, r: Request, need: int, rows: List[int],
+                   *, from_head: bool) -> None:
+        _faults.fire("serve_pool", f"admit_rid{r.rid}")
+        if from_head:
             self._queue.popleft()
-            self.pool.alloc(r.rid, need)
-            r.row = rows.pop(0)
-            r.state = RUNNING
-            self._active[r.row] = r
-            self.meter.admit(r.rid, queue_depth=len(self._queue), pages=need)
-            self.meter.set_occupancy(self.pool.occupancy())
+        else:
+            self._queue.remove(r)
+        self.pool.alloc(r.rid, need)
+        r.row = rows.pop(0)
+        r.state = RUNNING
+        self._active[r.row] = r
+        self.meter.admit(r.rid, queue_depth=len(self._queue), pages=need)
+        self.meter.set_occupancy(self.pool.occupancy())
+
+    def _admit_bypass(self, head: Request, head_need: int,
+                      rows: List[int]) -> bool:
+        """Pool-pressure deferral of long prompts: when the FIFO head does
+        not fit, admit one STRICTLY smaller request from the next
+        ``PADDLE_TPU_SERVE_DEFER_LOOKAHEAD`` queue slots instead of
+        wedging.  The head keeps its place and can only be bypassed
+        ``PADDLE_TPU_SERVE_DEFER_MAX`` times — after that admission holds
+        strictly FIFO until the head fits."""
+        if head.defers >= self._defer_max:
+            return False
+        window = min(len(self._queue), self._defer_lookahead + 1)
+        for i in range(1, window):
+            c = self._queue[i]
+            need = self.pool.pages_for(len(c.prompt) + 1)
+            if need < head_need and self.pool.can_alloc(need):
+                head.defers += 1
+                self.meter.defer(head.rid, defers=head.defers,
+                                 need=head_need, free=self.pool.pages_free)
+                self._admit_one(c, need, rows, from_head=False)
+                return True
+        return False
 
     def _evict(self, victim: Request) -> None:
         """Preempt ``victim``: free its pages, requeue it at the front; the
-        deterministic greedy replay regenerates the same tokens."""
+        deterministic greedy replay regenerates the same tokens (tokens
+        the client already saw are NOT re-delivered — ``delivered`` is the
+        high-water mark)."""
         freed = self.pool.free(victim.rid)
         del self._active[victim.row]
         victim.row = None
@@ -260,15 +516,35 @@ class ServingEngine:
         self.meter.evict(victim.rid, reason="pool_pressure",
                          pages_freed=freed)
 
+    def _victim_key(self, x: Request):
+        """Eviction preference under pool pressure, largest key loses.
+
+        No-deadline requests are preempted before any deadline-carrying
+        one (their sort group compares higher), youngest-admitted first —
+        the original policy.  Among deadline-carrying requests the victim
+        is the one with the MOST remaining slack: it has the best chance
+        of still making its SLO after the eviction replay."""
+        c = self.meter.clock(x.rid)
+        budgets = []
+        if x.deadline is not None:
+            if x.deadline.total_s is not None:
+                budgets.append(c.submit_t + x.deadline.total_s)
+            if x.deadline.ttft_s is not None and x.delivered == 0:
+                budgets.append(c.submit_t + x.deadline.ttft_s)
+        if not budgets:
+            return (1, c.admit_t or 0.0, x.rid)
+        return (0, min(budgets) - self._now(), x.rid)
+
     def _ensure_page(self, r: Request) -> bool:
         """Make sure the page holding ``r.pos`` exists.  Under pool
-        pressure the YOUNGEST-admitted active request is preempted — older
-        requests' accumulated decode progress is worth more; when ``r``
-        itself is the youngest it self-preempts (returns False) and waits
-        in the queue for pages to free up."""
+        pressure an active request is preempted (see :meth:`_victim_key`:
+        youngest-admitted without deadlines, most-slack with); when ``r``
+        itself is chosen it self-preempts (returns False) and waits in
+        the queue for pages to free up."""
         need = r.pos // self.page_tokens + 1
         while len(self.pool.table(r.rid)) < need:
             if self.pool.can_alloc(1):
+                _faults.fire("serve_pool", f"page_rid{r.rid}")
                 self.pool.alloc(r.rid, 1)
                 continue
             live = [x for x in self._active.values() if x.state == RUNNING]
@@ -278,8 +554,7 @@ class ServingEngine:
                     f"request {r.rid} needs page {need} but the pool is "
                     f"exhausted — raise PADDLE_TPU_SERVE_PAGES or lower "
                     f"the per-request budget")
-            victim = max(live,
-                         key=lambda x: self.meter.clock(x.rid).admit_t or 0.0)
+            victim = max(live, key=self._victim_key)
             self._evict(victim)
             if victim is r:
                 return False
@@ -293,7 +568,10 @@ class ServingEngine:
         r.row = None
         r.state = FINISHED
         self._results[r.rid] = np.asarray(r.generated, np.int32)
-        self.meter.finish(r.rid, n_tokens=len(r.generated))
+        if self.journal is not None:
+            self.journal.finish(r.rid)
+        self.meter.finish(r.rid, n_tokens=len(r.generated),
+                          deadline=r.deadline)
         self.meter.set_occupancy(self.pool.occupancy())
         del freed
 
@@ -307,6 +585,7 @@ class ServingEngine:
     def _prefill(self, r: Request) -> None:
         import jax.numpy as jnp
 
+        _faults.fire("serve_prefill", f"rid{r.rid}")
         P = self.page_tokens
         prompt = r.prompt
         n_chunks = -(-len(prompt) // P)
@@ -324,6 +603,7 @@ class ServingEngine:
         tok = int(np.argmax(np.asarray(logits)))
         r.generated.append(tok)
         self.meter.first_token(r.rid)
+        self._deliver(r, tok)
 
     def _decode_step(self) -> None:
         import jax.numpy as jnp
@@ -351,6 +631,7 @@ class ServingEngine:
             for r in list(self._active.values()):
                 self._retire_if_done(r)
             return
+        _faults.fire("serve_decode", f"step{self.steps_total}")
         logits = self._run_decode(jnp.asarray(tokens),
                                   jnp.asarray(positions),
                                   jnp.asarray(tables))
@@ -359,8 +640,129 @@ class ServingEngine:
             tok = int(np.argmax(logits[r.row]))
             r.generated.append(tok)
             self.meter.token(r.rid)
+            self._deliver(r, tok)
         for r in list(self._active.values()):
             self._retire_if_done(r)
+
+    # -- delivery / crash recovery ----------------------------------------
+    def _deliver(self, r: Request, tok: int) -> None:
+        """Token bookkeeping right after ``r.generated.append(tok)``.  New
+        tokens advance the journaled high-water mark and queue for the
+        sink (emitted only after the covering journal flush); replayed
+        tokens (eviction or crash recovery) are suppressed and verified
+        against what the client already saw — greedy decode is
+        deterministic, a divergence is an engine bug."""
+        idx = len(r.generated) - 1
+        if idx < r.delivered:
+            if r.delivered_tokens[idx] != tok:
+                raise RuntimeError(
+                    f"replay divergence for rid {r.rid} at token {idx}: "
+                    f"regenerated {tok}, client saw "
+                    f"{r.delivered_tokens[idx]}")
+            return
+        r.delivered_tokens.append(tok)
+        r.delivered = idx + 1
+        if self.journal is not None:
+            self.journal.deliver(r.rid, idx, tok)
+        self._pending_delivery.append((r.rid, idx, tok))
+
+    def _flush_delivery(self) -> None:
+        """Durability barrier, then client emission: journal records hit
+        disk BEFORE any of the tokens they cover reach the sink.  On a
+        flush failure everything stays pending — the step-failure path
+        retries, and a crash instead re-generates the tokens exactly."""
+        if self.journal is not None:
+            self.journal.flush()
+        if self._on_token is not None:
+            for rid, idx, tok in self._pending_delivery:
+                self._on_token(rid, idx, tok)
+        self._pending_delivery.clear()
+
+    def recover(self) -> dict:
+        """Replay the journal into this (fresh) engine after a crash:
+        re-submit every accepted-but-unfinished request with its original
+        rid and delivered high-water mark (tokens the client already saw
+        are regenerated but not re-delivered), restore finished results
+        and shed records, and re-offer every journaled token to the sink
+        (which deduplicates) — closing the flush→emit crash window.
+        Returns ``{"replayed", "finished", "shed", "truncated"}`` and
+        writes the supervisor resume report (``PADDLE_TPU_RESUME_REPORT``
+        protocol) when there was anything to recover."""
+        if self.journal is None:
+            raise RuntimeError("recover() needs a journal-backed engine")
+        st = self.journal.load_state()
+        replayed = 0
+        for rid in st.open_rids():
+            rec = st.requests[rid]
+            r = Request(np.asarray(rec["prompt"], np.int32),
+                        rec["max_new_tokens"], rec["eos_token_id"], rid=rid)
+            r.deadline = Deadline.from_doc(rec.get("deadline"))
+            toks = st.delivered.get(rid, [])
+            r.delivered = len(toks)
+            r.delivered_tokens = list(toks)
+            self._queue.append(r)
+            # deadlines keep aging across the crash: backdate the clock
+            # by the wall time already spent, so a budget that died while
+            # the process was down sheds here instead of being served to
+            # a client that gave up long ago
+            age = max(0.0, time.time() - rec.get("submit_wall",
+                                                 time.time()))
+            self.meter.submit(r.rid, age_s=age)
+            replayed += 1
+        for rid in st.finished:
+            self._results[rid] = np.asarray(st.delivered.get(rid, []),
+                                            np.int32)
+        for rid, reason in st.shed.items():
+            self.shed[rid] = reason
+        if self._on_token is not None:
+            for rid in sorted(st.delivered):
+                if rid in st.shed:
+                    continue
+                for idx, tok in enumerate(st.delivered[rid]):
+                    self._on_token(rid, idx, tok)
+        info = {"replayed": replayed, "finished": len(st.finished),
+                "shed": len(st.shed), "truncated": st.truncated,
+                "known_rids": sorted(st.requests)}
+        if st.requests:
+            _event("serve_replay", str(self.journal.root), **info)
+            _bump("serving.requests_replayed_total", replayed)
+            self._write_resume_report(info)
+        if self._queue:
+            self.meter.set_queue_depth(len(self._queue))
+            self._work.set()
+        return info
+
+    @staticmethod
+    def _write_resume_report(info: dict) -> None:
+        """Same stamp-file protocol the snapshot resume ladder uses: the
+        Supervisor reads it back and narrates ``resume_source=journal`` +
+        ``resume_replayed`` in its restart events."""
+        base = os.environ.get("PADDLE_TPU_RESUME_REPORT")
+        if not base:
+            return
+        try:
+            import json
+
+            with open(f"{base}.0", "w") as f:
+                json.dump({"rank": 0, "source": "journal",
+                           "replayed": info["replayed"]}, f)
+        except OSError:
+            pass
+
+    def _wedge_handler(self, info: dict) -> None:
+        """Watchdog expiry: the flight recorder is already dumped; the
+        journal was flushed at the end of the last completed step, so
+        exiting loses nothing the client saw.  Exit 101 hands control to
+        the Supervisor relaunch → :meth:`recover`."""
+        _event("serve_wedged", str(info.get("name")),
+                    elapsed_s=round(float(info.get("elapsed", 0.0)), 3))
+        try:
+            from ..distributed.fleet.elastic import ELASTIC_EXIT_CODE
+        except Exception:
+            ELASTIC_EXIT_CODE = 101
+        os._exit(ELASTIC_EXIT_CODE)
+
+
 
     # -- traced functions --------------------------------------------------
     def _paged_attention(self, q, k_new, v_new, kp, vp, tables, positions):

@@ -4,20 +4,30 @@ The serving analogue of :class:`telemetry.StepMeter`: where the train
 meter prices a step (tokens/s, MFU), the SLO meter prices a REQUEST —
 TTFT (arrival → first token), TPOT (mean inter-token gap over the decode
 phase), end-to-end latency — and the fleet-level gauges a capacity planner
-reads: queue depth, KV-pool occupancy, sustained requests/s.
+reads: queue depth, KV-pool occupancy, sustained requests/s, shed and
+deadline-miss rates.
+
+Memory is BOUNDED by design: a serving process lives for weeks, so p50/p99
+roll over a fixed window of the most recent finished requests
+(``PADDLE_TPU_SERVE_SLO_WINDOW``, default 1024) instead of an append-only
+list, per-request clocks are dropped at finish/shed, and no per-token
+timestamp list is kept — totals that must be exact (requests finished,
+tokens, evictions, sheds) live in O(1) counters.
 
 Everything flows through the telemetry runtime so the existing surfaces
 pick it up for free: gauges/counters land in ``telemetry.counters()`` (and
-therefore ``prometheus_text()``), and admit/evict/finish transitions are
-narrated into the flight recorder (``serve_admit`` / ``serve_evict`` /
-``serve_finish`` events) so a hung or thrashing server dumps its recent
-scheduling story the same way a hung train step dumps its collectives.
+therefore ``prometheus_text()``), and admit/evict/shed/finish transitions
+are narrated into the flight recorder (``serve_admit`` / ``serve_evict`` /
+``serve_shed`` / ``serve_reject`` / ``serve_finish`` events) so a hung or
+thrashing server dumps its recent scheduling story the same way a hung
+train step dumps its collectives.
 """
 
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from collections import deque
+from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from ..telemetry import record_event
@@ -26,16 +36,24 @@ from ..telemetry.runtime import bump, set_gauge
 __all__ = ["RequestClock", "SLOMeter"]
 
 
+def default_slo_window() -> int:
+    from ..distributed.checkpoint.replicator import env_int
+
+    return max(1, env_int("PADDLE_TPU_SERVE_SLO_WINDOW", 1024))
+
+
 @dataclass
 class RequestClock:
-    """Wall-clock milestones of one request's life (monotonic seconds)."""
+    """Wall-clock milestones of one request's life (monotonic seconds).
+    Lives only while the request is in flight — finish/shed folds it into
+    the meter's bounded window and drops it."""
 
     rid: object
     submit_t: float
     admit_t: Optional[float] = None
     first_token_t: Optional[float] = None
     finish_t: Optional[float] = None
-    token_times: List[float] = field(default_factory=list)
+    last_token_t: Optional[float] = None
     n_tokens: int = 0
     evictions: int = 0
     replay_watermark: int = 0   # tokens produced before the last eviction
@@ -72,22 +90,34 @@ def _pct(xs: List[float], q: float) -> Optional[float]:
 
 class SLOMeter:
     """Aggregates :class:`RequestClock` milestones into p50/p99 SLO lines
-    and exports live gauges through telemetry."""
+    over a bounded window and exports live gauges through telemetry."""
 
-    def __init__(self, now=time.monotonic):
+    def __init__(self, now=time.monotonic, window: Optional[int] = None):
         self._now = now
         self._clocks: Dict[object, RequestClock] = {}
-        self._finished: List[RequestClock] = []
+        # each entry: (finish_t, ttft_s|None, tpot_s|None, latency_s,
+        #              deadline_miss True/False/None)
+        self._window: deque = deque(
+            maxlen=window if window is not None else default_slo_window())
+        self._ft_window: deque = deque(maxlen=self._window.maxlen)
         self._t_first_submit: Optional[float] = None
         self._t_last_finish: Optional[float] = None
         self.occupancy_peak = 0.0
+        self.finished_total = 0
+        self.evictions_total = 0
+        self.shed_total = 0
+        self.rejected_total = 0
+        self.deadline_misses_total = 0
 
     def clock(self, rid) -> RequestClock:
         return self._clocks[rid]
 
     # -- lifecycle ---------------------------------------------------------
-    def submit(self, rid) -> None:
-        t = self._now()
+    def submit(self, rid, age_s: float = 0.0) -> None:
+        """``age_s`` backdates the clock: a journal-replayed request has
+        already waited that long in its previous incarnation, and its
+        deadline budgets must keep aging across the crash."""
+        t = self._now() - max(0.0, float(age_s))
         self._clocks[rid] = RequestClock(rid=rid, submit_t=t)
         if self._t_first_submit is None:
             self._t_first_submit = t
@@ -106,13 +136,15 @@ class SLOMeter:
         c = self._clocks[rid]
         if c.first_token_t is None:
             c.first_token_t = t     # an eviction-replay re-prefill must
-        c.token_times.append(t)     # not reset the client's TTFT
+            if c.admit_t is not None:    # not reset the client's TTFT
+                self._ft_window.append(t - c.admit_t)
+        c.last_token_t = t
         c.n_tokens += 1
         self._count_token(c)
 
     def token(self, rid) -> None:
         c = self._clocks[rid]
-        c.token_times.append(self._now())
+        c.last_token_t = self._now()
         c.n_tokens += 1
         self._count_token(c)
 
@@ -129,26 +161,89 @@ class SLOMeter:
     def evict(self, rid, *, reason: str, pages_freed: int) -> None:
         c = self._clocks[rid]
         c.evictions += 1
+        self.evictions_total += 1
         # the restarted prefill regenerates from scratch: token milestones
         # reset so TTFT/TPOT price what the CLIENT observes (the retained
         # first_token_t stands — the client saw that token)
         c.replay_watermark = max(c.replay_watermark, c.n_tokens)
         c.n_tokens = 0
-        c.token_times.clear()
         record_event("serve_evict", str(rid), reason=reason,
                      pages_freed=pages_freed, evictions=c.evictions)
         bump("serving.evictions")
 
-    def finish(self, rid, *, n_tokens: int) -> None:
-        c = self._clocks[rid]
+    def shed(self, rid, *, reason: str) -> None:
+        """A queued request dropped by deadline shedding (or recovery of a
+        journaled shed): it will never run — fold its clock away."""
+        c = self._clocks.pop(rid, None)
+        self.shed_total += 1
+        record_event("serve_shed", str(rid), reason=reason,
+                     queued_s=(None if c is None else
+                               round(self._now() - c.submit_t, 6)))
+        bump("serving.requests_shed_total")
+
+    def reject(self, *, reason: str,
+               retry_after_s: Optional[float] = None) -> None:
+        """An Overloaded refusal at submit (bounded queue / breaker)."""
+        self.rejected_total += 1
+        record_event("serve_reject", reason, retry_after_s=retry_after_s)
+        bump("serving.requests_rejected_total")
+
+    def defer(self, rid, *, defers: int, need: int, free: int) -> None:
+        """The FIFO head was bypassed under pool pressure (a shorter
+        request behind it fit; the head keeps its place)."""
+        record_event("serve_defer", str(rid), defers=defers,
+                     pages_needed=need, pages_free=free)
+        bump("serving.admission_defers_total")
+
+    def finish(self, rid, *, n_tokens: int, deadline=None) -> None:
+        c = self._clocks.pop(rid)
         c.finish_t = self._now()
         c.n_tokens = n_tokens
         self._t_last_finish = c.finish_t
-        self._finished.append(c)
+        self.finished_total += 1
+        miss = None
+        if deadline is not None:
+            miss = bool(
+                (deadline.ttft_s is not None and c.ttft_s is not None
+                 and c.ttft_s > deadline.ttft_s) or
+                (deadline.total_s is not None
+                 and c.latency_s > deadline.total_s))
+            if miss:
+                self.deadline_misses_total += 1
+                bump("serving.deadline_misses_total")
+        self._window.append((c.finish_t, c.ttft_s, c.tpot_s, c.latency_s,
+                             miss))
+        set_gauge("serving.deadline_miss_rate", self.deadline_miss_rate())
         record_event("serve_finish", str(rid), n_tokens=n_tokens,
                      latency_s=round(c.latency_s, 6),
-                     evictions=c.evictions)
+                     evictions=c.evictions, deadline_miss=miss)
         bump("serving.requests_finished")
+
+    # -- estimates (admission control reads these) -------------------------
+    def est_first_token_s(self) -> Optional[float]:
+        """Recent mean admit → first-token latency: the optimistic lower
+        bound on a queued request's remaining TTFT (even admitted right
+        now it still pays prefill)."""
+        if not self._ft_window:
+            return None
+        return sum(self._ft_window) / len(self._ft_window)
+
+    def finish_rate_per_s(self) -> Optional[float]:
+        """Finished requests/s over the current window."""
+        if len(self._window) < 2:
+            return None
+        span = self._window[-1][0] - self._window[0][0]
+        if span <= 0:
+            return None
+        return (len(self._window) - 1) / span
+
+    def deadline_miss_rate(self) -> float:
+        """Fraction of deadline-carrying finishes in the window that
+        missed (0.0 when none carried a deadline)."""
+        hits = [m for (_, _, _, _, m) in self._window if m is not None]
+        if not hits:
+            return 0.0
+        return sum(1 for m in hits if m) / len(hits)
 
     # -- gauges ------------------------------------------------------------
     def set_queue_depth(self, n: int) -> None:
@@ -160,20 +255,20 @@ class SLOMeter:
 
     # -- rollup ------------------------------------------------------------
     def summary(self) -> Dict[str, object]:
-        """SLO rollup over finished requests (milliseconds)."""
-        ttft = [c.ttft_s * 1e3 for c in self._finished
-                if c.ttft_s is not None]
-        tpot = [c.tpot_s * 1e3 for c in self._finished
-                if c.tpot_s is not None]
-        lat = [c.latency_s * 1e3 for c in self._finished
-               if c.latency_s is not None]
+        """SLO rollup (milliseconds); percentiles over the bounded window,
+        totals exact."""
+        ttft = [t * 1e3 for (_, t, _, _, _) in self._window if t is not None]
+        tpot = [t * 1e3 for (_, _, t, _, _) in self._window if t is not None]
+        lat = [t * 1e3 for (_, _, _, t, _) in self._window if t is not None]
         span = None
         if self._t_first_submit is not None and \
                 self._t_last_finish is not None:
             span = max(self._t_last_finish - self._t_first_submit, 1e-9)
-        n = len(self._finished)
+        n = self.finished_total
         return {
             "requests_finished": n,
+            "requests_shed": self.shed_total,
+            "requests_rejected": self.rejected_total,
             "requests_per_sec": round(n / span, 3) if span else None,
             "ttft_ms_p50": _r(_pct(ttft, 50)),
             "ttft_ms_p99": _r(_pct(ttft, 99)),
@@ -181,7 +276,8 @@ class SLOMeter:
             "tpot_ms_p99": _r(_pct(tpot, 99)),
             "latency_ms_p50": _r(_pct(lat, 50)),
             "latency_ms_p99": _r(_pct(lat, 99)),
-            "evictions": sum(c.evictions for c in self._finished),
+            "deadline_miss_rate": round(self.deadline_miss_rate(), 4),
+            "evictions": self.evictions_total,
             "kv_pool_occupancy_peak": round(self.occupancy_peak, 4),
         }
 

@@ -118,6 +118,18 @@ def prometheus_text() -> str:
              "Tokens recomputed by eviction replay"),
             ("serving.evictions", "counter",
              "Mid-flight evictions under KV-pool pressure"),
+            ("serving.requests_shed_total", "counter",
+             "Queued requests shed (deadline unreachable/expired)"),
+            ("serving.requests_rejected_total", "counter",
+             "Requests refused at submit (queue full / breaker open)"),
+            ("serving.requests_replayed_total", "counter",
+             "In-flight requests replayed from the journal after relaunch"),
+            ("serving.deadline_misses_total", "counter",
+             "Finished requests that missed an attached deadline"),
+            ("serving.step_failures_total", "counter",
+             "Serving steps that failed transiently and were retried"),
+            ("serving.deadline_miss_rate", "gauge",
+             "Deadline misses / deadline-carrying finishes (SLO window)"),
             ("serving.queue_depth", "gauge",
              "Requests waiting for admission"),
             ("serving.kv_pool_occupancy", "gauge",

@@ -54,7 +54,16 @@ for _k, _v in (("PADDLE_TPU_HB_INTERVAL", "0.25"),
                ("PADDLE_TPU_PAGE_TOKENS", "8"),
                ("PADDLE_TPU_SERVE_MAX_BATCH", "3"),
                ("PADDLE_TPU_SERVE_PAGES", "24"),
-               ("PADDLE_TPU_SERVE_MAX_PAGES_PER_SEQ", "6")):
+               ("PADDLE_TPU_SERVE_MAX_PAGES_PER_SEQ", "6"),
+               # serving resilience: production queue bounds / breaker
+               # cooldowns are sized for real traffic — pin them down so
+               # the admission-control and chaos suites resolve fast on
+               # CPU (tests that probe a specific bound pass ctor args)
+               ("PADDLE_TPU_SERVE_MAX_QUEUE", "16"),
+               ("PADDLE_TPU_SERVE_BREAKER_THRESHOLD", "3"),
+               ("PADDLE_TPU_SERVE_BREAKER_COOLDOWN", "0.2"),
+               ("PADDLE_TPU_SERVE_SLO_WINDOW", "256"),
+               ("PADDLE_TPU_SERVE_MAX_STEP_FAILURES", "8")):
     os.environ.setdefault(_k, _v)
 
 import jax  # noqa: E402
