@@ -1482,6 +1482,85 @@ def bench_serving(on_accel: bool, peak: float):
     finally:
         shutil.rmtree(jroot, ignore_errors=True)
 
+    # --- multi-replica fleet leg (ISSUE 12): two replicas behind the
+    # lease-routed frontend; one dies mid-stream (its emit path crashes,
+    # its lease expires unreleased — the in-process stand-in for SIGKILL)
+    # and the frontend must fence it at the depot, fold its journal and
+    # replay the open work on the survivor with exactly-once delivery
+    from paddle_tpu.distributed.checkpoint.replicator import (SnapshotClient,
+                                                              SnapshotStore)
+    from paddle_tpu.serving.fleet import (EngineReplica, LocalKV,
+                                          ServingFrontend)
+
+    fleet_root = tempfile.mkdtemp(prefix="paddle_tpu_serve_fleet_")
+    depot_store = SnapshotStore(host="127.0.0.1")
+    depot = SnapshotClient("127.0.0.1", depot_store.port)
+    try:
+        kv = LocalKV()
+        delivered = {}
+
+        def fleet_sink(rid, idx, tok):
+            toks = delivered.setdefault(rid, [])
+            if idx == len(toks):      # exactly-once: drop replayed marks
+                toks.append(int(tok))
+
+        fleet_ttl_s = 1.0
+        fe = ServingFrontend(kv, depot, sink=fleet_sink, ttl=fleet_ttl_s,
+                             auto_attach=False)
+        crash = {"n": 0}
+
+        def dying_emit(rid, idx, tok):
+            fe.emit(rid, idx, tok)
+            crash["n"] += 1
+            if crash["n"] >= 3:
+                raise RuntimeError("fleet leg: simulated replica death")
+
+        ekw = dict(max_batch=max_batch, page_tokens=page_tokens,
+                   num_pages=num_pages, max_pages_per_seq=mp)
+        r0 = EngineReplica("r0", model, store=kv, depot=depot,
+                           journal_root=os.path.join(fleet_root, "j"),
+                           on_token=dying_emit, ttl=fleet_ttl_s,
+                           engine_kw=ekw).start()
+        r1 = EngineReplica("r1", model, store=kv, depot=depot,
+                           journal_root=os.path.join(fleet_root, "j"),
+                           on_token=fe.emit, ttl=fleet_ttl_s,
+                           engine_kw=ekw).start()
+        fe.attach(r0)
+        fe.attach(r1)
+        fleet_rids = {}
+        for i in range(4):
+            n = int(prompt_lens[i % len(prompt_lens)])
+            rid = fe.submit(
+                rng.integers(1, cfg.vocab_size, n).astype(np.int32),
+                max_new_tokens=max_new_lo)
+            fleet_rids[rid] = max_new_lo
+        t_crash = time.perf_counter() + 120
+        while r0.error is None and time.perf_counter() < t_crash:
+            time.sleep(0.02)
+        r0.die()          # heartbeats stop, lease left to expire
+        if not fe.wait_all(list(fleet_rids), timeout=300):
+            raise RuntimeError("fleet leg did not complete after replica "
+                               f"death: {fe.summary()}")
+        fleet_failovers = fe.failovers
+        fleet_replayed = fe.replayed_requests
+        if r0.error is not None and fleet_failovers < 1:
+            raise RuntimeError("fleet leg killed a replica but the "
+                               "frontend never fenced/failed it over")
+        for rid, mn in fleet_rids.items():
+            if rid in fe.shed:
+                continue
+            if len(delivered.get(rid, [])) != mn:
+                raise RuntimeError(
+                    f"fleet leg rid {rid}: {len(delivered.get(rid, []))} "
+                    f"tokens delivered, wanted {mn} — failover replay is "
+                    "not exactly-once")
+        r1.stop()
+        fe.stop()
+    finally:
+        depot.close()
+        depot_store.close()
+        shutil.rmtree(fleet_root, ignore_errors=True)
+
     import jax
 
     from paddle_tpu.telemetry import PEAK_HBM_GBPS
@@ -1517,12 +1596,18 @@ def bench_serving(on_accel: bool, peak: float):
             "overload_shed_rate": round(overload_shed_rate, 4),
             "deadline_miss_rate": s_ov["deadline_miss_rate"],
             "resume_replayed": resume_replayed,
+            "fleet_replicas": 2,
+            "failovers": fleet_failovers,
+            "replayed_requests": fleet_replayed,
             "note": "mixed-length trace through the paged continuous-"
                     "batching engine; p99s from per-request SLO clocks; "
                     "MBU prices params + gathered page view per step; "
                     "shed_rate gated ==0 nominal / >0 over-capacity with "
                     "accepted p99 TTFT inside the deadline; "
-                    "resume_replayed from the journal replay smoke",
+                    "resume_replayed from the journal replay smoke; "
+                    "failovers/replayed_requests from the two-replica "
+                    "fleet leg (one replica dies mid-stream, survivor "
+                    "finishes every request exactly-once)",
         },
     }
 
@@ -1544,6 +1629,7 @@ _COMPACT_KEYS = (
     "evictions", "donation_lint",
     "shed_rate", "overload_shed_rate", "deadline_miss_rate",
     "resume_replayed",
+    "fleet_replicas", "failovers", "replayed_requests",
 )
 
 

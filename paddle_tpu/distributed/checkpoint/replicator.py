@@ -54,12 +54,26 @@ import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = [
-    "SnapshotStore", "SnapshotClient", "KVTransport",
+    "SnapshotStore", "SnapshotClient", "KVTransport", "FencedEpoch",
     "ensure_host_store", "transport_from_env", "crc32", "env_int",
 ]
 
 _HDR = struct.Struct(">I")
 _KEEP_GENS = 2  # double-buffer on the store side too
+# serving-journal record family: keep the newest N fencing EPOCHS per
+# replica (an epoch's segment set must stay complete — the fold needs every
+# segment from the incarnation's start — so retention prunes whole epochs,
+# never individual segments)
+_KEEP_JOURNAL_EPOCHS = 2
+
+
+class FencedEpoch(OSError):
+    """A journal put was refused because the replica's epoch is fenced:
+    the frontend declared this incarnation dead and bumped the fence, so a
+    zombie's late flush must change nothing.  An ``OSError`` on purpose —
+    the serving step loop absorbs it like a storage failure, which blocks
+    the zombie's token emission (flush gates the sink) without crashing
+    the depot connection."""
 
 
 def crc32(data: bytes) -> int:
@@ -137,6 +151,11 @@ class SnapshotStore(threading.Thread):
         # (src, holder, gen) -> {"step","crc","ts","payload"}
         self._copies: Dict[Tuple[int, int, int], Dict[str, Any]] = {}
         self._reports: Dict[int, Dict[int, dict]] = {}
+        # serving-journal record family (keyed by replica NAME, not rank):
+        # (replica, epoch, seq) -> {"crc","ts","payload"}; _fence maps
+        # replica -> minimum epoch the depot still accepts puts for
+        self._journal: Dict[Tuple[str, int, int], Dict[str, Any]] = {}
+        self._fence: Dict[str, int] = {}
         self._stop = threading.Event()
         self.start()
 
@@ -286,6 +305,77 @@ class SnapshotStore(threading.Thread):
                  "dropped": d["payload"] is None}
                 for (s, h, g), d in sorted(self._copies.items())]}, b""
 
+    # -- serving-journal record family ------------------------------------
+    # A serving replica ships every journal segment here at the same flush
+    # boundary that gates token emission, so the depot's view of a
+    # replica's ledger is always >= what any client was shown.  Fencing:
+    # when the frontend declares a replica incarnation dead it bumps the
+    # replica's fence epoch; a zombie still flushing at the old epoch is
+    # refused (``fenced`` refusal, not an ``error`` — the client raises
+    # :class:`FencedEpoch` so callers can tell it from an outage).
+
+    def _cmd_journal_put(self, head, payload):
+        replica, epoch = str(head["replica"]), int(head["epoch"])
+        seq, want = int(head["seq"]), int(head["crc"])
+        if crc32(payload) != want:
+            return {"ok": False, "reason": "crc mismatch on ingest"}, b""
+        with self._lock:
+            fence = self._fence.get(replica, 0)
+            if epoch < fence:
+                return {"ok": False, "fenced": True,
+                        "fence_epoch": fence}, b""
+            self._journal[(replica, epoch, seq)] = {
+                "crc": want, "ts": time.time(), "payload": payload}
+            # retention prunes whole STALE EPOCHS (never individual
+            # segments — a fold needs the epoch's full segment set)
+            epochs = sorted({e for (r, e, _s) in self._journal
+                             if r == replica})
+            for e in epochs[:-_KEEP_JOURNAL_EPOCHS]:
+                for key in [k for k in self._journal
+                            if k[0] == replica and k[1] == e]:
+                    self._journal.pop(key, None)
+        return {"ok": True}, b""
+
+    def _cmd_journal_index(self, head, payload):
+        replica = str(head["replica"])
+        epoch = head.get("epoch")
+        with self._lock:
+            segs = [{"epoch": e, "seq": s, "crc": d["crc"],
+                     "nbytes": len(d["payload"])}
+                    for (r, e, s), d in sorted(self._journal.items())
+                    if r == replica and (epoch is None or e == int(epoch))]
+            return {"segments": segs,
+                    "fence_epoch": self._fence.get(replica, 0)}, b""
+
+    def _cmd_journal_get(self, head, payload):
+        key = (str(head["replica"]), int(head["epoch"]), int(head["seq"]))
+        with self._lock:
+            doc = self._journal.get(key)
+            if doc is None:
+                return {"found": False}, b""
+            return {"found": True, "crc": doc["crc"]}, doc["payload"]
+
+    def _cmd_journal_replicas(self, head, payload):
+        with self._lock:
+            names = sorted({r for (r, _e, _s) in self._journal}
+                           | set(self._fence))
+        return {"replicas": names}, b""
+
+    def _cmd_fence(self, head, payload):
+        replica, epoch = str(head["replica"]), int(head["epoch"])
+        with self._lock:
+            # monotonic max: concurrent fencers (frontend restart racing
+            # the original scan) can only tighten the fence, never reopen
+            # a dead incarnation's rid-space
+            cur = max(self._fence.get(replica, 0), epoch)
+            self._fence[replica] = cur
+        return {"fence_epoch": cur}, b""
+
+    def _cmd_fence_epoch(self, head, payload):
+        with self._lock:
+            return {"fence_epoch":
+                    self._fence.get(str(head["replica"]), 0)}, b""
+
 
 class SnapshotClient:
     """Rank-side client of :class:`SnapshotStore` (one socket, lock-
@@ -397,6 +487,62 @@ class SnapshotClient:
     def index(self) -> List[dict]:
         resp, _ = self._call({"cmd": "index"})
         return resp.get("copies", [])
+
+    # -- serving-journal surface -------------------------------------------
+    def journal_put(self, replica: str, epoch: int, seq: int,
+                    payload: bytes, crc: Optional[int] = None) -> None:
+        """Ship one journal segment.  Raises :class:`FencedEpoch` when the
+        incarnation is fenced (the caller is a zombie and must NOT treat
+        this as a retryable outage) and plain ``OSError`` on transport or
+        ingest-CRC failure (retryable — records stay buffered)."""
+        resp, _ = self._call({
+            "cmd": "journal_put", "replica": str(replica),
+            "epoch": int(epoch), "seq": int(seq),
+            "crc": crc32(payload) if crc is None else crc}, payload)
+        if not resp.get("ok"):
+            if resp.get("fenced"):
+                raise FencedEpoch(
+                    f"journal put refused: replica {replica} epoch {epoch} "
+                    f"fenced at {resp.get('fence_epoch')}")
+            raise OSError(f"journal put refused: "
+                          f"{resp.get('reason', 'unknown')}")
+
+    def journal_index(self, replica: str,
+                      epoch: Optional[int] = None) -> dict:
+        resp, _ = self._call({"cmd": "journal_index",
+                              "replica": str(replica), "epoch": epoch})
+        return {"segments": resp.get("segments", []),
+                "fence_epoch": int(resp.get("fence_epoch", 0))}
+
+    def journal_fetch(self, replica: str, epoch: int
+                      ) -> List[Tuple[int, bytes]]:
+        """All segments of one incarnation, CRC-verified, in seq order."""
+        out: List[Tuple[int, bytes]] = []
+        for seg in self.journal_index(replica, epoch=epoch)["segments"]:
+            resp, payload = self._call({
+                "cmd": "journal_get", "replica": str(replica),
+                "epoch": int(epoch), "seq": int(seg["seq"])})
+            if not resp.get("found") or crc32(payload) != resp["crc"]:
+                continue  # pruned or corrupt in flight: skip, fold dedups
+            out.append((int(seg["seq"]), payload))
+        return out
+
+    def journal_replicas(self) -> List[str]:
+        resp, _ = self._call({"cmd": "journal_replicas"})
+        return list(resp.get("replicas", []))
+
+    def fence(self, replica: str, epoch: int) -> int:
+        """Raise the replica's fence to at least ``epoch`` (monotonic) and
+        return the resulting fence epoch.  ``fence(name, 0)`` is the
+        read-adopt idiom a fresh incarnation uses at startup."""
+        resp, _ = self._call({"cmd": "fence", "replica": str(replica),
+                              "epoch": int(epoch)})
+        return int(resp["fence_epoch"])
+
+    def fence_epoch(self, replica: str) -> int:
+        resp, _ = self._call({"cmd": "fence_epoch",
+                              "replica": str(replica)})
+        return int(resp.get("fence_epoch", 0))
 
 
 # -- KV fallback transport ---------------------------------------------------

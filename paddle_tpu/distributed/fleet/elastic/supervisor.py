@@ -90,8 +90,8 @@ from typing import Callable, List, Optional, Sequence, Union
 
 from . import ELASTIC_EXIT_CODE
 
-__all__ = ["RestartPolicy", "Supervisor", "emergency_handler",
-           "RESUME_LADDER", "worst_resume_source"]
+__all__ = ["RestartPolicy", "Supervisor", "ReplicaPool",
+           "emergency_handler", "RESUME_LADDER", "worst_resume_source"]
 
 # recovery rungs from cheapest to most degraded — a multi-rank launch
 # reports its WORST rung (the one that actually bounded the restart)
@@ -338,6 +338,154 @@ class Supervisor:
             telemetry.record_event("supervisor", name, **data)
         except Exception:
             pass
+
+
+class ReplicaPool:
+    """Per-replica supervision for a serving fleet.
+
+    The gang :class:`Supervisor` restarts ONE child and treats its death
+    as the whole job's death — right for SPMD training, wrong for a
+    lease-routed serving fleet where replica death is routine and the
+    frontend has already fenced + failed the work over by the time a
+    relaunch matters.  This pool runs N named replica subprocesses, each
+    with its OWN bounded :class:`RestartPolicy` budget: one replica crash
+    -looping exhausts only its own budget; the others keep serving.
+
+    ``-SIGKILL`` is a restart code by default (unlike the gang
+    supervisor): an externally killed replica (preemption, OOM killer,
+    chaos) relaunches, adopts a bumped fencing epoch
+    (:func:`paddle_tpu.serving.fleet.adopt_epoch`) and takes new traffic,
+    while the dead incarnation's work replays on survivors.  Exit 0 means
+    the replica was asked to stop (frontend ``stop`` command) and is NOT
+    relaunched."""
+
+    def __init__(self, policy: Optional[RestartPolicy] = None,
+                 restart_codes: Sequence[int] = (ELASTIC_EXIT_CODE, -9),
+                 env: Optional[dict] = None):
+        self.policy = policy or RestartPolicy()
+        self.restart_codes = tuple(restart_codes)
+        self.env = env
+        self._argv: dict = {}          # name -> argv list
+        self._envs: dict = {}          # name -> per-replica env overlay
+        self._logs: dict = {}          # name -> log path (append per spawn)
+        self._procs: dict = {}         # name -> live Popen
+        self._backoff_until: dict = {} # name -> wall time to respawn at
+        self.restarts: dict = {}       # name -> relaunch count
+        self.exit_codes: dict = {}     # name -> [codes]
+        self.given_up: set = set()
+        self.done: set = set()         # exited 0 (asked to stop)
+        self._stopping = False
+
+    def add(self, name: str, argv: Sequence[str],
+            env: Optional[dict] = None,
+            log_path: Optional[str] = None) -> None:
+        self._argv[str(name)] = list(argv)
+        self._envs[str(name)] = dict(env or {})
+        self._logs[str(name)] = log_path
+        self.restarts.setdefault(str(name), 0)
+        self.exit_codes.setdefault(str(name), [])
+
+    def start(self) -> None:
+        for name in self._argv:
+            if name not in self._procs:
+                self._spawn(name)
+
+    def _spawn(self, name: str) -> None:
+        env = dict(self.env) if self.env is not None else dict(os.environ)
+        env.update(self._envs.get(name, ()))
+        env["PADDLE_TPU_SERVE_REPLICA"] = name
+        kw = {}
+        log_f = None
+        if self._logs.get(name):
+            log_f = open(self._logs[name], "a")
+            kw = {"stdout": log_f, "stderr": subprocess.STDOUT}
+        try:
+            self._procs[name] = subprocess.Popen(self._argv[name], env=env,
+                                                 **kw)
+        finally:
+            if log_f is not None:
+                log_f.close()   # the child holds its own dup of the fd
+        self._event("replica_spawn", replica=name,
+                    pid=self._procs[name].pid,
+                    attempt=self.restarts.get(name, 0))
+
+    def poll_once(self, now: Callable[[], float] = time.time) -> None:
+        """One non-blocking pass: reap exited replicas, schedule/execute
+        backed-off relaunches.  The caller's loop (launcher main, test)
+        owns the cadence."""
+        for name, proc in list(self._procs.items()):
+            rc = proc.poll()
+            if rc is None:
+                continue
+            del self._procs[name]
+            self.exit_codes[name].append(rc)
+            if self._stopping:
+                continue
+            if rc == 0:
+                self.done.add(name)
+                self._event("replica_done", replica=name)
+            elif rc in self.restart_codes and \
+                    self.restarts[name] < self.policy.max_restarts:
+                self.restarts[name] += 1
+                delay = self.policy.delay(self.restarts[name])
+                self._backoff_until[name] = now() + delay
+                self._event("replica_restart", replica=name, exit_code=rc,
+                            attempt=self.restarts[name],
+                            backoff_s=round(delay, 3))
+            else:
+                self.given_up.add(name)
+                self._event("replica_giveup", replica=name, exit_code=rc,
+                            restarts=self.restarts[name])
+        for name, t in list(self._backoff_until.items()):
+            if now() >= t:
+                del self._backoff_until[name]
+                self._spawn(name)
+
+    def alive(self) -> List[str]:
+        return sorted(n for n, p in self._procs.items() if p.poll() is None)
+
+    def all_exited(self) -> bool:
+        return not self._procs and not self._backoff_until
+
+    def run(self, poll_interval: float = 0.2,
+            until: Optional[Callable[[], bool]] = None) -> dict:
+        """Poll until every replica exited for good (done or gave up), or
+        ``until()`` goes true.  Returns {name: last exit code}."""
+        while True:
+            self.poll_once()
+            if until is not None and until():
+                break
+            if self.all_exited():
+                break
+            time.sleep(poll_interval)
+        return {n: (codes[-1] if codes else None)
+                for n, codes in self.exit_codes.items()}
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """TERM every replica, escalate to KILL past ``timeout``."""
+        self._stopping = True
+        self._backoff_until.clear()
+        for proc in self._procs.values():
+            try:
+                proc.terminate()
+            except OSError:
+                pass
+        deadline = time.time() + timeout
+        for name, proc in list(self._procs.items()):
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                try:
+                    proc.kill()
+                    proc.wait(timeout=5)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+            self.exit_codes[name].append(proc.returncode)
+            del self._procs[name]
+
+    @staticmethod
+    def _event(name: str, **data) -> None:
+        Supervisor._event(name, **data)
 
 
 def emergency_handler(get_state: Callable[[], dict], ckpt_root: str,

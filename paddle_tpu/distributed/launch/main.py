@@ -36,6 +36,14 @@ the committed disk checkpoint instead of silently resuming torn state.
 Coordinated exits (the poison-poll's 101) keep their holdings: the "host"
 is fine, only the process restarts.
 
+Serving mode (``--mode serve``, env ``PADDLE_TPU_LAUNCH_MODE``): the same
+store + depot hosting, but the children are serving replicas
+(:func:`paddle_tpu.serving.fleet.run_replica`) supervised by a
+:class:`~..fleet.elastic.supervisor.ReplicaPool` — per-replica bounded
+relaunch instead of first-failure pod teardown, because a lease-routed
+frontend fences a dead replica and replays its work on survivors while
+the relaunch (new fencing epoch) takes new traffic.
+
 On TPU the normal deployment is ONE process per host owning all local chips
 (`--nproc_per_node 1`, the default); multi-process-per-host is used by the
 CPU "fake cluster" tests."""
@@ -81,6 +89,16 @@ def _parse(argv):
                    help="directory for per-rank workerlog.N files")
     p.add_argument("--job_id", type=str, default="default",
                    help="job name tag (reference parity)")
+    p.add_argument("--mode", choices=("train", "serve"),
+                   default=os.environ.get("PADDLE_TPU_LAUNCH_MODE", "train"),
+                   help="train: SPMD gang (first failure tears the pod "
+                        "down); serve: fleet of serving replicas with "
+                        "per-replica relaunch (a dead replica restarts "
+                        "alone while the frontend fails its work over)")
+    p.add_argument("--max_replica_restarts", type=int,
+                   default=int(os.environ.get(
+                       "PADDLE_TPU_SERVE_MAX_RESTARTS", "5")),
+                   help="serve mode: per-replica relaunch budget")
     p.add_argument("--fault_domain", choices=("on", "off"),
                    default=("off" if os.environ.get(
                        "PADDLE_TPU_FAULT_DOMAIN", "1") in ("0", "false")
@@ -263,6 +281,20 @@ def launch(argv=None) -> int:
             snap = None
     os.makedirs(args.log_dir, exist_ok=True)
 
+    if args.mode == "serve":
+        # serving pod: same store + depot hosting as a training pod (the
+        # depot doubles as the fleet's journal depot), but supervision is
+        # PER REPLICA — no gang poisoning, no first-failure teardown
+        try:
+            return _serve_pod(args, node_rank, fleet_store_addr, snap)
+        finally:
+            if watch is not None:
+                watch.stop()
+            if snap is not None:
+                snap.stop()
+            if fleet_store is not None:
+                fleet_store.close()
+
     grace = 10.0
     try:
         grace = float(os.environ.get("PADDLE_TPU_TEARDOWN_GRACE", grace))
@@ -416,6 +448,53 @@ def launch(argv=None) -> int:
             snap.stop()
         if fleet_store is not None:
             fleet_store.close()
+    return rc
+
+
+def _serve_pod(args, node_rank: int, fleet_store_addr: Optional[str],
+               snap) -> int:
+    """Serve-mode watch loop: ``nproc_per_node`` replica children under a
+    :class:`~..fleet.elastic.supervisor.ReplicaPool`.  Each child gets the
+    fleet env contract (``PADDLE_TPU_FLEET_STORE`` for its heartbeat
+    lease, ``PADDLE_TPU_SNAP_STORE`` for journal shipping,
+    ``PADDLE_TPU_SERVE_REPLICA`` for its stable name) and is expected to
+    call :func:`paddle_tpu.serving.fleet.run_replica`.  A SIGKILL'd or
+    101-exiting replica relaunches alone with backoff and adopts a fresh
+    fencing epoch; exit 0 (frontend said stop) retires it."""
+    from ..fleet.elastic.supervisor import ReplicaPool, RestartPolicy
+
+    pool = ReplicaPool(
+        policy=RestartPolicy(max_restarts=args.max_replica_restarts),
+        restart_codes=(101, -signal.SIGKILL, -signal.SIGTERM))
+    for local in range(args.nproc_per_node):
+        name = f"replica{node_rank * args.nproc_per_node + local}"
+        env = {
+            "PADDLE_JOB_ID": args.job_id,
+            "PADDLE_LOCAL_RANK": str(local),
+            **({"PADDLE_TPU_FLEET_STORE": fleet_store_addr}
+               if fleet_store_addr else {}),
+            **({"PADDLE_TPU_SNAP_STORE": snap.addr} if snap else {}),
+        }
+        pool.add(name,
+                 [sys.executable, "-u", args.script, *args.script_args],
+                 env=env,
+                 log_path=os.path.join(args.log_dir, f"{name}.log"))
+    _record_event("serve_pod_start", replicas=args.nproc_per_node,
+                  node_rank=node_rank)
+    rc = 0
+    try:
+        pool.start()
+        while not pool.all_exited():
+            pool.poll_once()
+            time.sleep(0.2)
+        if pool.given_up:
+            rc = 101   # at least one replica burned its relaunch budget
+    except KeyboardInterrupt:
+        rc = 130
+    finally:
+        pool.stop()
+        _record_event("serve_pod_done", given_up=sorted(pool.given_up),
+                      restarts=dict(pool.restarts), rc=rc)
     return rc
 
 

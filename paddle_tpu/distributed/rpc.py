@@ -15,9 +15,12 @@ boundary (advisor round 4): by default rank 0 mints the secret and
 publishes it through the UNAUTHENTICATED TCPStore rendezvous, so the HMAC
 only protects against peers who cannot reach the rendezvous master — any
 process that can talk to the master endpoint during init can read the
-secret.  For a stronger boundary set ``PADDLE_RPC_SECRET`` (hex string) in
-every worker's environment; the secret then never transits the store and
-reaching the master is NOT enough to forge frames. The server
+secret.  For a stronger boundary set ``PADDLE_RPC_SECRET`` (hex string,
+**at least 32 characters** — enforced) in every worker's environment; the
+secret then never transits the store and reaching the master is NOT enough
+to forge frames.  The cross-rank consistency check publishes only an HMAC
+of the secret keyed by a per-job random nonce — never a deterministic
+fingerprint an observer of the store could brute-force offline. The server
 binds to the interface that routes to the rendezvous master (or
 ``PADDLE_LOCAL_IP``), not 0.0.0.0, and the same address is advertised to
 peers (``gethostbyname(gethostname())`` resolves to 127.0.1.1 on some
@@ -197,11 +200,18 @@ def init_rpc(name: str, rank: Optional[int] = None,
             raise RuntimeError(
                 "PADDLE_RPC_SECRET is set on this rank but not on rank 0 — "
                 "set it everywhere or nowhere")
+        import secrets as _secrets
+
         if env_secret:
+            if len(env_secret) < 32:
+                raise RuntimeError(
+                    "PADDLE_RPC_SECRET must be at least 32 characters (its "
+                    "digest crosses the UNAUTHENTICATED job store for the "
+                    "consistency check below, so a short secret would be "
+                    "exposed to offline guessing) — use e.g. "
+                    "`openssl rand -hex 32`")
             secret = env_secret.encode()
         else:
-            import secrets as _secrets
-
             if node_rank == 0:
                 store.set("rpc/secret", _secrets.token_hex(32).encode())
             store.wait(["rpc/secret"], timeout=_DEFAULT_RPC_TIMEOUT * 10)
@@ -209,10 +219,22 @@ def init_rpc(name: str, rank: Optional[int] = None,
         # consistency check: a PARTIAL PADDLE_RPC_SECRET deployment (some
         # ranks env, some store) would otherwise degrade to silent dropped
         # frames / timeouts — every rank publishes a digest of the secret
-        # it will actually use, rank 0's is the reference
+        # it will actually use, rank 0's is the reference.  The digest is
+        # keyed by a PER-JOB RANDOM NONCE (never a bare hash of the
+        # secret): anything published on the unauthenticated store is
+        # readable by anyone who can reach it, and a deterministic
+        # fingerprint of a human-chosen secret would hand out a free
+        # offline brute-force target.  The nonce makes each job's digest
+        # unlinkable across jobs and useless without the nonce's window.
         import hashlib as _hashlib
+        import hmac as _hmac
 
-        digest = _hashlib.sha256(b"rpc-secret-check:" + secret).hexdigest()
+        if node_rank == 0:
+            store.set("rpc/secret_nonce", _secrets.token_hex(16).encode())
+        store.wait(["rpc/secret_nonce"], timeout=_DEFAULT_RPC_TIMEOUT * 10)
+        nonce = bytes(store.get("rpc/secret_nonce"))
+        digest = _hmac.new(secret, b"rpc-secret-check:" + nonce,
+                           _hashlib.sha256).hexdigest()
         if node_rank == 0:
             store.set("rpc/secret_digest", digest.encode())
         store.wait(["rpc/secret_digest"], timeout=_DEFAULT_RPC_TIMEOUT * 10)

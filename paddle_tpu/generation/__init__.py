@@ -251,8 +251,16 @@ class GenerationMixin:
         prompts share compiled programs instead of compiling one per
         length (the reference absorbs ragged prompts in its paged
         block_multi_head_attention cache; here the static-cache program
-        is reused via the left-pad machinery, so outputs are
-        row-identical to the unbucketed decode).
+        is reused via the left-pad machinery).  Mask semantics make the
+        bucketed decode TOKEN-equivalent to the unbucketed one, but not
+        bit-identical on accelerators: padding changes which prefill
+        kernel the gate picks (a bucketed prompt can take the dense
+        masked einsum where the unbucketed one takes flash) and with it
+        the accumulation order, so logits agree only to numerical
+        tolerance — argmax ties at float precision can in principle
+        resolve differently.  Exactness tests compare greedy TOKENS on
+        CPU (where both paths share one kernel) and logits to tolerance
+        elsewhere.
 
         ``input_ids``: int Tensor/array [batch, prompt_len].  Batched
         ragged prompts use LEFT padding + ``attention_mask`` ([batch,

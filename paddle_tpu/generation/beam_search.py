@@ -95,9 +95,14 @@ def beam_search_loop(step_fn: Callable, caches, first_logits,
             cand_ids, tok[:, :, None], t, axis=2)
         is_eos = tok == eos if eos >= 0 else jnp.zeros_like(tok, bool)
 
-        # bank merge: eos-candidates length-normalized at len = t+1
+        # bank merge: eos-candidates length-normalized at len = t+1.
+        # Gate on the candidate actually being ALIVE: a dead beam carries
+        # run_score ~ _NEG, and its "eos candidate" score _NEG/(t+1)^lp
+        # can clear the bank_full threshold (_NEG/2) once t is large
+        # enough — latching `done` with garbage hypotheses.  This bites
+        # whenever dead beams exist, e.g. vocab V <= num_beams at step 0.
         pen = top_scores / jnp.power(jnp.float32(t + 1), lp)
-        eos_pen = jnp.where(is_eos, pen, _NEG)
+        eos_pen = jnp.where((top_scores > _NEG / 2) & is_eos, pen, _NEG)
         merged_scores = jnp.concatenate([bank_scores, eos_pen], axis=1)
         merged_ids = jnp.concatenate([bank_ids, cand_ids], axis=1)
         new_bank_scores, sel = jax.lax.top_k(merged_scores, K)

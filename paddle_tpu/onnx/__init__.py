@@ -24,7 +24,15 @@ def export(layer, path: str, input_spec: Optional[Sequence] = None,
 
     ``format="onnx"`` writes ``{path}.onnx``; ``format="stablehlo"``
     delegates to ``jit.save``.  ``input_spec`` must carry CONCRETE shapes
-    for the onnx path (dim_param-style dynamic dims are not emitted)."""
+    for the onnx path (dim_param-style dynamic dims are not emitted).
+
+    ``opset_version``: the emitter targets **opset 18** and that is what
+    the file always declares.  ``9`` is accepted ONLY as a compatibility
+    alias for the reference API's default signature — it emits the same
+    opset-18 graph and warns loudly (``UserWarning``); it does NOT
+    produce an opset-9 file.  Every other value raises ``ValueError``:
+    silently emitting opset-18 forms under a different requested number
+    would produce files whose declared and actual opsets disagree."""
     if format == "stablehlo":
         from .. import jit
 
@@ -35,15 +43,20 @@ def export(layer, path: str, input_spec: Optional[Sequence] = None,
     if not input_spec:
         raise ValueError("onnx export needs input_spec (concrete shapes)")
     if opset_version not in (9, 18):  # 9 = reference default signature
-        raise ValueError(f"opset_version={opset_version}: this emitter "
-                         "targets opset 18")
+        raise ValueError(
+            f"opset_version={opset_version} is not supported: this emitter "
+            "targets opset 18 (the only value it can emit honestly); 9 is "
+            "accepted as a compatibility alias for the reference default "
+            "and also emits opset 18")
     if opset_version != 18:
-        import logging
+        import warnings
 
-        logging.getLogger("paddle_tpu.onnx").warning(
-            "opset_version=%s requested but emission targets opset 18 "
-            "(ReduceMax/Squeeze/Slice use axes-as-input forms)",
-            opset_version)
+        warnings.warn(
+            f"opset_version={opset_version} is a compatibility alias: the "
+            "emitted file targets and declares opset 18 (ReduceMax/"
+            "Squeeze/Slice use axes-as-input forms) — pass "
+            "opset_version=18 to silence this",
+            UserWarning, stacklevel=2)
 
     import jax.numpy as jnp
 

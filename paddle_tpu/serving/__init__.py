@@ -24,20 +24,35 @@ and a decode-loop watchdog.
                         deadline=Deadline(ttft_s=2.0, total_s=30.0))
     outputs = engine.run()          # {rid: generated token array}
     engine.meter.summary()          # ttft_ms_p99, deadline_miss_rate, ...
-"""
+
+ISSUE-12 scales this to a FLEET: :class:`ServingFrontend` routes across N
+replicas (:class:`Router` — least-loaded, deadline-aware spill), replica
+membership rides heartbeat leases, every replica ships its journal to the
+launcher's depot at the flush boundary that gates emission, and a dead
+replica's work is fenced, folded and replayed on survivors with delivered
+high-water marks primed — exactly-once tokens across replica death (see
+:mod:`.fleet`)."""
 
 from .kv_pool import PagedKVPool, PoolExhausted, TRASH_PAGE, \
     default_page_tokens  # noqa: F401
-from .metrics import RequestClock, SLOMeter  # noqa: F401
+from .metrics import FleetMeter, RequestClock, SLOMeter  # noqa: F401
 from .admission import (AdmissionController, CircuitBreaker, Deadline,  # noqa: F401
                         Overloaded)
 from .journal import JournalState, ServingJournal, TokenSink  # noqa: F401
 from .engine import Request, ServingEngine, check_decode_donation  # noqa: F401
+from .router import ReplicaStatus, Router  # noqa: F401
+from .fleet import (EngineReplica, LocalKV, RemoteReplica,  # noqa: F401
+                    ReplicaServer, ServingFrontend, TokenCollector,
+                    fold_depot_journal, run_replica)
 
 __all__ = [
     "PagedKVPool", "PoolExhausted", "TRASH_PAGE", "default_page_tokens",
-    "RequestClock", "SLOMeter",
+    "RequestClock", "SLOMeter", "FleetMeter",
     "AdmissionController", "CircuitBreaker", "Deadline", "Overloaded",
     "JournalState", "ServingJournal", "TokenSink",
     "Request", "ServingEngine", "check_decode_donation",
+    "ReplicaStatus", "Router",
+    "EngineReplica", "LocalKV", "RemoteReplica", "ReplicaServer",
+    "ServingFrontend", "TokenCollector", "fold_depot_journal",
+    "run_replica",
 ]

@@ -175,7 +175,7 @@ class ServingEngine:
                  lint: Optional[bool] = None,
                  max_queue: Optional[int] = None,
                  admission: Optional[AdmissionController] = None,
-                 journal=None, on_token=None, now=None):
+                 journal=None, journal_ship=None, on_token=None, now=None):
         import jax.numpy as jnp
 
         base = getattr(model, "llama", None)
@@ -200,8 +200,12 @@ class ServingEngine:
         self.meter = SLOMeter(now=self._now)
         self.admission = admission if admission is not None else \
             AdmissionController(max_queue=max_queue, now=self._now)
+        # journal_ship: optional ``ship(seq, data)`` — a fleet replica
+        # wires the depot put here so segments replicate off-host at the
+        # same flush boundary that gates token emission (fleet.py)
         self.journal: Optional[ServingJournal] = \
-            ServingJournal(journal) if isinstance(journal, str) else journal
+            ServingJournal(journal, ship=journal_ship) \
+            if isinstance(journal, str) else journal
         self._on_token = on_token
         self._lint = (os.environ.get("PADDLE_TPU_SERVE_LINT", "1") != "0"
                       if lint is None else bool(lint))
@@ -241,12 +245,20 @@ class ServingEngine:
     def submit(self, prompt, max_new_tokens: int = 64,
                eos_token_id: Optional[int] = None, *,
                deadline: Optional[Deadline] = None,
-               rid: Optional[int] = None) -> int:
+               rid: Optional[int] = None,
+               delivered_tokens: Optional[List[int]] = None,
+               age_s: float = 0.0) -> int:
         """Admit a request or refuse it.  Raises ``ValueError`` for a
         request the engine could NEVER serve (malformed, or worst-case
         page demand beyond the whole pool), :class:`Overloaded` for a
         request it cannot serve NOW (bounded queue full, circuit breaker
-        open) — the latter carries ``retry_after_s``."""
+        open) — the latter carries ``retry_after_s``.
+
+        ``delivered_tokens`` / ``age_s`` are the fleet failover hooks: a
+        request replayed from a dead replica arrives with the tokens its
+        client already saw (delivered high-water mark — regenerated but
+        not re-emitted) and the wall-clock age it accrued there (deadlines
+        keep aging across the failover)."""
         r = Request(prompt, max_new_tokens, eos_token_id, rid=rid)
         if rid is not None and (
                 rid in self._results or rid in self.shed or
@@ -279,6 +291,9 @@ class ServingEngine:
             self.meter.reject(reason=e.reason,
                               retry_after_s=e.retry_after_s)
             raise
+        if delivered_tokens:
+            r.delivered = len(delivered_tokens)
+            r.delivered_tokens = [int(t) for t in delivered_tokens]
         if self.journal is not None:
             # accepted work becomes durable at the admission boundary —
             # BEFORE the request is queued, so a flush failure leaves
@@ -286,12 +301,49 @@ class ServingEngine:
             # seeing an error) nor a ghost journal record (replayed after
             # a crash despite never being accepted)
             self.journal.submit_durable(r.rid, r.prompt, r.max_new_tokens,
-                                        r.eos_token_id, r.deadline)
+                                        r.eos_token_id, r.deadline,
+                                        primed=r.delivered_tokens or None,
+                                        age_s=age_s)
         self._queue.append(r)
-        self.meter.submit(r.rid)
+        self.meter.submit(r.rid, age_s=age_s)
         self.meter.set_queue_depth(len(self._queue))
         self._work.set()
         return r.rid
+
+    def handback_queued(self) -> List[dict]:
+        """Drain hook: remove every queued-but-UNSTARTED request (nothing
+        delivered yet, not holding pool pages) and return its descriptor
+        so a fleet frontend can re-submit it on another replica.  Each
+        handed-back rid is journaled as shed(``drained``): if THIS replica
+        later dies, its journal fold must not resurrect work that already
+        moved elsewhere.  Active requests are untouched — a draining
+        replica finishes what it started."""
+        out: List[dict] = []
+        for r in list(self._queue):
+            if r.delivered > 0:
+                continue   # an evictee mid-replay: its pages/tokens live
+                # here, let the drain finish it locally
+            try:
+                self._queue.remove(r)
+            except ValueError:
+                continue   # the serve thread admitted it meanwhile
+            # read the clock BEFORE shedding: meter.shed retires it
+            age_s = max(0.0, self._now() - self.meter.clock(r.rid).submit_t)
+            self._shed(r, "drained")
+            out.append({"rid": r.rid,
+                        "prompt": [int(x) for x in r.prompt],
+                        "max_new_tokens": r.max_new_tokens,
+                        "eos_token_id": r.eos_token_id,
+                        "deadline": (None if r.deadline is None
+                                     else r.deadline.to_doc()),
+                        "age_s": age_s})
+        if out and self.journal is not None:
+            try:
+                self.journal.flush()
+            except OSError:
+                pass   # shed records stay pending; next step retries
+        self.meter.set_queue_depth(len(self._queue))
+        return out
 
     def run(self, max_steps: int = 100000, *, forever: bool = False,
             watchdog_s: Optional[float] = None,

@@ -80,12 +80,17 @@ class ServingJournal:
     threads — without the lock two concurrent flushes would race on the
     same segment number and one thread's records would vanish."""
 
-    def __init__(self, root: str):
+    def __init__(self, root: str, ship=None):
         self.root = str(root)
         os.makedirs(self.root, exist_ok=True)
         self._lock = threading.Lock()
         self._pending: List[dict] = []
         self._next_seg = self._scan_next_seg()
+        # optional segment shipper ``ship(seq, data)`` — the fleet wires a
+        # depot put here so every flushed segment reaches the launcher's
+        # depot BEFORE the covering tokens can be emitted (depot view >=
+        # client view; see _flush_locked for the ordering contract)
+        self._ship = ship
 
     def _scan_next_seg(self) -> int:
         last = -1
@@ -107,26 +112,39 @@ class ServingJournal:
 
     @staticmethod
     def _submit_record(rid: int, prompt, max_new_tokens: int,
-                       eos_token_id, deadline) -> dict:
-        return {"t": "submit", "rid": int(rid),
-                "prompt": [int(x) for x in prompt],
-                "max_new_tokens": int(max_new_tokens),
-                "eos_token_id": (None if eos_token_id is None
-                                 else int(eos_token_id)),
-                "deadline": (None if deadline is None else
-                             deadline.to_doc()),
-                # wall clock (monotonic doesn't survive a restart): lets
-                # recover() age replayed deadlines by real elapsed time
-                "submit_wall": time.time()}
+                       eos_token_id, deadline, primed=None,
+                       age_s: float = 0.0) -> dict:
+        rec = {"t": "submit", "rid": int(rid),
+               "prompt": [int(x) for x in prompt],
+               "max_new_tokens": int(max_new_tokens),
+               "eos_token_id": (None if eos_token_id is None
+                                else int(eos_token_id)),
+               "deadline": (None if deadline is None else
+                            deadline.to_doc()),
+               # wall clock (monotonic doesn't survive a restart): lets
+               # recover() age replayed deadlines by real elapsed time.
+               # Backdated by age_s so a request that already aged on a
+               # dead replica keeps aging across the failover — and keeps
+               # aging again through a SECOND failover.
+               "submit_wall": time.time() - float(age_s)}
+        if primed:
+            # failover re-submission: tokens the dead replica already
+            # delivered — folded as this rid's starting high-water mark so
+            # THIS journal has no gap before its first deliver record
+            rec["primed"] = [int(x) for x in primed]
+        return rec
 
     def submit(self, rid: int, prompt, max_new_tokens: int,
-               eos_token_id, deadline) -> None:
+               eos_token_id, deadline, primed=None,
+               age_s: float = 0.0) -> None:
         with self._lock:
             self._pending.append(self._submit_record(
-                rid, prompt, max_new_tokens, eos_token_id, deadline))
+                rid, prompt, max_new_tokens, eos_token_id, deadline,
+                primed=primed, age_s=age_s))
 
     def submit_durable(self, rid: int, prompt, max_new_tokens: int,
-                       eos_token_id, deadline) -> None:
+                       eos_token_id, deadline, primed=None,
+                       age_s: float = 0.0) -> None:
         """Record an accepted request and flush it to disk as ONE atomic
         operation.  On a flush failure exactly this record is dropped
         from the buffer (other threads' pending records — e.g. the
@@ -134,7 +152,8 @@ class ServingJournal:
         stay put) and the error propagates: the client sees the refusal
         and no ghost request can be replayed after a crash."""
         rec = self._submit_record(rid, prompt, max_new_tokens,
-                                  eos_token_id, deadline)
+                                  eos_token_id, deadline,
+                                  primed=primed, age_s=age_s)
         with self._lock:
             self._pending.append(rec)
             try:
@@ -167,6 +186,21 @@ class ServingJournal:
         path = os.path.join(self.root, _SEG_FMT.format(self._next_seg))
         data = json.dumps(self._pending).encode()
         write_bytes(path, data, op="serve_journal")
+        if self._ship is not None:
+            try:
+                self._ship(self._next_seg, data)
+            except BaseException:
+                # depot refused (outage OR fence): remove the local
+                # segment so disk and depot agree the flush never
+                # happened — otherwise a crash before the retry would
+                # fold a record the client was told was refused (ghost
+                # submit) or one the depot can't replay.  Records stay
+                # pending; submit_durable additionally unwinds its own.
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                raise
         # buffered records are durable only now; a flush failure above
         # leaves them pending for the next attempt
         self._pending.clear()
@@ -221,7 +255,12 @@ class ServingJournal:
         t, rid = rec.get("t"), rec.get("rid")
         if t == "submit":
             st.requests[rid] = rec
-            st.delivered.setdefault(rid, [])
+            toks = st.delivered.setdefault(rid, [])
+            primed = rec.get("primed") or []
+            if len(primed) > len(toks):
+                # failover re-submission: the dead replica's delivered
+                # high-water mark is this incarnation's starting point
+                st.delivered[rid] = [int(x) for x in primed]
         elif t == "deliver":
             toks = st.delivered.setdefault(rid, [])
             idx = rec["idx"]

@@ -33,7 +33,7 @@ from typing import Dict, List, Optional
 from ..telemetry import record_event
 from ..telemetry.runtime import bump, set_gauge
 
-__all__ = ["RequestClock", "SLOMeter"]
+__all__ = ["RequestClock", "SLOMeter", "FleetMeter"]
 
 
 def default_slo_window() -> int:
@@ -284,3 +284,46 @@ class SLOMeter:
 
 def _r(x: Optional[float]) -> Optional[float]:
     return None if x is None else round(x, 3)
+
+
+class FleetMeter:
+    """Fleet-level counters/gauges for the multi-replica frontend
+    (:class:`~paddle_tpu.serving.fleet.ServingFrontend`): live replica
+    count, per-replica queue depth, failovers, replayed requests, drain
+    hand-backs.  Same runtime seam as :class:`SLOMeter`, so the fleet
+    story lands in ``telemetry.counters()`` / ``prometheus_text()`` and
+    the flight recorder for free."""
+
+    def __init__(self):
+        self.failovers_total = 0
+        self.replayed_requests_total = 0
+        self.handbacks_total = 0
+        self.live_replicas = 0
+
+    def set_live_replicas(self, n: int) -> None:
+        self.live_replicas = int(n)
+        set_gauge("serving.fleet_live_replicas", float(n))
+
+    def set_replica_queue_depth(self, name: str, depth: int) -> None:
+        set_gauge(f"serving.fleet_queue_depth.{name}", float(depth))
+
+    def failover(self, name: str, replayed: int = 0) -> None:
+        self.failovers_total += 1
+        self.replayed_requests_total += int(replayed)
+        bump("serving.fleet_failovers_total")
+        if replayed:
+            bump("serving.fleet_requests_replayed_total", int(replayed))
+        record_event("serve_fleet_failover", str(name),
+                     replayed=int(replayed))
+
+    def handback(self, name: str, moved: int = 0) -> None:
+        self.handbacks_total += int(moved)
+        if moved:
+            bump("serving.fleet_handbacks_total", int(moved))
+        record_event("serve_fleet_drain", str(name), moved=int(moved))
+
+    def summary(self) -> Dict[str, object]:
+        return {"live_replicas": self.live_replicas,
+                "failovers": self.failovers_total,
+                "replayed_requests": self.replayed_requests_total,
+                "handbacks": self.handbacks_total}

@@ -1,0 +1,96 @@
+"""Replica selection for the serving fleet frontend.
+
+Pure policy, no I/O: the frontend snapshots replica health (lease
+payloads published by each replica) into :class:`ReplicaStatus` rows and
+asks :class:`Router` to pick one.  Policy is least-loaded with
+deadline-aware spill:
+
+- **least-loaded** — smallest ``(queue_depth + active) / capacity``;
+  ties break on name for determinism.
+- **deadline-aware spill** — a replica whose measured
+  ``est_first_token_s`` cannot meet the request's remaining TTFT budget
+  is skipped, so latency-sensitive traffic spills toward replicas that
+  can still make the SLO.  When NO replica can, the pick falls back to
+  the least-loaded one anyway: the estimate is a trailing measurement
+  (often stale right after a load shift), and the engine's own
+  admission/shed machinery is the authoritative judge — shedding there
+  is accounted, shedding here silently would not be.
+- **draining replicas** are never picked (see
+  :meth:`fleet.ServingFrontend.drain`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from .admission import Deadline
+
+__all__ = ["ReplicaStatus", "Router"]
+
+
+@dataclass
+class ReplicaStatus:
+    """One replica's routable view, as published on its heartbeat lease."""
+
+    name: str
+    address: str = ""
+    capacity: int = 1                # queue slots the replica admits
+    queue_depth: int = 0
+    active: int = 0                  # requests holding decode rows
+    est_first_token_s: Optional[float] = None
+    epoch: int = 0                   # fencing incarnation
+    draining: bool = False
+    extra: dict = field(default_factory=dict)
+
+    @property
+    def load(self) -> float:
+        return (self.queue_depth + self.active) / max(1, self.capacity)
+
+    @classmethod
+    def from_doc(cls, name: str, doc: dict) -> "ReplicaStatus":
+        return cls(name=name,
+                   address=str(doc.get("address", "")),
+                   capacity=int(doc.get("capacity", 1)),
+                   queue_depth=int(doc.get("queue_depth", 0)),
+                   active=int(doc.get("active", 0)),
+                   est_first_token_s=doc.get("est_first_token_s"),
+                   epoch=int(doc.get("epoch", 0)),
+                   draining=bool(doc.get("draining", False)))
+
+
+class Router:
+    """Stateless pick over a list of :class:`ReplicaStatus`."""
+
+    def pick(self, replicas: List[ReplicaStatus],
+             deadline: Optional[Deadline] = None, *,
+             age_s: float = 0.0) -> Optional[ReplicaStatus]:
+        """Best replica for one request, or ``None`` when no routable
+        replica exists at all (every one dead or draining)."""
+        cands = [r for r in replicas if not r.draining]
+        if not cands:
+            return None
+        budget = None
+        if deadline is not None and deadline.ttft_s is not None:
+            budget = deadline.ttft_s - age_s
+        if budget is not None:
+            fits = [r for r in cands
+                    if r.est_first_token_s is None
+                    or r.est_first_token_s <= budget]
+            if fits:
+                cands = fits   # spill toward replicas that can make TTFT
+        return min(cands, key=lambda r: (r.load, r.name))
+
+    def order(self, replicas: List[ReplicaStatus],
+              deadline: Optional[Deadline] = None, *,
+              age_s: float = 0.0) -> List[ReplicaStatus]:
+        """All routable replicas, best first — the frontend walks this so
+        a replica-side refusal (``Overloaded``) spills to the next one."""
+        out: List[ReplicaStatus] = []
+        pool = list(replicas)
+        while True:
+            best = self.pick(pool, deadline, age_s=age_s)
+            if best is None:
+                return out
+            out.append(best)
+            pool = [r for r in pool if r.name != best.name]

@@ -133,11 +133,30 @@ def prometheus_text() -> str:
             ("serving.queue_depth", "gauge",
              "Requests waiting for admission"),
             ("serving.kv_pool_occupancy", "gauge",
-             "Fraction of allocatable KV pages in use")):
+             "Fraction of allocatable KV pages in use"),
+            ("serving.fleet_live_replicas", "gauge",
+             "Serving replicas with a fresh heartbeat lease"),
+            ("serving.fleet_failovers_total", "counter",
+             "Replica deaths fenced and failed over by the frontend"),
+            ("serving.fleet_requests_replayed_total", "counter",
+             "Requests replayed onto survivors after a replica death"),
+            ("serving.fleet_handbacks_total", "counter",
+             "Queued requests re-homed by drain"),
+            ("serving.journal_corrupt_segments", "counter",
+             "Serve-journal segments quarantined as corrupt")):
         if name in ctr:
             val = ctr[name] if mtype == "gauge" else int(ctr[name])
             _metric(lines, name.replace(".", "_"), mtype, help_,
                     [(None, val)])
+
+    # per-replica queue depth, labeled by replica name (fleet frontend)
+    qd = sorted((k.split(".", 1)[1].split("fleet_queue_depth.", 1)[1], v)
+                for k, v in ctr.items()
+                if k.startswith("serving.fleet_queue_depth."))
+    if qd:
+        _metric(lines, "serving_fleet_queue_depth", "gauge",
+                "Queue depth per serving replica (from its lease payload)",
+                [({"replica": name}, v) for name, v in qd])
 
     # Pallas gate rejections, labeled by kernel and reason — a silent
     # dense-einsum fallback must be visible on the scrape, not just in a

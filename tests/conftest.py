@@ -63,7 +63,14 @@ for _k, _v in (("PADDLE_TPU_HB_INTERVAL", "0.25"),
                ("PADDLE_TPU_SERVE_BREAKER_THRESHOLD", "3"),
                ("PADDLE_TPU_SERVE_BREAKER_COOLDOWN", "0.2"),
                ("PADDLE_TPU_SERVE_SLO_WINDOW", "256"),
-               ("PADDLE_TPU_SERVE_MAX_STEP_FAILURES", "8")):
+               ("PADDLE_TPU_SERVE_MAX_STEP_FAILURES", "8"),
+               # serving fleet: production lease ttl (10s) and scan cadence
+               # would make the failover chaos e2e wait most of the tier-1
+               # budget on a clock — a dead replica must be fenced and
+               # replayed within ~1-2s on the CPU lane
+               ("PADDLE_TPU_SERVE_FLEET_TTL", "1.0"),
+               ("PADDLE_TPU_SERVE_FLEET_SCAN", "0.2"),
+               ("PADDLE_TPU_SERVE_FLEET_STATUS", "0.1")):
     os.environ.setdefault(_k, _v)
 
 import jax  # noqa: E402

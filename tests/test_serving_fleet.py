@@ -1,0 +1,689 @@
+"""Multi-replica serving fleet (ISSUE 12): lease-routed frontend,
+journal fail-over through the launcher depot, fencing epochs, drain
+hand-back, per-replica supervision, and the process-isolated
+SIGKILL-one-of-three chaos e2e with exactly-once token delivery.
+
+Tier-1 ``serving``/``chaos`` lanes; conftest pins
+``PADDLE_TPU_SERVE_FLEET_*`` (ttl 1.0s, scan 0.2s, status 0.1s) so lease
+expiry -> fence -> fold -> replay resolves in ~1-2s on CPU.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.checkpoint.replicator import (FencedEpoch,
+                                                          SnapshotClient,
+                                                          SnapshotStore)
+from paddle_tpu.distributed.fleet.elastic.supervisor import (ReplicaPool,
+                                                             RestartPolicy)
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.serving import (Deadline, Overloaded, ServingJournal,
+                                TokenSink)
+from paddle_tpu.serving.fleet import (FLEET_HB_PREFIX, EngineReplica,
+                                      JournalShipper, LocalKV,
+                                      RemoteReplica, ServingFrontend,
+                                      TokenCollector, adopt_epoch,
+                                      fold_depot_journal)
+from paddle_tpu.serving.router import ReplicaStatus, Router
+
+pytestmark = [pytest.mark.serving, pytest.mark.chaos]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ENGINE_KW = dict(max_batch=3, page_tokens=8, num_pages=24,
+                 max_pages_per_seq=6)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(3)
+    cfg = llama_tiny(num_hidden_layers=2, vocab_size=96,
+                     max_position_embeddings=128)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture
+def depot():
+    store = SnapshotStore(host="127.0.0.1")
+    client = SnapshotClient("127.0.0.1", store.port)
+    yield client
+    client.close()
+    store.close()
+
+
+def _solo(model, prompt, max_new, eos=None):
+    ids, _ = model.generate(paddle.to_tensor(np.asarray(prompt)[None]),
+                            max_new_tokens=max_new, eos_token_id=eos,
+                            pad_token_id=0 if eos is not None else None)
+    return ids.numpy()[0]
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += float(dt)
+
+
+class FakeReplica:
+    """Handle-surface double for routing/failover unit tests."""
+
+    def __init__(self, name, fail=None):
+        self.name = name
+        self.fail = fail           # None | "oserror" | "overloaded"
+        self.submits = []
+
+    def submit(self, prompt, max_new_tokens=64, eos_token_id=None, *,
+               deadline=None, rid=None, delivered_tokens=None, age_s=0.0):
+        if self.fail == "oserror":
+            raise ConnectionRefusedError("fake transport down")
+        if self.fail == "overloaded":
+            raise Overloaded("fake queue full", reason="queue_full")
+        self.submits.append({"rid": rid, "prompt": list(prompt),
+                             "max_new_tokens": max_new_tokens,
+                             "deadline": deadline,
+                             "delivered": list(delivered_tokens or []),
+                             "age_s": age_s})
+        return rid
+
+    def status(self):
+        return {"queue_depth": 0, "active": 0, "finished": [], "shed": {}}
+
+    def drain(self):
+        return []
+
+    def close(self):
+        pass
+
+
+def _lease(kv, name, *, epoch=1, ttl=1.0, address="inproc", qd=0):
+    kv.put(FLEET_HB_PREFIX + name,
+           {"name": name, "address": address, "capacity": 4,
+            "queue_depth": qd, "active": 0, "est_first_token_s": 0.05,
+            "epoch": epoch, "ttl": ttl})
+
+
+# ---------------------------------------------------------------------------
+class TestRouter:
+    def _st(self, name, **kw):
+        d = dict(address="inproc", capacity=4, queue_depth=0, active=0,
+                 est_first_token_s=0.1, epoch=1, draining=False)
+        d.update(kw)
+        return ReplicaStatus(name=name, **d)
+
+    def test_least_loaded_wins(self):
+        r = Router()
+        picked = r.pick([self._st("a", queue_depth=3),
+                         self._st("b", queue_depth=1)])
+        assert picked.name == "b"
+
+    def test_tie_breaks_on_name(self):
+        r = Router()
+        assert r.pick([self._st("b"), self._st("a")]).name == "a"
+
+    def test_draining_excluded(self):
+        r = Router()
+        picked = r.pick([self._st("a", draining=True), self._st("b")])
+        assert picked.name == "b"
+        assert r.pick([self._st("a", draining=True)]) is None
+
+    def test_deadline_spills_to_faster_replica(self):
+        # "a" is less loaded but too slow for the remaining ttft budget;
+        # the spill prefers "b", which still fits
+        r = Router()
+        picked = r.pick([self._st("a", est_first_token_s=5.0),
+                         self._st("b", queue_depth=2,
+                                  est_first_token_s=0.05)],
+                        Deadline(ttft_s=1.0), age_s=0.5)
+        assert picked.name == "b"
+
+    def test_all_spilled_falls_back_to_least_loaded(self):
+        # nobody fits the budget: routing still picks someone (the
+        # replica-side shedder is the authority on hopeless deadlines)
+        r = Router()
+        picked = r.pick([self._st("a", est_first_token_s=5.0),
+                         self._st("b", queue_depth=2,
+                                  est_first_token_s=5.0)],
+                        Deadline(ttft_s=0.1), age_s=0.05)
+        assert picked.name == "a"
+
+    def test_order_walks_every_candidate_once(self):
+        r = Router()
+        sts = [self._st("a", queue_depth=2), self._st("b"),
+               self._st("c", draining=True)]
+        assert [s.name for s in r.order(sts, None)] == ["b", "a"]
+
+
+# ---------------------------------------------------------------------------
+class TestDepotJournal:
+    def test_roundtrip_fence_and_zombie_refusal(self, depot):
+        depot.journal_put("r0", 1, 0, b'[{"t":"finish","rid":0}]')
+        depot.journal_put("r0", 1, 1, b'[{"t":"finish","rid":1}]')
+        got = depot.journal_fetch("r0", 1)
+        assert [s for s, _ in got] == [0, 1]
+        assert depot.fence("r0", 2) == 2
+        before = len(depot.journal_index("r0", epoch=1)["segments"])
+        with pytest.raises(FencedEpoch):
+            depot.journal_put("r0", 1, 2, b"[]")
+        # the refused put changed nothing
+        assert len(depot.journal_index("r0", epoch=1)["segments"]) == before
+        # the NEW incarnation's epoch still writes
+        depot.journal_put("r0", 2, 0, b"[]")
+
+    def test_fence_is_monotonic(self, depot):
+        assert depot.fence("m", 3) == 3
+        assert depot.fence("m", 1) == 3   # never lowers
+        assert depot.fence_epoch("m") == 3
+
+    def test_adopt_epoch_fences_predecessor(self, depot):
+        e1 = adopt_epoch(depot, "n")
+        assert e1 == 1
+        depot.journal_put("n", e1, 0, b"[]")
+        # fast relaunch: the frontend never saw the death, but the new
+        # incarnation fences the old one at startup all the same
+        e2 = adopt_epoch(depot, "n")
+        assert e2 == e1 + 1
+        with pytest.raises(FencedEpoch):
+            depot.journal_put("n", e1, 1, b"[]")
+        depot.journal_put("n", e2, 0, b"[]")
+
+    def test_retention_prunes_whole_old_epochs(self, depot):
+        for ep in (1, 2, 3):
+            depot.journal_put("old", ep, 0, b"[]")
+            depot.journal_put("old", ep, 1, b"[]")
+        # keep-N retention drops epoch 1 entirely, never single segments
+        assert depot.journal_index("old", epoch=1)["segments"] == []
+        assert len(depot.journal_index("old", epoch=2)["segments"]) == 2
+        assert len(depot.journal_index("old", epoch=3)["segments"]) == 2
+
+    def test_fenced_flush_unwinds_local_segment(self, depot, tmp_path):
+        j = ServingJournal(str(tmp_path / "z"),
+                           ship=JournalShipper(depot, "z", 1))
+        j.record("submit", rid=0, prompt=[1, 2], max_new_tokens=2,
+                 eos_token_id=None, deadline=None, submit_wall=0.0)
+        j.flush()
+        assert len(j.segments()) == 1
+        depot.fence("z", 2)            # the frontend declared us dead
+        j.deliver(0, 0, 42)
+        with pytest.raises(FencedEpoch):
+            j.flush()
+        # local disk and depot agree the flush never happened: no ghost
+        # segment a later fold could disagree with the client about
+        assert len(j.segments()) == 1
+        assert j.pending == 1
+        assert len(depot.journal_index("z", epoch=1)["segments"]) == 1
+
+    def test_fold_depot_journal_stops_at_gap(self, depot):
+        recs = '[{"t":"submit","rid":7,"prompt":[1],"max_new_tokens":3,' \
+               '"eos_token_id":null,"deadline":null,"submit_wall":0.0}]'
+        depot.journal_put("g", 1, 0, recs.encode())
+        depot.journal_put("g", 1, 2, b'[{"t":"finish","rid":7}]')  # hole at 1
+        st = fold_depot_journal(depot, "g", 1)
+        assert st.truncated and st.segments_read == 1
+        assert 7 in st.requests and 7 not in st.finished
+        assert st.open_rids() == [7]
+
+
+# ---------------------------------------------------------------------------
+class TestLeaseFailover:
+    """Fake-clock lease-expiry unit: no engines, no real time."""
+
+    def _frontend(self, depot, clock, sink):
+        kv = LocalKV(now=clock)
+        fe = ServingFrontend(kv, depot, sink=sink, ttl=1.0,
+                             auto_attach=False, wall=clock)
+        return kv, fe
+
+    def test_expiry_fences_folds_reoffers_and_replays(self, depot,
+                                                      tmp_path):
+        clock = FakeClock(1000.0)
+        got = []
+        kv, fe = self._frontend(depot, clock,
+                                lambda rid, idx, tok: got.append(
+                                    (rid, idx, tok)))
+        _lease(kv, "a", epoch=1)
+        _lease(kv, "b", epoch=1)
+        b = FakeReplica("b")
+        fe.attach(b)
+        # the dead replica's depot ledger: rid 0 mid-stream (2 tokens
+        # delivered, submitted 3s ago), rid 1 accepted but unstarted
+        j = ServingJournal(str(tmp_path / "a"),
+                           ship=JournalShipper(depot, "a", 1))
+        j.record("submit", rid=0, prompt=[5, 6, 7], max_new_tokens=4,
+                 eos_token_id=None, deadline=None, submit_wall=clock.t - 3.0)
+        j.deliver(0, 0, 11)
+        j.deliver(0, 1, 12)
+        j.flush()
+        j.record("submit", rid=1, prompt=[8, 9], max_new_tokens=3,
+                 eos_token_id=None, deadline=None, submit_wall=clock.t - 1.0)
+        j.flush()
+
+        assert fe.scan_once() == []          # fresh leases: nobody dies
+        clock.advance(1.5)                   # a's lease expires...
+        kv.touch(FLEET_HB_PREFIX + "b")      # ...b kept beating
+        assert fe.scan_once() == ["a"]
+        # fenced at the depot: the zombie's late flush is refused
+        assert depot.fence_epoch("a") == 2
+        with pytest.raises(FencedEpoch):
+            JournalShipper(depot, "a", 1)(99, b"[]")
+        # journaled tokens re-offered through the sink (flush->emit window)
+        assert got[:2] == [(0, 0, 11), (0, 1, 12)]
+        # both open rids replayed on the survivor: rid 0 with its
+        # delivered high-water mark primed, deadlines still aging from
+        # the ORIGINAL submit wall clock
+        subs = {s["rid"]: s for s in b.submits}
+        assert subs[0]["delivered"] == [11, 12]
+        assert subs[0]["age_s"] == pytest.approx(4.5)   # 3.0 + 1.5 scan
+        assert subs[1]["delivered"] == []
+        assert subs[1]["age_s"] == pytest.approx(2.5)
+        assert fe.failovers == 1 and fe.replayed_requests == 2
+        assert fe.meter.failovers_total == 1
+        assert fe.meter.replayed_requests_total == 2
+        # idempotent: the fenced epoch never fails over twice
+        assert fe.scan_once() == []
+        assert fe.failovers == 1
+
+    def test_epoch_bump_under_fresh_lease_is_a_death(self, depot):
+        clock = FakeClock()
+        kv, fe = self._frontend(depot, clock, None)
+        b = FakeReplica("b")
+        fe.attach(b)
+        _lease(kv, "b", epoch=1)
+        _lease(kv, "a", epoch=1)
+        assert fe.scan_once() == []
+        # replica died and relaunched between scans: the lease never
+        # looked expired but the epoch moved
+        _lease(kv, "a", epoch=3)
+        assert fe.scan_once() == ["a"]
+        assert fe.failovers == 1
+        # only the DEAD incarnation is fenced; epoch 3 still writes
+        assert depot.fence_epoch("a") == 2
+        JournalShipper(depot, "a", 3)(0, b"[]")
+
+    def test_transport_error_spills_without_failover(self, depot):
+        clock = FakeClock()
+        kv, fe = self._frontend(depot, clock, None)
+        _lease(kv, "a", epoch=1, qd=0)   # least loaded: routed first
+        _lease(kv, "b", epoch=1, qd=3)
+        a = FakeReplica("a", fail="oserror")
+        b = FakeReplica("b")
+        fe.attach(a)
+        fe.attach(b)
+        rid = fe.submit([1, 2, 3], max_new_tokens=2)
+        # a slow/unreachable peer is NOT a dead peer: the request spilled
+        # to b and nobody was fenced
+        assert fe.assignments[rid] == "b"
+        assert fe.failovers == 0 and fe._fenced == {}
+        assert depot.fence_epoch("a") == 0
+
+    def test_all_replicas_refusing_raises_overloaded(self, depot):
+        clock = FakeClock()
+        kv, fe = self._frontend(depot, clock, None)
+        _lease(kv, "a", epoch=1)
+        a = FakeReplica("a", fail="overloaded")
+        fe.attach(a)
+        with pytest.raises(Overloaded):
+            fe.submit([1, 2], max_new_tokens=2)
+        assert fe.requests == {}      # the refused rid was unwound
+
+    def test_replay_refused_by_survivors_parks_as_orphan(self, depot,
+                                                         tmp_path):
+        clock = FakeClock(1000.0)
+        kv, fe = self._frontend(depot, clock, None)
+        _lease(kv, "a", epoch=1)
+        _lease(kv, "b", epoch=1)
+        b = FakeReplica("b", fail="overloaded")
+        fe.attach(b)
+        j = ServingJournal(str(tmp_path / "a"),
+                           ship=JournalShipper(depot, "a", 1))
+        j.record("submit", rid=4, prompt=[3], max_new_tokens=2,
+                 eos_token_id=None, deadline=None, submit_wall=clock.t)
+        j.flush()
+        fe.scan_once()
+        clock.advance(1.5)
+        kv.touch(FLEET_HB_PREFIX + "b")
+        assert fe.scan_once() == ["a"]
+        # survivor full RIGHT NOW: accepted work is parked, not dropped
+        assert fe.summary()["orphans"] == 1
+        b.fail = None
+        fe.scan_once()                 # retry drains the orphan onto b
+        assert fe.summary()["orphans"] == 0
+        assert b.submits[0]["rid"] == 4
+        assert fe.assignments[4] == "b"
+
+
+# ---------------------------------------------------------------------------
+class TestDrainHandback:
+    def test_queued_work_moves_active_replica_keeps_lease(self, model,
+                                                          depot, tmp_path):
+        kv = LocalKV()
+        sink = TokenSink(str(tmp_path / "out.jsonl"))
+        fe = ServingFrontend(kv, depot, sink=sink, auto_attach=False)
+        # "a" heartbeats but its serve loop never starts: submissions
+        # stay queued-but-unstarted, exactly what drain must hand back
+        ra = EngineReplica("a", model, store=kv, depot=depot,
+                           journal_root=str(tmp_path / "j"),
+                           on_token=fe.emit, engine_kw=ENGINE_KW)
+        ra.lease.start()
+        fe.attach(ra)
+        rng = np.random.default_rng(7)
+        prompts = [rng.integers(1, 96, 5).astype(np.int32),
+                   rng.integers(1, 96, 8).astype(np.int32)]
+        rids = [fe.submit(p, max_new_tokens=3) for p in prompts]
+        assert all(fe.assignments[r] == "a" for r in rids)
+
+        rb = EngineReplica("b", model, store=kv, depot=depot,
+                           journal_root=str(tmp_path / "j"),
+                           on_token=fe.emit, engine_kw=ENGINE_KW).start()
+        fe.attach(rb)
+        moved = fe.drain("a")
+        assert moved == 2
+        assert ra.engine.shed == {rids[0]: "drained", rids[1]: "drained"}
+        assert all(fe.assignments[r] == "b" for r in rids)
+        assert fe.meter.handbacks_total == 2   # counts requests moved
+        # a drained replica stays a live MEMBER (its lease beats on) but
+        # the router sends it no NEW traffic
+        assert "a" in fe.live_replicas()
+        assert "a" in fe._draining
+        rid3 = fe.submit(prompts[0][:4], max_new_tokens=2)
+        assert fe.assignments[rid3] == "b"
+        # ...and the moved work completes on b, token-exact
+        assert fe.wait_all(rids + [rid3], timeout=90)
+        streams = TokenSink.collect(sink.path)
+        for rid, p in zip(rids, prompts):
+            assert streams[rid] == list(_solo(model, p, 3)), rid
+        # undrain: the relaunched/healthy replica is routable again
+        fe.undrain("a")
+        assert "a" not in fe._draining
+        ra.lease.stop(release=True)
+        rb.stop()
+        fe.stop()
+        sink.close()
+
+
+# ---------------------------------------------------------------------------
+class TestDoubleFault:
+    def test_replica_crash_and_frontend_restart_same_window(self, model,
+                                                            depot,
+                                                            tmp_path):
+        kv = LocalKV()
+        sink = TokenSink(str(tmp_path / "out.jsonl"))
+        fe = ServingFrontend(kv, depot, sink=sink, auto_attach=False)
+        crash = {"n": 0}
+
+        def crashing_emit(rid, idx, tok):
+            fe.emit(rid, idx, tok)
+            crash["n"] += 1
+            if crash["n"] >= 3:
+                raise RuntimeError("injected replica crash mid-stream")
+
+        ra = EngineReplica("a", model, store=kv, depot=depot,
+                           journal_root=str(tmp_path / "j"),
+                           on_token=crashing_emit, engine_kw=ENGINE_KW)
+        fe.attach(ra)
+        ra.start()
+        rng = np.random.default_rng(5)
+        prompts = [rng.integers(1, 96, 6).astype(np.int32),
+                   rng.integers(1, 96, 9).astype(np.int32)]
+        rids = [fe.submit(p, max_new_tokens=5) for p in prompts]
+        deadline = time.monotonic() + 60
+        while ra.error is None and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert ra.error is not None      # the crash fired mid-stream
+        ra.die()                         # lease left to expire (SIGKILL)
+        epoch_a = ra.epoch
+        del fe                           # the frontend dies in the window
+        time.sleep(1.3)                  # ttl 1.0: the lease expires
+
+        # restart: a FRESH frontend over the same store/depot/sink
+        sink2 = TokenSink(str(tmp_path / "out.jsonl"))
+        fe2 = ServingFrontend(kv, depot, sink=sink2, auto_attach=False)
+        rb = EngineReplica("b", model, store=kv, depot=depot,
+                           journal_root=str(tmp_path / "j"),
+                           on_token=fe2.emit, engine_kw=ENGINE_KW).start()
+        fe2.attach(rb)
+        deadline = time.monotonic() + 10
+        while kv.get(FLEET_HB_PREFIX + "b") is None and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        info = fe2.recover()
+        assert "a" in info["failed_over"]
+        assert set(rids) <= set(fe2.requests)
+        assert fe2.wait_all(rids, timeout=90)
+        # exactly-once + token-exact across BOTH faults
+        streams = TokenSink.collect(sink2.path)
+        for rid, p in zip(rids, prompts):
+            assert streams[rid] == list(_solo(model, p, 5)), rid
+        # the dead incarnation stays fenced
+        assert depot.fence_epoch("a") == epoch_a + 1
+        with pytest.raises(FencedEpoch):
+            JournalShipper(depot, "a", epoch_a)(999, b"[]")
+        rb.stop()
+        fe2.stop()
+        sink2.close()
+
+
+# ---------------------------------------------------------------------------
+class TestReplicaPool:
+    def test_restart_retire_giveup_budgets_are_per_replica(self, tmp_path):
+        pool = ReplicaPool(policy=RestartPolicy(max_restarts=2,
+                                                backoff_base=0.01,
+                                                backoff_cap=0.02,
+                                                jitter=0.0),
+                           restart_codes=(101,))
+        pool.add("ok", [sys.executable, "-c", "raise SystemExit(0)"],
+                 log_path=str(tmp_path / "ok.log"))
+        pool.add("flappy", [sys.executable, "-c", "raise SystemExit(101)"],
+                 log_path=str(tmp_path / "flappy.log"))
+        pool.add("bad", [sys.executable, "-c", "raise SystemExit(5)"])
+        pool.start()
+        deadline = time.monotonic() + 60
+        while not pool.all_exited() and time.monotonic() < deadline:
+            pool.poll_once()
+            time.sleep(0.02)
+        assert pool.all_exited()
+        # exit 0 = asked to stop: retired, never relaunched
+        assert "ok" in pool.done and pool.restarts["ok"] == 0
+        # a restart code burns only ITS replica's budget, then gives up
+        assert "flappy" in pool.given_up and pool.restarts["flappy"] == 2
+        assert pool.exit_codes["flappy"] == [101, 101, 101]
+        # an unknown exit code is not relaunched at all
+        assert "bad" in pool.given_up and pool.restarts["bad"] == 0
+        # append-per-spawn logging survived the relaunches
+        assert os.path.exists(str(tmp_path / "flappy.log"))
+        pool.stop()
+
+
+# ---------------------------------------------------------------------------
+class TestBeamSearchDeadBeams:
+    def test_vocab_smaller_than_num_beams(self):
+        """Regression (satellite 1): dead beams carry ~-1e9 scores; under
+        a length penalty their "eos candidates" (-1e9 / (t+1)^lp) used to
+        clear the bank-full threshold (-5e8) and latch `done` with
+        garbage hypotheses.  V <= num_beams guarantees dead beams from
+        step 0."""
+        import jax.numpy as jnp
+        from paddle_tpu.generation.beam_search import beam_search_loop
+
+        V, K, max_new = 3, 4, 4
+        eos = 2
+        base = jnp.log(jnp.asarray([[0.18, 0.80, 0.02]], jnp.float32))
+
+        def step_fn(tok, caches, offset, pad_lens):
+            return jnp.broadcast_to(base, (tok.shape[0], V)), caches
+
+        ids, scores = beam_search_loop(
+            step_fn, jnp.zeros((K, 1)), base, num_beams=K,
+            max_new=max_new, eos=eos, pad=0, length_penalty=2.0,
+            early_stopping=True)
+        ids, scores = np.asarray(ids), np.asarray(scores)
+        assert ids.shape == (1, K, max_new)
+        # no garbage hypotheses: every banked score is a real length-
+        # normalized log-prob, nowhere near the -1e9/(t+1)^lp band
+        assert (scores > -1e6).all(), scores
+        assert ((ids >= 0) & (ids < V)).all()
+        # the best hypothesis is the analytic one: 1, 1, eos
+        lp1, lpe = float(base[0, 1]), float(base[0, eos])
+        np.testing.assert_array_equal(ids[0, 0], [1, 1, eos, 0])
+        assert scores[0, 0] == pytest.approx((2 * lp1 + lpe) / 9.0,
+                                             rel=1e-4)
+
+
+# ---------------------------------------------------------------------------
+CHILD = textwrap.dedent("""
+    import os, sys
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.serving.fleet import run_replica
+
+    work, collector = sys.argv[1], sys.argv[2]
+    paddle.seed(3)
+    cfg = llama_tiny(num_hidden_layers=2, vocab_size=96,
+                     max_position_embeddings=128)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    run_replica(model, collector_addr=collector,
+                journal_root=os.path.join(work, "journals"),
+                engine_kw=dict(max_batch=2, page_tokens=8, num_pages=24,
+                               max_pages_per_seq=6, max_queue=4))
+""")
+
+
+class TestFleetChaosE2E:
+    """Acceptance: 3 subprocess replicas under a mixed-length trace,
+    SIGKILL one mid-stream; the frontend fences within the lease TTL,
+    replays in-flight work on survivors, and every accepted request is
+    token-exact with the sink holding every token exactly once."""
+
+    def test_sigkill_one_of_three_replicas(self, model, tmp_path):
+        from paddle_tpu.distributed.store import TCPStore
+
+        store = TCPStore("127.0.0.1", 0, is_master=True)
+        snapstore = SnapshotStore(host="127.0.0.1")
+        client = SnapshotClient("127.0.0.1", snapstore.port)
+        sink = TokenSink(str(tmp_path / "tokens.jsonl"))
+        fe = ServingFrontend(store, client, sink=sink)
+        coll = TokenCollector(fe)
+        env = {**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+               "PADDLE_TPU_FLEET_STORE": f"127.0.0.1:{store.port}",
+               "PADDLE_TPU_SNAP_STORE": f"127.0.0.1:{snapstore.port}"}
+        procs = {}
+        logs = {}
+        for i in range(3):
+            name = f"r{i}"
+            logs[name] = open(str(tmp_path / f"{name}.log"), "w")
+            procs[name] = subprocess.Popen(
+                [sys.executable, "-c", CHILD, str(tmp_path), coll.address],
+                env={**env, "PADDLE_TPU_SERVE_REPLICA": name},
+                stdout=logs[name], stderr=subprocess.STDOUT)
+        try:
+            deadline = time.monotonic() + 300
+            while time.monotonic() < deadline:
+                fe.scan_once()
+                if len(fe.live_replicas()) == 3:
+                    break
+                time.sleep(0.25)
+            assert len(fe.live_replicas()) == 3, \
+                f"fleet never formed: {fe.live_replicas()}"
+
+            # over-capacity mixed-length trace (3 replicas x max_queue 4).
+            # The FIRST request streams long (36 tokens at one journal
+            # flush + collector push per step) so there is a wide, non-racy
+            # mid-stream window in which to kill its replica.
+            rng = np.random.default_rng(11)
+            dl = Deadline(ttft_s=240.0, total_s=600.0)
+            reqs, rejected = {}, 0
+            long_p = rng.integers(1, 96, 6).astype(np.int32)
+            long_rid = fe.submit(long_p, max_new_tokens=36, deadline=dl)
+            reqs[long_rid] = (long_p, 36)
+            for _ in range(8):
+                p = rng.integers(1, 96,
+                                 int(rng.integers(4, 11))).astype(np.int32)
+                mn = int(rng.integers(3, 7))
+                try:
+                    rid = fe.submit(p, max_new_tokens=mn, deadline=dl)
+                    reqs[rid] = (p, mn)
+                except Overloaded:
+                    rejected += 1
+            assert len(reqs) >= 3
+
+            # wait until the long request is streaming mid-flight, then
+            # SIGKILL the replica that owns it
+            victim = None
+            deadline = time.monotonic() + 300
+            while time.monotonic() < deadline:
+                fe.scan_once()
+                done = fe.finished_rids()
+                if long_rid not in done and sink.delivered(long_rid) >= 3:
+                    victim = fe.assignments[long_rid]
+                    break
+                time.sleep(0.05)
+            assert victim is not None, "no mid-stream open work to kill"
+            vepoch = fe._epochs[victim]
+            procs[victim].kill()
+            procs[victim].wait(timeout=30)
+
+            # lease expiry -> fence -> fold -> replay on the survivors
+            assert fe.wait_all(list(reqs), timeout=420), fe.summary()
+            assert fe.failovers >= 1
+            assert client.fence_epoch(victim) >= vepoch + 1
+            # the zombie's post-fence flush is refused and changes nothing
+            before = len(client.journal_index(victim,
+                                              epoch=vepoch)["segments"])
+            with pytest.raises(FencedEpoch):
+                client.journal_put(victim, vepoch, 10_000, b"[]")
+            after = len(client.journal_index(victim,
+                                             epoch=vepoch)["segments"])
+            assert after == before
+
+            # generous deadlines: nothing accepted may be shed
+            assert not (set(reqs) & set(fe.shed)), fe.shed
+            # exactly-once (collect raises on dup/out-of-order) and
+            # token-exact vs the serial oracle, across the failover
+            streams = TokenSink.collect(sink.path)
+            for rid, (p, mn) in sorted(reqs.items()):
+                assert streams.get(rid) == list(_solo(model, p, mn)), rid
+            assert set(streams) == set(reqs)
+            # accepted p99 TTFT inside the deadline
+            ttfts = [fe.first_token_wall[r] - fe.requests[r]["submit_wall"]
+                     for r in reqs if r in fe.first_token_wall]
+            assert len(ttfts) == len(reqs)
+            assert float(np.percentile(ttfts, 99)) <= dl.ttft_s
+        finally:
+            for h in list(fe.handles.values()):
+                if isinstance(h, RemoteReplica):
+                    try:
+                        h.stop_replica()
+                    except OSError:
+                        pass
+            for pr in procs.values():
+                try:
+                    pr.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    pr.kill()
+                    pr.wait(timeout=10)
+            fe.stop()
+            coll.close()
+            sink.close()
+            client.close()
+            snapstore.close()
+            store.close()
+            for f in logs.values():
+                f.close()
