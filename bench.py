@@ -268,6 +268,95 @@ def bench_llama(on_accel: bool, peak: float):
     }
 
 
+def _raw_jax_resnet_ceiling(on_accel: bool, peak: float,
+                            flops_fwd: float) -> float:
+    """Measured raw-jax fwd+bwd+SGD ceiling MFU for the conv ladder.
+
+    ISSUE-13 re-baseline: the old 0.15 normalization came from a
+    FORWARD-only raw-jax probe scaled by a guessed bwd ratio — a stale
+    proxy once the leg times fwd+bwd+optimizer. This builds the same
+    macro-shape NHWC conv stack in bare jax (stem + strided 3x3 stages +
+    dense head, no framework, no BN), trains it with momentum-SGD under
+    jit with donated state, and returns its measured MFU priced with the
+    SAME flops accounting as the framework leg — so vs_baseline is a
+    like-for-like framework-overhead ratio on THIS machine, not a chip
+    constant. Falls back to the historical 0.15 if the probe fails."""
+    import time
+
+    import numpy as np
+
+    try:
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        if on_accel:
+            batch, hw, widths, steps, warmup = 256, 224, \
+                (64, 64, 128, 128, 256, 256, 512, 512), 6, 2
+            dt_c = jnp.bfloat16
+        else:
+            batch, hw, widths, steps, warmup = 4, 64, \
+                (64, 64, 128, 128, 256, 256, 512, 512), 2, 1
+            dt_c = jnp.float32
+
+        rng = np.random.default_rng(2)
+
+        def w_conv(kh, kw, cin, cout):
+            fan = kh * kw * cin
+            return jnp.asarray(rng.standard_normal((kh, kw, cin, cout))
+                               .astype(np.float32) / np.sqrt(fan))
+
+        params = [w_conv(7, 7, 3, widths[0])]
+        cin = widths[0]
+        for i, cout in enumerate(widths):
+            params.append(w_conv(3, 3, cin, cout))
+            cin = cout
+        params.append(jnp.asarray(
+            rng.standard_normal((cin, 1000)).astype(np.float32)
+            / np.sqrt(cin)))
+        vel = [jnp.zeros_like(p) for p in params]
+
+        def fwd(params, x, y):
+            h = lax.conv_general_dilated(
+                x.astype(dt_c), params[0].astype(dt_c), (2, 2), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            h = jnp.maximum(h, 0)
+            for i, w in enumerate(params[1:-1]):
+                stride = 2 if (i % 2 == 0 and i > 0) else 1
+                h = lax.conv_general_dilated(
+                    h, w.astype(dt_c), (stride, stride), "SAME",
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"))
+                h = jnp.maximum(h, 0)
+            h = h.mean((1, 2)).astype(jnp.float32)
+            logits = h @ params[-1]
+            lse = jax.scipy.special.logsumexp(logits, -1)
+            return (lse - logits[jnp.arange(batch), y]).mean()
+
+        @jax.jit
+        def step(params, vel, x, y):
+            _, grads = jax.value_and_grad(fwd)(params, x, y)
+            vel = [0.9 * v + g for v, g in zip(vel, grads)]
+            params = [p - 0.01 * v for p, v in zip(params, vel)]
+            return params, vel
+
+        x = jnp.asarray(rng.standard_normal((batch, hw, hw, 3))
+                        .astype(np.float32))
+        y = jnp.asarray(rng.integers(0, 1000, (batch,)).astype(np.int32))
+        for _ in range(warmup):
+            params, vel = step(params, vel, x, y)
+        jax.block_until_ready(params[0])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, vel = step(params, vel, x, y)
+        jax.block_until_ready(params[0])
+        dt = max(time.perf_counter() - t0, 1e-9)
+        achieved = steps * 3 * flops_fwd * batch / dt / 1e12
+        ceiling = achieved / peak
+        return max(ceiling, 1e-4)
+    except Exception:
+        return 0.15
+
+
 def bench_resnet(on_accel: bool, peak: float):
     """BASELINE.md config #1: ResNet-50 imgs/sec (synthetic data).
 
@@ -276,15 +365,17 @@ def bench_resnet(on_accel: bool, peak: float):
     NCHW input directly — materializing a C=3 NHWC array would lane-pad
     3→128).
 
-    Normalization: vs_baseline = MFU / 0.15. ResNet-50 is NOT
-    matmul-dense — measured on THIS v5e, a raw-jax NHWC conv stack with
-    no framework code and no batchnorm tops out at 33 TF/s forward
-    (0.17 MFU; the same chip runs large bf16 matmuls at 150 TF/s), so
-    XLA's conv lowering — not the framework — sets the ceiling, and 0.15
-    MFU is the realistic strong-conv-stack target (MLPerf-class ResNet
-    results on GPUs sit near ~10-15% of peak FLOPs for the same reason).
-    The llama/gpt/ernie ladder keeps the 0.50-MFU normalization — those
-    ARE matmul-dense."""
+    Normalization (re-baselined, ISSUE 13): vs_baseline = MFU divided by
+    the MEASURED fwd+bwd+SGD MFU of a same-macro-shape raw-jax NHWC conv
+    stack on this machine (`_raw_jax_resnet_ceiling`). ResNet is NOT
+    matmul-dense — XLA's conv lowering, not the framework, sets the
+    ceiling (on the r5 v5e the raw stack measured 0.17 MFU forward while
+    big bf16 matmuls hit 0.76) — but the old hard-coded 0.15 target
+    scaled that forward-only probe by a guessed bwd ratio, so the
+    published 0.899 was against a stale proxy. Measuring the full
+    train step makes the denominator apples-to-apples with what the leg
+    times. The llama/gpt/ernie ladder keeps the 0.50-MFU normalization —
+    those ARE matmul-dense."""
     import numpy as np
 
     import paddle_tpu as paddle
@@ -318,11 +409,12 @@ def bench_resnet(on_accel: bool, peak: float):
     imgs_per_sec = batch * steps / dt
     achieved = imgs_per_sec * 3 * flops_fwd / 1e12  # train ~ 3x fwd flops
     mfu = achieved / peak
+    ceiling_mfu = _raw_jax_resnet_ceiling(on_accel, peak, flops_fwd)
     return {
         "metric": f"{name}_train_imgs_per_sec_per_chip",
         "value": round(imgs_per_sec, 1),
         "unit": "imgs/s",
-        "vs_baseline": round(mfu / 0.15, 4),
+        "vs_baseline": round(mfu / ceiling_mfu, 4),
         "detail": {"batch": batch, "image": hw,
                    "layout": getattr(model, "data_format",
                                      getattr(getattr(model, "_layers", None),
@@ -331,10 +423,13 @@ def bench_resnet(on_accel: bool, peak: float):
                    "final_loss": round(final_loss, 4),
                    "mfu": round(mfu, 4),
                    "achieved_tflops": round(achieved, 2),
-                   "norm_note": "vs 0.15-MFU conv target: raw-jax NHWC "
-                                "conv stack w/o framework or BN measures "
-                                "0.17 MFU fwd on this chip (XLA conv "
-                                "lowering ceiling; big matmuls hit 0.76)",
+                   "norm_ceiling_mfu": round(ceiling_mfu, 4),
+                   "norm_note": "vs MEASURED raw-jax fwd+bwd+SGD ceiling "
+                                "of a same-macro-shape NHWC conv stack "
+                                "(no framework, no BN) on this machine — "
+                                "re-baselined from the stale 0.15 "
+                                "fwd-only proxy (XLA conv lowering sets "
+                                "the ceiling; big matmuls hit 0.76)",
                    "attribution": "r5 profile, per 123ms step: fwd 44.8ms "
                                   "(0.119 MFU-1x), bwd 75.4ms (1.68x fwd), "
                                   "optimizer 3.3ms; train-BN == eval-BN "
@@ -1561,6 +1656,60 @@ def bench_serving(on_accel: bool, peak: float):
         depot_store.close()
         shutil.rmtree(fleet_root, ignore_errors=True)
 
+    # --- speculative decoding leg (ISSUE 13): same engine class with the
+    # draft/verify scheduler on (k=3, n-gram self-drafting). Token-exactness
+    # vs serial is tier-1's job (tests/test_speculative.py -m spec); the
+    # bench gates that speculation ENGAGES on a decode trace with
+    # draftable structure: acceptance must be nonzero and the verify steps
+    # must average >1 emitted token per row — otherwise the widened decode
+    # program is pure overhead and the leg fails loudly.
+    eng_sp = ServingEngine(model, max_batch=max_batch,
+                           page_tokens=page_tokens, num_pages=num_pages,
+                           max_pages_per_seq=mp,
+                           max_queue=n_requests + 1, speculative=3)
+    loopy = np.tile(np.array([7, 8, 9, 10], np.int32), 4)
+    for i in range(max_batch * 2):
+        seq = loopy if i % 2 == 0 else rng.integers(
+            1, cfg.vocab_size,
+            int(prompt_lens[i % len(prompt_lens)])).astype(np.int32)
+        eng_sp.submit(seq, max_new_tokens=max_new_hi)
+    eng_sp.run()
+    s_sp = eng_sp.meter.summary()
+    spec_acceptance = s_sp["spec_acceptance"]
+    spec_eff = s_sp["effective_tokens_per_step"]
+    if not spec_acceptance or spec_acceptance <= 0:
+        raise RuntimeError(
+            f"speculative serving leg accepted no draft tokens "
+            f"(acceptance={spec_acceptance}) — the verify scheduler is "
+            "not engaging")
+    if not spec_eff or spec_eff <= 1.0:
+        raise RuntimeError(
+            f"speculative serving leg emitted {spec_eff} tokens per "
+            "verify step — no better than serial decode, the widened "
+            "program is pure overhead")
+
+    # --- int8 KV page leg (ISSUE 13): the DTYPE_BYTES-priced pool
+    # accountant must report int8 pages at exactly half the bf16 bytes
+    # (scale planes are priced separately), and the dequant-fused decode
+    # path must serve a short trace end-to-end
+    eng_i8 = ServingEngine(model, max_batch=max_batch,
+                           page_tokens=page_tokens, num_pages=num_pages,
+                           max_pages_per_seq=mp,
+                           max_queue=n_requests + 1, kv_dtype="int8")
+    if eng_i8.pool.bytes_per_page * 2 != eng.pool.bytes_per_page:
+        raise RuntimeError(
+            f"int8 serving leg: pool bytes/page {eng_i8.pool.bytes_per_page} "
+            f"is not half the bf16 {eng.pool.bytes_per_page} — the "
+            "DTYPE_BYTES pricing regressed")
+    for i in range(2):
+        eng_i8.submit(rng.integers(1, cfg.vocab_size,
+                                   int(prompt_lens[i])).astype(np.int32),
+                      max_new_tokens=max_new_lo)
+    outs_i8 = eng_i8.run()
+    if any(len(v) == 0 for v in outs_i8.values()):
+        raise RuntimeError("int8 serving leg generated nothing through "
+                           "the dequant-fused decode path")
+
     import jax
 
     from paddle_tpu.telemetry import PEAK_HBM_GBPS
@@ -1599,6 +1748,12 @@ def bench_serving(on_accel: bool, peak: float):
             "fleet_replicas": 2,
             "failovers": fleet_failovers,
             "replayed_requests": fleet_replayed,
+            "kv_dtype": eng.kv_dtype,
+            "kv_bytes_per_token": s["kv_bytes_per_token"],
+            "spec_acceptance": spec_acceptance,
+            "effective_tokens_per_step": spec_eff,
+            "int8_bytes_per_page": eng_i8.pool.bytes_per_page,
+            "bf16_bytes_per_page": eng.pool.bytes_per_page,
             "note": "mixed-length trace through the paged continuous-"
                     "batching engine; p99s from per-request SLO clocks; "
                     "MBU prices params + gathered page view per step; "
@@ -1607,7 +1762,10 @@ def bench_serving(on_accel: bool, peak: float):
                     "resume_replayed from the journal replay smoke; "
                     "failovers/replayed_requests from the two-replica "
                     "fleet leg (one replica dies mid-stream, survivor "
-                    "finishes every request exactly-once)",
+                    "finishes every request exactly-once); "
+                    "spec_acceptance/effective_tokens_per_step gated "
+                    ">0 / >1 on the speculative leg; int8 leg gated at "
+                    "exactly half the bf16 pool bytes/page",
         },
     }
 
@@ -1630,6 +1788,8 @@ _COMPACT_KEYS = (
     "shed_rate", "overload_shed_rate", "deadline_miss_rate",
     "resume_replayed",
     "fleet_replicas", "failovers", "replayed_requests",
+    "spec_acceptance", "effective_tokens_per_step", "kv_dtype",
+    "norm_ceiling_mfu",
 )
 
 

@@ -35,7 +35,14 @@ import jax.numpy as jnp
 from ..autograd import no_grad
 from ..tensor.tensor import Tensor
 
-__all__ = ["GenerationMixin", "cached_attention"]
+from .speculative import (AdaptiveK, DraftModelDrafter,  # noqa: F401
+                          NGramDrafter, ShallowExitDrafter, SpecConfig,
+                          rejection_sample_step, speculative_generate)
+
+__all__ = ["GenerationMixin", "cached_attention",
+           "SpecConfig", "AdaptiveK", "NGramDrafter", "DraftModelDrafter",
+           "ShallowExitDrafter", "rejection_sample_step",
+           "speculative_generate"]
 
 
 def cached_attention(q, k_new, v_new, cache_k, cache_v, pos, pad_lens=None):

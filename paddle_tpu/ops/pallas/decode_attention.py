@@ -232,3 +232,242 @@ def decode_attention(q, k_new, v_new, cache_k, cache_v, pos,
 
     out = out[:, :, :g, :].reshape(b, 1, h, d)
     return out, ck_out, cv_out
+
+
+# ---------------------------------------------------------------------------
+# int8 quantized-cache variant (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+_QMAX = 127.0
+_SCALE_EPS = 1e-8
+
+
+def decode_attention_int8_supported(q_shape, cache_shape, *,
+                                    block_k: int = DEFAULT_BLOCK_K,
+                                    emit_fallback: bool = False) -> bool:
+    """Shapes the int8 decode kernel handles.  The extra constraint over
+    the bf16 kernel is lane alignment of the per-token scale vectors
+    (``block_k`` must fill whole lane registers).  With ``emit_fallback``
+    every gate rejection lands a ``kernel_fallback`` telemetry event so an
+    int8 deployment silently falling back to the einsum path is visible."""
+    def _reject(reason: str, **detail) -> bool:
+        if emit_fallback:
+            from ...telemetry import kernel_fallback
+
+            kernel_fallback("decode_attention_int8", reason, **detail)
+        return False
+
+    if len(q_shape) != 4 or len(cache_shape) != 4:
+        return _reject("rank", q_rank=len(q_shape))
+    b, s, h, d = q_shape
+    _, C, kv, dc = cache_shape
+    if not decode_attention_supported(q_shape, cache_shape, block_k=block_k):
+        return _reject("shape", q_shape=list(q_shape), cache_len=C,
+                       block_k=block_k)
+    if block_k % _LANES != 0:
+        return _reject("scale_lane_alignment", block_k=block_k)
+    return True
+
+
+def _decode_kernel_int8(pos_ref, pad_ref, q_ref, kn_ref, vn_ref, ck_ref,
+                        cv_ref, ks_ref, vs_ref, o_ref, cko_ref, cvo_ref,
+                        kso_ref, vso_ref, acc_ref, m_ref, l_ref, *,
+                        scale: float, block_k: int):
+    """Same online-softmax structure as :func:`_decode_kernel`, but the
+    cache blocks are int8 with per-token f32 scales riding a ``[b, kv, C]``
+    scale plane.  Dequant is FUSED into the block math without a transpose:
+    ``q . (k*s) == (q . k) * s`` scales the score columns, and
+    ``p @ diag(s) @ v == (p*s) @ v`` scales the probability columns — the
+    softmax denominator keeps the UNSCALED p.  The append quantizes the new
+    token in-kernel and writes its int8 row + scale through the aliased
+    buffers."""
+    ib, ik = pl.program_id(0), pl.program_id(2)
+    nk = pl.num_programs(2)
+    pos = pos_ref[0]
+    pad = pad_ref[ib]
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    def _bcast(col):
+        return jnp.broadcast_to(col, (col.shape[0], _LANES))
+
+    def _online(s_col, v_rows, p_scale=None):
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s_col, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        m_ok = jnp.where(m_new == _NEG_INF, 0.0, m_new)
+        p = jnp.exp(s_col - m_ok)
+        alpha = jnp.exp(m_prev - m_ok)
+        l_ref[:] = _bcast(l_prev * alpha + jnp.sum(p, axis=1, keepdims=True))
+        m_ref[:] = _bcast(m_new)
+        pv = p if p_scale is None else p * p_scale
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            pv.astype(v_rows.dtype), v_rows, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when((ik * block_k < pos) & ((ik + 1) * block_k > pad))
+    def _attend():
+        q = q_ref[0, 0].astype(jnp.float32)            # (g, d)
+        k = ck_ref[0, :, 0, :].astype(jnp.float32)     # (block_k, d) int8
+        ksc = ks_ref[0]                                # (1, block_k) f32
+        vsc = vs_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * ksc * scale                            # fused k dequant
+        col = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where((col < pos) & (col >= pad), s, _NEG_INF)
+        _online(s, cv_ref[0, :, 0, :].astype(jnp.float32), p_scale=vsc)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        # the new token folds in EXACT (pre-quantization k/v): its cache
+        # row is quantized by _append below, but this step's reader sees
+        # the true values — one step later the quantized row is what the
+        # einsum oracle reads too
+        q = q_ref[0, 0].astype(jnp.float32)
+        kn = kn_ref[0, 0].astype(jnp.float32)          # (1, d)
+        s_new = jax.lax.dot_general(q, kn, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) \
+            * scale
+        _online(s_new, vn_ref[0, 0].astype(jnp.float32))
+        l = l_ref[:, :1]
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+    @pl.when(ik == pos // block_k)
+    def _append():
+        row = pos % block_k
+        kn = kn_ref[0, 0].astype(jnp.float32)          # (1, d)
+        vn = vn_ref[0, 0].astype(jnp.float32)
+        ks_new = jnp.maximum(jnp.max(jnp.abs(kn)), _SCALE_EPS) / _QMAX
+        vs_new = jnp.maximum(jnp.max(jnp.abs(vn)), _SCALE_EPS) / _QMAX
+        cko_ref[0, :, 0, :] = ck_ref[0, :, 0, :]
+        cvo_ref[0, :, 0, :] = cv_ref[0, :, 0, :]
+        kso_ref[0, :] = ks_ref[0, :]
+        vso_ref[0, :] = vs_ref[0, :]
+        cko_ref[0, pl.ds(row, 1), 0, :] = jnp.clip(
+            jnp.round(kn / ks_new), -_QMAX, _QMAX).astype(jnp.int8)
+        cvo_ref[0, pl.ds(row, 1), 0, :] = jnp.clip(
+            jnp.round(vn / vs_new), -_QMAX, _QMAX).astype(jnp.int8)
+        kso_ref[0, 0, pl.ds(row, 1)] = jnp.full((1,), ks_new, jnp.float32)
+        vso_ref[0, 0, pl.ds(row, 1)] = jnp.full((1,), vs_new, jnp.float32)
+
+
+def decode_attention_int8(q, k_new, v_new, cache_k, cache_v, k_scale,
+                          v_scale, pos, pad_lens=None, *,
+                          scale: Optional[float] = None,
+                          block_k: int = DEFAULT_BLOCK_K,
+                          interpret: bool = False):
+    """Fused int8-cache decode step: dequantize the k/v block loads in
+    place (score- and probability-column scaling — no dequantized cache
+    copy ever exists), quantize+append the new token at ``pos``, and
+    attend ``q`` over cols ``[pad_lens, pos]``.
+
+    - cache_k/cache_v — int8 ``[b, C, kv, d]``, aliased in place
+    - k_scale/v_scale — f32 ``[b, kv, C]`` per-token scales, aliased too
+      (lane-major over C so a ``block_k`` slice is lane-aligned)
+
+    Returns ``(out, new_ck, new_cv, new_ks, new_vs)``."""
+    b, s, h, d = q.shape
+    _, C, kv, _ = cache_k.shape
+    assert s == 1, "decode kernel is single-query (s == 1)"
+    assert cache_k.dtype == jnp.int8 and cache_v.dtype == jnp.int8
+    g = h // kv
+    gp = max(g, _MIN_SUBLANES)
+    sc = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    q4 = q.reshape(b, kv, g, d)
+    if gp != g:
+        q4 = jnp.concatenate(
+            [q4, jnp.zeros((b, kv, gp - g, d), q4.dtype)], axis=2)
+    kn3 = jnp.transpose(k_new, (0, 2, 1, 3))           # [b, kv, 1, d]
+    vn3 = jnp.transpose(v_new, (0, 2, 1, 3))
+    pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)
+    pad_arr = (jnp.zeros((b,), jnp.int32) if pad_lens is None
+               else jnp.asarray(pad_lens, jnp.int32).reshape(b))
+
+    nk = C // block_k
+    kernel = functools.partial(_decode_kernel_int8, scale=sc,
+                               block_k=block_k)
+    grid = (b, kv, nk)
+
+    out, ck_out, cv_out, ks_out, vs_out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, gp, d),
+                             lambda ib, ikv, ik, pos_r, pad_r:
+                             (ib, ikv, 0, 0)),
+                pl.BlockSpec((1, 1, 1, d),
+                             lambda ib, ikv, ik, pos_r, pad_r:
+                             (ib, ikv, 0, 0)),
+                pl.BlockSpec((1, 1, 1, d),
+                             lambda ib, ikv, ik, pos_r, pad_r:
+                             (ib, ikv, 0, 0)),
+                pl.BlockSpec((1, block_k, 1, d),
+                             lambda ib, ikv, ik, pos_r, pad_r:
+                             (ib, ik, ikv, 0)),
+                pl.BlockSpec((1, block_k, 1, d),
+                             lambda ib, ikv, ik, pos_r, pad_r:
+                             (ib, ik, ikv, 0)),
+                pl.BlockSpec((1, 1, block_k),
+                             lambda ib, ikv, ik, pos_r, pad_r:
+                             (ib, ikv, ik)),
+                pl.BlockSpec((1, 1, block_k),
+                             lambda ib, ikv, ik, pos_r, pad_r:
+                             (ib, ikv, ik)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, gp, d),
+                             lambda ib, ikv, ik, pos_r, pad_r:
+                             (ib, ikv, 0, 0)),
+                pl.BlockSpec((1, block_k, 1, d),
+                             lambda ib, ikv, ik, pos_r, pad_r:
+                             (ib, pos_r[0] // block_k, ikv, 0)),
+                pl.BlockSpec((1, block_k, 1, d),
+                             lambda ib, ikv, ik, pos_r, pad_r:
+                             (ib, pos_r[0] // block_k, ikv, 0)),
+                pl.BlockSpec((1, 1, block_k),
+                             lambda ib, ikv, ik, pos_r, pad_r:
+                             (ib, ikv, pos_r[0] // block_k)),
+                pl.BlockSpec((1, 1, block_k),
+                             lambda ib, ikv, ik, pos_r, pad_r:
+                             (ib, ikv, pos_r[0] // block_k)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((gp, d), jnp.float32),
+                pltpu.VMEM((gp, _LANES), jnp.float32),
+                pltpu.VMEM((gp, _LANES), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, kv, gp, d), q.dtype),
+            jax.ShapeDtypeStruct(cache_k.shape, jnp.int8),
+            jax.ShapeDtypeStruct(cache_v.shape, jnp.int8),
+            jax.ShapeDtypeStruct(k_scale.shape, jnp.float32),
+            jax.ShapeDtypeStruct(v_scale.shape, jnp.float32),
+        ],
+        # operand indices count the scalar-prefetch args: pos=0, pad=1,
+        # q=2, k_new=3, v_new=4, ck=5, cv=6, ks=7, vs=8 — the int8 arenas
+        # AND their scale planes all update in place
+        input_output_aliases={5: 1, 6: 2, 7: 3, 8: 4},
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * h * C * d,
+            bytes_accessed=(2 * b * C * kv * (d + 4)    # int8 rows + f32 scales
+                            + 2 * block_k * kv * (d + 4)
+                            + b * h * d * q.dtype.itemsize),
+            transcendentals=b * h * C),
+        interpret=interpret,
+    )(pos_arr, pad_arr, q4, kn3, vn3, cache_k, cache_v, k_scale, v_scale)
+
+    out = out[:, :, :g, :].reshape(b, 1, h, d)
+    return out, ck_out, cv_out, ks_out, vs_out

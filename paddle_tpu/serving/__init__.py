@@ -35,6 +35,9 @@ high-water marks primed — exactly-once tokens across replica death (see
 
 from .kv_pool import PagedKVPool, PoolExhausted, TRASH_PAGE, \
     default_page_tokens  # noqa: F401
+from .kv_quant import (KV_DTYPES, dequantize_kv, kv_cache_dtype,  # noqa: F401
+                       kv_page_bytes, kv_scale_page_bytes,
+                       observe_kv_absmax, quantize_kv)
 from .metrics import FleetMeter, RequestClock, SLOMeter  # noqa: F401
 from .admission import (AdmissionController, CircuitBreaker, Deadline,  # noqa: F401
                         Overloaded)
@@ -47,6 +50,8 @@ from .fleet import (EngineReplica, LocalKV, RemoteReplica,  # noqa: F401
 
 __all__ = [
     "PagedKVPool", "PoolExhausted", "TRASH_PAGE", "default_page_tokens",
+    "KV_DTYPES", "kv_cache_dtype", "quantize_kv", "dequantize_kv",
+    "observe_kv_absmax", "kv_page_bytes", "kv_scale_page_bytes",
     "RequestClock", "SLOMeter", "FleetMeter",
     "AdmissionController", "CircuitBreaker", "Deadline", "Overloaded",
     "JournalState", "ServingJournal", "TokenSink",

@@ -80,6 +80,8 @@ from .admission import AdmissionController, Deadline, Overloaded
 from .journal import ServingJournal
 from .kv_pool import PagedKVPool, PoolExhausted, TRASH_PAGE, \
     default_page_tokens
+from .kv_quant import (dequantize_kv, kv_cache_dtype, kv_page_bytes,
+                       kv_scale_page_bytes, quantize_kv)
 from .metrics import SLOMeter
 
 __all__ = ["Request", "ServingEngine", "check_decode_donation"]
@@ -117,6 +119,7 @@ class Request:
         self.delivered = 0                    # client-visible high-water mark
         self.delivered_tokens: List[int] = []
         self.defers = 0                       # FIFO-head bypasses suffered
+        self.drafter = None                   # speculative proposer (or None)
 
     @property
     def pos(self) -> int:
@@ -130,14 +133,20 @@ class Request:
             and self.generated[-1] == self.eos_token_id)
 
 
-def check_decode_donation(compiled, arena_bytes: int, name: str = "serving_decode"):
+def check_decode_donation(compiled, arena_bytes: int,
+                          name: str = "serving_decode", *,
+                          scale_bytes: int = 0):
     """Shardlint gate for the serving path: run the ``donation`` rule over
     the compiled decode program and additionally require the KV arenas to
     be ALIASED (donated in, updated in place) — an unaliased arena means
     the program copies the whole cache every step, the exact defect the
-    subsystem exists to delete.  Returns the :class:`LintReport`; raises
-    ``RuntimeError`` when the arenas are not aliased or an unexempted
-    donation error fires."""
+    subsystem exists to delete.  With int8 pages the f32 ``scale_bytes``
+    buffers ride the same donation: an unaliased scale arena silently
+    copies ``2 * layers * pages * page_tokens * kv_heads`` floats per
+    step, so the gate requires ``arena_bytes + scale_bytes`` aliased.
+    Returns the :class:`LintReport`; raises ``RuntimeError`` when the
+    arenas (or scales) are not aliased or an unexempted donation error
+    fires."""
     from ..analysis import lint
 
     report = lint(compiled, rules=["donation"], name=name)
@@ -148,13 +157,17 @@ def check_decode_donation(compiled, arena_bytes: int, name: str = "serving_decod
                "argument_bytes": int(ma.argument_size_in_bytes)}
     except Exception:
         pass
-    if mem is not None and mem["alias_bytes"] < arena_bytes:
+    need = int(arena_bytes) + int(scale_bytes)
+    if mem is not None and mem["alias_bytes"] < need:
+        what = "KV arenas" if not scale_bytes else \
+            "KV arenas + int8 scale buffers"
         raise RuntimeError(
-            f"serving decode program does not alias its KV arenas: "
-            f"{mem['alias_bytes']} bytes aliased < {arena_bytes} arena "
-            f"bytes — the cache is being copied every step (donation "
-            f"dropped; check donate_argnums and that arena shapes/dtypes "
-            f"are unchanged between input and output)")
+            f"serving decode program does not alias its {what}: "
+            f"{mem['alias_bytes']} bytes aliased < {need} required "
+            f"({arena_bytes} arena + {scale_bytes} scale) — the cache is "
+            f"being copied every step (donation dropped; check "
+            f"donate_argnums and that arena/scale shapes/dtypes are "
+            f"unchanged between input and output)")
     if not report.ok:
         raise RuntimeError(
             "serving decode program failed the donation lint:\n" +
@@ -175,8 +188,11 @@ class ServingEngine:
                  lint: Optional[bool] = None,
                  max_queue: Optional[int] = None,
                  admission: Optional[AdmissionController] = None,
-                 journal=None, journal_ship=None, on_token=None, now=None):
+                 journal=None, journal_ship=None, on_token=None, now=None,
+                 kv_dtype: Optional[str] = None, speculative=None):
         import jax.numpy as jnp
+
+        from ..generation.speculative import AdaptiveK, SpecConfig
 
         base = getattr(model, "llama", None)
         if base is None or not hasattr(base, "layers"):
@@ -215,12 +231,57 @@ class ServingEngine:
         cdt = next((p._value.dtype for p in self._params
                     if jnp.issubdtype(p._value.dtype, jnp.floating)),
                    jnp.float32)
+        self._cdt = cdt
         n_layers, kv_heads, head_dim = model._kv_cache_spec()
         self._arena_shape = (N, P, kv_heads, head_dim)
-        self._ks = [jnp.zeros(self._arena_shape, cdt) for _ in range(n_layers)]
-        self._vs = [jnp.zeros(self._arena_shape, cdt) for _ in range(n_layers)]
+        # KV page dtype (ISSUE 13): "bf16" = the native compute dtype,
+        # bit-exact; "int8" stores quantized pages + f32 per-(slot, head)
+        # scale arenas, dequantized at the gather inside the same program
+        self.kv_dtype = kv_cache_dtype(kv_dtype)
+        adt = jnp.int8 if self.kv_dtype == "int8" else cdt
+        arenas = {
+            "k": [jnp.zeros(self._arena_shape, adt)
+                  for _ in range(n_layers)],
+            "v": [jnp.zeros(self._arena_shape, adt)
+                  for _ in range(n_layers)],
+        }
+        self._scale_bytes = 0
+        if self.kv_dtype == "int8":
+            sshape = (N, P, kv_heads)
+            arenas["ks"] = [jnp.zeros(sshape, jnp.float32)
+                            for _ in range(n_layers)]
+            arenas["vs"] = [jnp.zeros(sshape, jnp.float32)
+                            for _ in range(n_layers)]
+            self._scale_bytes = 2 * n_layers * int(np.prod(sshape)) * 4
+        self._arenas = arenas
         self._arena_bytes = 2 * n_layers * int(np.prod(self._arena_shape)) \
-            * self._ks[0].dtype.itemsize
+            * arenas["k"][0].dtype.itemsize
+        self.pool.set_page_bytes(
+            kv_page_bytes(P, kv_heads, head_dim, self.kv_dtype,
+                          n_layers=n_layers),
+            kv_scale_page_bytes(P, kv_heads, self.kv_dtype,
+                                n_layers=n_layers),
+            self.kv_dtype)
+        self.meter.set_kv_bytes_per_token(self.pool.bytes_per_token())
+
+        # speculative decoding (ISSUE 13): the decode program widens to a
+        # fixed [R, k_max+1] verify signature; a per-row dynamic valid
+        # count carries the adaptive draft length, so k changes never
+        # recompile.  None/0 = plain serial decode (S = 1).
+        if speculative is None:
+            env_k = _env_int("PADDLE_TPU_SPEC_K", 0)
+            speculative = SpecConfig(k=env_k) if env_k > 0 else None
+        elif isinstance(speculative, int):
+            speculative = SpecConfig(k=speculative) \
+                if speculative > 0 else None
+        elif not isinstance(speculative, SpecConfig):
+            raise TypeError("speculative must be None, an int draft "
+                            "length, or a generation.SpecConfig")
+        self.spec: Optional[SpecConfig] = speculative
+        self._spec_width = 1 + (self.spec.k if self.spec else 0)
+        self._adapt = AdaptiveK(self.spec.k, self.spec.adaptive,
+                                decay=self.spec.ema_decay) \
+            if self.spec else None
 
         self._queue: deque = deque()
         self._active: Dict[int, Request] = {}          # row -> Request
@@ -230,6 +291,8 @@ class ServingEngine:
         self._prefill_exec = None
         self._decode_compiles = 0
         self.lint_report = None
+        self.last_decode_logits = None   # host copy of the latest verify
+        # logits [R, S, V] — the int8-vs-bf16 tolerance harness reads it
         self.steps_total = 0
         self._pending_delivery: List[tuple] = []       # (rid, idx, token)
         self._work = threading.Event()
@@ -563,6 +626,9 @@ class ServingEngine:
         victim.row = None
         victim.state = QUEUED
         victim.generated = []        # replayed from the prompt on re-admit
+        victim.drafter = None        # rebuilt at re-prefill; proposals only
+        # ever influence WHICH positions get verified, never the tokens,
+        # so a drafter reset cannot perturb the deterministic replay
         victim.evictions += 1
         self._queue.appendleft(victim)
         self.meter.evict(victim.rid, reason="pool_pressure",
@@ -587,13 +653,14 @@ class ServingEngine:
             return (1, c.admit_t or 0.0, x.rid)
         return (0, min(budgets) - self._now(), x.rid)
 
-    def _ensure_page(self, r: Request) -> bool:
-        """Make sure the page holding ``r.pos`` exists.  Under pool
-        pressure an active request is preempted (see :meth:`_victim_key`:
-        youngest-admitted without deadlines, most-slack with); when ``r``
-        itself is chosen it self-preempts (returns False) and waits in
-        the queue for pages to free up."""
-        need = r.pos // self.page_tokens + 1
+    def _ensure_page(self, r: Request, n_tok: int = 1) -> bool:
+        """Make sure pages covering ``r.pos .. r.pos + n_tok - 1`` exist
+        (``n_tok > 1`` when a verify step writes draft positions too).
+        Under pool pressure an active request is preempted (see
+        :meth:`_victim_key`: youngest-admitted without deadlines,
+        most-slack with); when ``r`` itself is chosen it self-preempts
+        (returns False) and waits in the queue for pages to free up."""
+        need = (r.pos + max(int(n_tok), 1) - 1) // self.page_tokens + 1
         while len(self.pool.table(r.rid)) < need:
             if self.pool.can_alloc(1):
                 _faults.fire("serve_pool", f"page_rid{r.rid}")
@@ -656,26 +723,59 @@ class ServingEngine:
         r.generated.append(tok)
         self.meter.first_token(r.rid)
         self._deliver(r, tok)
+        if self.spec is not None:
+            # (re)build the drafter here so eviction replay and crash
+            # recovery get a fresh one primed with exactly the tokens a
+            # first-admission drafter would have seen
+            r.drafter = self.spec.make_drafter()
+            r.drafter.begin([int(t) for t in r.prompt])
+            r.drafter.observe([tok])
 
     def _decode_step(self) -> None:
+        """One verify-wide decode step.  Serial mode (spec off) is the
+        degenerate S=1 case: every row carries n_tok=1 and the program
+        trace is value-identical to the old single-token decode.  With
+        speculation, each row drafts k_r tokens host-side, the ONE
+        compiled program scores positions ``pos..pos+k_r`` in a single
+        weight read, and the greedy acceptance loop emits the longest
+        prefix whose drafts match the target's own argmax — followed by
+        the target's correction token, so every step emits >= 1 token and
+        the stream is token-exact vs serial by construction.  Rejected
+        drafts leave stale cache slots AT OR PAST the next write position;
+        the next step's scatter overwrites them before its gather (same
+        program), and the causal mask hides anything beyond its window."""
         import jax.numpy as jnp
 
-        R, MP = self.max_batch, self.max_pages_per_seq
-        tokens = np.zeros((R,), np.int32)
+        R, MP, S = self.max_batch, self.max_pages_per_seq, self._spec_width
+        tokens = np.zeros((R, S), np.int32)
         positions = np.zeros((R,), np.int32)
+        n_tok = np.zeros((R,), np.int32)
         tables = np.full((R, MP), TRASH_PAGE, np.int32)
+        drafts: Dict[int, List[int]] = {}
         stepped: List[Request] = []
         for r in [self._active[row] for row in sorted(self._active)]:
             # _ensure_page can evict LATER snapshot entries; skip anything
             # no longer running so an evictee never allocates while queued
             if r.state != RUNNING or r.row is None or r.done():
                 continue
-            self._ensure_page(r)
+            d: List[int] = []
+            if self.spec is not None and r.drafter is not None:
+                # never draft past the output budget: the last budgeted
+                # token needs no verification slot (nothing follows it)
+                k_r = min(self._adapt.k(),
+                          r.max_new_tokens - len(r.generated) - 1)
+                if k_r > 0:
+                    d = [int(t) for t in r.drafter.propose(k_r)]
+            drafts[r.rid] = d
+            self._ensure_page(r, 1 + len(d))
         # _ensure_page may have evicted rows; rebuild the live view
         for row, r in sorted(self._active.items()):
             if r.done():
                 continue
-            tokens[row] = r.generated[-1]
+            d = drafts.get(r.rid, [])
+            seq = [r.generated[-1]] + d
+            tokens[row, :len(seq)] = seq
+            n_tok[row] = len(seq)
             positions[row] = r.pos
             tables[row] = self._padded_table(r.rid)
             stepped.append(r)
@@ -686,13 +786,46 @@ class ServingEngine:
         _faults.fire("serve_decode", f"step{self.steps_total}")
         logits = self._run_decode(jnp.asarray(tokens),
                                   jnp.asarray(positions),
-                                  jnp.asarray(tables))
-        logits = np.asarray(logits)
+                                  jnp.asarray(tables),
+                                  jnp.asarray(n_tok))
+        logits = np.asarray(logits)                       # [R, S, V]
+        self.last_decode_logits = logits
+        proposed_total = accepted_total = emitted_total = 0
         for r in stepped:
-            tok = int(np.argmax(logits[r.row]))
-            r.generated.append(tok)
-            self.meter.token(r.rid)
-            self._deliver(r, tok)
+            nv = int(n_tok[r.row])
+            row_logits = logits[r.row, :nv]
+            if not np.all(np.isfinite(row_logits)):
+                # a corrupted int8 scale (or any cache poisoning) surfaces
+                # as NaN/inf logits — fail LOUDLY instead of emitting junk
+                raise RuntimeError(
+                    f"non-finite decode logits for rid {r.rid} "
+                    f"(kv_dtype={self.kv_dtype}): corrupted KV page or "
+                    f"scale buffer")
+            d = drafts.get(r.rid, [])
+            emitted: List[int] = []
+            for i in range(nv):
+                tok = int(np.argmax(row_logits[i]))
+                r.generated.append(tok)
+                self.meter.token(r.rid)
+                self._deliver(r, tok)
+                emitted.append(tok)
+                if r.done():
+                    break
+                if i < nv - 1 and tok != d[i]:
+                    break            # first mismatch: rest of the draft is
+                    # conditioned on a token the target rejected
+            if self.spec is not None:
+                accepted = len(emitted) - 1
+                proposed_total += len(d)
+                accepted_total += accepted
+                emitted_total += len(emitted)
+                self._adapt.update(accepted, len(d))
+                if r.drafter is not None and not r.done():
+                    r.drafter.observe(emitted)
+        if self.spec is not None:
+            self.meter.spec_step(proposed=proposed_total,
+                                 accepted=accepted_total,
+                                 emitted=emitted_total, rows=len(stepped))
         for r in list(self._active.values()):
             self._retire_if_done(r)
 
@@ -817,54 +950,87 @@ class ServingEngine:
 
 
     # -- traced functions --------------------------------------------------
-    def _paged_attention(self, q, k_new, v_new, kp, vp, tables, positions):
-        """Scatter this step's k/v into the page arenas and attend each row
-        over its gathered pages.  Mirrors ``generation.cached_attention``'s
-        grouped einsum (cache dtype multiplies, f32 accumulation, no cache
-        cast) so outputs are bit-identical to the contiguous-cache path —
-        junk cols (trash page, unwritten slots) mask to exact zeros."""
+    @property
+    def _ks(self):
+        return self._arenas["k"]
+
+    @property
+    def _vs(self):
+        return self._arenas["v"]
+
+    def _paged_attention(self, q, k_new, v_new, arenas, li, tables,
+                         positions, n_tok):
+        """Scatter this step's k/v into layer ``li``'s page arenas and
+        attend each row over its gathered pages.  ``n_tok`` [R] is the
+        per-row count of VALID tokens in the s-window (speculative verify
+        rows carry 1 + k_r; idle rows 0) — invalid slots scatter to the
+        trash page.  Mirrors ``generation.cached_attention``'s grouped
+        einsum (cache dtype multiplies, f32 accumulation, no cache cast)
+        so bf16 outputs are bit-identical to the contiguous-cache path —
+        junk cols (trash page, unwritten slots, positions past a row's
+        valid window) mask to exact zeros.  int8 pages quantize on the
+        scatter (per-token scales into the scale arenas) and dequantize
+        at the gather, fused into the same program."""
+        import jax
         import jax.numpy as jnp
 
         R, s, h, d = q.shape
         kv = k_new.shape[2]
         P = self.page_tokens
         MP = tables.shape[1]
-        rows = jnp.arange(R)
-        if s == 1:
-            page = tables[rows, positions // P]
-            slot = positions % P
-            kp = kp.at[page, slot].set(k_new[:, 0].astype(kp.dtype))
-            vp = vp.at[page, slot].set(v_new[:, 0].astype(vp.dtype))
+        kp, vp = arenas["k"][li], arenas["v"][li]
+        quant = self.kv_dtype == "int8"
+        pos_js = positions[:, None] + jnp.arange(s)[None, :]      # [R, s]
+        valid = jnp.arange(s)[None, :] < n_tok[:, None]           # [R, s]
+        page = jnp.take_along_axis(tables,
+                                   jnp.clip(pos_js // P, 0, MP - 1), axis=1)
+        page = jnp.where(valid, page, TRASH_PAGE)
+        slot = jnp.where(valid, pos_js % P, 0)
+        if quant:
+            kq, ksc = quantize_kv(k_new)        # [R,s,kv] scales
+            vq, vsc = quantize_kv(v_new)
+            kp = kp.at[page, slot].set(kq)
+            vp = vp.at[page, slot].set(vq)
+            ksp = arenas["ks"][li].at[page, slot].set(ksc)
+            vsp = arenas["vs"][li].at[page, slot].set(vsc)
         else:
-            # prefill chunk: R == 1, the chunk fills exactly one page
-            page = tables[0, positions[0] // P]
-            kp = kp.at[page].set(k_new[0].astype(kp.dtype))
-            vp = vp.at[page].set(v_new[0].astype(vp.dtype))
+            kp = kp.at[page, slot].set(k_new.astype(kp.dtype))
+            vp = vp.at[page, slot].set(v_new.astype(vp.dtype))
         C = MP * P
-        kk = kp[tables].reshape(R, C, kv, d)
-        vv = vp[tables].reshape(R, C, kv, d)
+        if quant:
+            kk = dequantize_kv(kp[tables].reshape(R, C, kv, d),
+                               ksp[tables].reshape(R, C, kv)).astype(
+                                   self._cdt)
+            vv = dequantize_kv(vp[tables].reshape(R, C, kv, d),
+                               vsp[tables].reshape(R, C, kv)).astype(
+                                   self._cdt)
+        else:
+            kk = kp[tables].reshape(R, C, kv, d)
+            vv = vp[tables].reshape(R, C, kv, d)
         g = h // kv
         q5 = q.reshape(R, s, kv, g, d).astype(kk.dtype)
         scores = jnp.einsum("bskgd,bckd->bkgsc", q5, kk,
                             preferred_element_type=jnp.float32) \
             / jnp.sqrt(float(d))
         col = jnp.arange(C)[None, None, None, None, :]
-        row_pos = (positions[:, None] + jnp.arange(s)[None, :]) \
-            [:, None, None, :, None]
+        row_pos = pos_js[:, None, None, :, None]
         scores = jnp.where(col <= row_pos, scores,
                            jnp.finfo(jnp.float32).min)
-        import jax
-
         probs = jax.nn.softmax(scores, axis=-1)
         out = jnp.einsum("bkgsc,bckd->bskgd", probs.astype(vv.dtype), vv,
                          preferred_element_type=jnp.float32)
-        return out.reshape(R, s, h, d).astype(q.dtype), kp, vp
+        out = out.reshape(R, s, h, d).astype(q.dtype)
+        new = {"k": kp, "v": vp}
+        if quant:
+            new["ks"], new["vs"] = ksp, vsp
+        return out, new
 
-    def _forward(self, param_arrays, buffer_arrays, ks, vs, tokens,
-                 positions, tables):
+    def _forward(self, param_arrays, buffer_arrays, arenas, tokens,
+                 positions, tables, n_tok):
         """Shared transformer step for both programs.  ``tokens`` [R, s]
-        (decode: s=1; prefill: R=1, s=page_tokens); ``positions`` [R]
-        absolute position of each row's first token."""
+        (decode/verify: s=spec width; prefill: R=1, s=page_tokens);
+        ``positions`` [R] absolute position of each row's first token;
+        ``n_tok`` [R] valid tokens per row (rest scatter to trash)."""
         import jax.numpy as jnp
 
         from ..autograd import no_grad
@@ -889,17 +1055,18 @@ class ServingEngine:
             cos_s = jnp.take(cos, pos_ids, axis=0)[:, :, None, :]
             sin_s = jnp.take(sin, pos_ids, axis=0)[:, :, None, :]
             x = base.embed_tokens(Tensor(tokens))
-            new_ks, new_vs = [], []
+            new_arenas = {key: [] for key in arenas}
             for li, layer in enumerate(base.layers):
                 xin = layer.input_layernorm(x)
                 q = reshape(layer.self_attn.q_proj(xin), [R, s, h, d])
                 k = reshape(layer.self_attn.k_proj(xin), [R, s, kvh, d])
                 v = reshape(layer.self_attn.v_proj(xin), [R, s, kvh, d])
                 qv, kv_ = rotate_half_apply(q._value, k._value, cos_s, sin_s)
-                out_v, nk, nv = self._paged_attention(
-                    qv, kv_, v._value, ks[li], vs[li], tables, positions)
-                new_ks.append(nk)
-                new_vs.append(nv)
+                out_v, new = self._paged_attention(
+                    qv, kv_, v._value, arenas, li, tables, positions,
+                    n_tok)
+                for key in new:
+                    new_arenas[key].append(new[key])
                 x = x + layer.self_attn.o_proj(
                     Tensor(out_v.reshape(R, s, h * d)))
                 x = x + layer.mlp(layer.post_attention_layernorm(x))
@@ -908,50 +1075,56 @@ class ServingEngine:
                 logits = model.lm_head(hidden)
             else:
                 logits = F.linear(hidden, base.embed_tokens.weight.T)
-            return logits._value, new_ks, new_vs
+            return logits._value, new_arenas
 
-    def _decode_fn(self, param_arrays, buffer_arrays, ks, vs, tokens,
-                   positions, tables):
-        logits, ks, vs = self._forward(param_arrays, buffer_arrays, ks, vs,
-                                       tokens[:, None], positions, tables)
-        return logits[:, 0], ks, vs
+    def _decode_fn(self, param_arrays, buffer_arrays, arenas, tokens,
+                   positions, tables, n_tok):
+        """ONE compiled decode signature: ``tokens`` [R, S] where S is the
+        fixed speculative width (1 + k_max; 1 when speculation is off) and
+        ``n_tok`` carries each row's live width — adapting k never
+        recompiles.  Returns logits [R, S, V]."""
+        logits, arenas = self._forward(param_arrays, buffer_arrays, arenas,
+                                       tokens, positions, tables, n_tok)
+        return logits, arenas
 
-    def _prefill_fn(self, param_arrays, buffer_arrays, ks, vs, tokens,
+    def _prefill_fn(self, param_arrays, buffer_arrays, arenas, tokens,
                     chunk_start, tables, take_idx):
         import jax.numpy as jnp
 
         positions = chunk_start[None]                 # [1]
-        logits, ks, vs = self._forward(param_arrays, buffer_arrays, ks, vs,
-                                       tokens, positions, tables)
-        return jnp.take(logits[0], take_idx, axis=0), ks, vs
+        n_tok = jnp.full((1,), tokens.shape[1], jnp.int32)  # full chunk
+        logits, arenas = self._forward(param_arrays, buffer_arrays, arenas,
+                                       tokens, positions, tables, n_tok)
+        return jnp.take(logits[0], take_idx, axis=0), arenas
 
     def _param_arrays(self):
         return ([p._value for p in self._params],
                 [b._value for b in self._buffers])
 
-    def _run_decode(self, tokens, positions, tables):
+    def _run_decode(self, tokens, positions, tables, n_tok):
         import jax
 
         pa, ba = self._param_arrays()
-        args = (pa, ba, self._ks, self._vs, tokens, positions, tables)
+        args = (pa, ba, self._arenas, tokens, positions, tables, n_tok)
         if self._decode_exec is None:
             self._decode_compiles += 1
-            jitted = jax.jit(self._decode_fn, donate_argnums=(2, 3))
+            jitted = jax.jit(self._decode_fn, donate_argnums=(2,))
             self._decode_exec = jitted.lower(*args).compile()
             if self._lint:
                 self.lint_report = check_decode_donation(
-                    self._decode_exec, self._arena_bytes)
-        logits, self._ks, self._vs = self._decode_exec(*args)
+                    self._decode_exec, self._arena_bytes,
+                    scale_bytes=self._scale_bytes)
+        logits, self._arenas = self._decode_exec(*args)
         return logits
 
     def _run_prefill(self, tokens, chunk_start, tables, take_idx):
         import jax
 
         pa, ba = self._param_arrays()
-        args = (pa, ba, self._ks, self._vs, tokens, chunk_start, tables,
+        args = (pa, ba, self._arenas, tokens, chunk_start, tables,
                 take_idx)
         if self._prefill_exec is None:
-            jitted = jax.jit(self._prefill_fn, donate_argnums=(2, 3))
+            jitted = jax.jit(self._prefill_fn, donate_argnums=(2,))
             self._prefill_exec = jitted.lower(*args).compile()
-        logits, self._ks, self._vs = self._prefill_exec(*args)
+        logits, self._arenas = self._prefill_exec(*args)
         return logits

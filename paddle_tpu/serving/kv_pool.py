@@ -26,6 +26,11 @@ from typing import Dict, List
 __all__ = ["PagedKVPool", "PoolExhausted", "default_page_tokens",
            "TRASH_PAGE"]
 
+# int8 paging (ISSUE 13) keeps the accounting here and the arrays in the
+# engine, same split as the bf16 pool: kv_quant.py prices a page through
+# analysis.program.DTYPE_BYTES and the engine calls set_page_bytes so the
+# accountant can answer "how many HBM bytes does this pool hold / use"
+
 TRASH_PAGE = 0
 
 
@@ -53,6 +58,36 @@ class PagedKVPool:
         self._free: List[int] = list(range(num_pages - 1, TRASH_PAGE, -1))
         self._tables: Dict[object, List[int]] = {}
         self._peak_used = 0
+        # byte accountant (engine fills in via set_page_bytes): HBM cost
+        # of one page's k+v arena slices and of its scale slices (int8
+        # pages carry f32 per-token scales; 0 in the bf16 pool)
+        self.bytes_per_page = 0
+        self.scale_bytes_per_page = 0
+        self.kv_dtype = "bf16"
+
+    # -- byte accounting ---------------------------------------------------
+    def set_page_bytes(self, arena_bytes: int, scale_bytes: int = 0,
+                       kv_dtype: str = "bf16") -> None:
+        """Record what one page costs in HBM (across all layers, k+v, plus
+        any scale buffers) so occupancy has a byte denomination."""
+        self.bytes_per_page = int(arena_bytes)
+        self.scale_bytes_per_page = int(scale_bytes)
+        self.kv_dtype = str(kv_dtype)
+
+    def pool_bytes(self) -> int:
+        """Total HBM held by the allocatable pages (trash page excluded —
+        it is compiled-shape overhead, not serveable capacity)."""
+        return self.capacity * (self.bytes_per_page +
+                                self.scale_bytes_per_page)
+
+    def used_bytes(self) -> int:
+        return self.pages_used * (self.bytes_per_page +
+                                  self.scale_bytes_per_page)
+
+    def bytes_per_token(self) -> float:
+        """HBM bytes one token slot costs (arena + scales, all layers)."""
+        return (self.bytes_per_page + self.scale_bytes_per_page) \
+            / max(self.page_tokens, 1)
 
     # -- capacity ----------------------------------------------------------
     @property

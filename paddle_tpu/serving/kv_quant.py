@@ -1,0 +1,127 @@
+"""Quantized KV-cache pages: dtype resolution, per-token int8 scales,
+and DTYPE_BYTES-priced page accounting.
+
+The serving MBU wall is raw bytes-per-token (BENCH_r05: 0.576 MBU at 8K
+context); int8 pages halve the cache bytes behind that ceiling AND double
+how many concurrent users a fixed pool holds.  Scheme:
+
+- **Storage** — the page arenas become ``int8`` (exactly half the bf16
+  itemsize) and a per-(token-slot, kv-head) ``float32`` scale rides in a
+  scale arena of shape ``[num_pages, page_tokens, kv_heads]`` alongside
+  each k/v arena.  Scales are computed at WRITE time from the token's own
+  absmax (``scale = max|x| / 127``) — decode writes one token at a time,
+  so per-token scales need no calibration pass and are exact for the
+  token they cover (a per-page scale would need the whole page up front).
+- **Dequant at the load** — the gather that builds a row's paged view
+  multiplies the int8 block by its scale column in the same fused program
+  (and the Pallas decode kernel does the multiply on its k/v block loads),
+  so no dequantized copy of the cache ever materializes in HBM.
+- **Calibration seam** — :func:`observe_kv_absmax` runs the PTQ
+  :class:`~paddle_tpu.quantization.AbsmaxObserver` over sample KV tensors;
+  the per-tensor scale it yields is what a static-scale format (the fp8
+  seam below) needs, and tests use it to sanity-bound the per-token scales
+  against the observed distribution.
+- **fp8 seam** — ``PADDLE_TPU_KV_DTYPE=fp8`` is STUBBED: ``DTYPE_BYTES``
+  already prices ``f8e4m3fn`` so the accounting is ready, but no fp8
+  scatter/gather path is wired; resolving it raises loudly instead of
+  silently serving bf16.
+
+Env: ``PADDLE_TPU_KV_DTYPE=bf16|int8`` (default ``bf16`` = the engine's
+native compute dtype, bit-exact path).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["KV_DTYPES", "kv_cache_dtype", "quantize_kv", "dequantize_kv",
+           "observe_kv_absmax", "kv_page_bytes", "kv_scale_page_bytes"]
+
+KV_DTYPES = ("bf16", "int8")
+_QMAX = 127.0
+_SCALE_EPS = 1e-8       # all-zero tokens (trash page writes) quantize to 0
+
+
+def kv_cache_dtype(override: Optional[str] = None) -> str:
+    """Resolve the KV page dtype: ``override`` beats ``PADDLE_TPU_KV_DTYPE``
+    beats the bit-exact ``bf16`` default.  ``bf16`` means "the engine's
+    native compute dtype" (f32 on the CPU smoke); ``fp8`` is a stubbed
+    seam and raises."""
+    v = (override if override is not None
+         else os.environ.get("PADDLE_TPU_KV_DTYPE", "bf16")).strip().lower()
+    if v in ("bf16", "bfloat16", "native", "f32", "float32", ""):
+        return "bf16"
+    if v in ("int8", "s8"):
+        return "int8"
+    if v in ("fp8", "f8", "f8e4m3fn", "f8e5m2"):
+        raise NotImplementedError(
+            "PADDLE_TPU_KV_DTYPE=fp8: the fp8 KV seam is stubbed — "
+            "analysis.program.DTYPE_BYTES already prices f8e4m3fn pages "
+            "and observe_kv_absmax provides the static per-tensor scale "
+            "it needs, but no fp8 scatter/gather path is wired yet; use "
+            "int8")
+    raise ValueError(
+        f"PADDLE_TPU_KV_DTYPE={v!r}: expected one of {KV_DTYPES} "
+        f"(fp8 is a stubbed seam)")
+
+
+def quantize_kv(x):
+    """Per-token symmetric int8: ``x`` [..., kv, d] → (int8 values, f32
+    scales over the trailing ``d`` axis).  ``dequantize_kv(q, s)`` round-
+    trips to within 1/127 of each token's absmax — exact for zeros."""
+    import jax.numpy as jnp
+
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)                     # [..., kv]
+    scale = jnp.maximum(amax, _SCALE_EPS) / _QMAX
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -_QMAX, _QMAX)
+    return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def dequantize_kv(q, scale):
+    """Inverse of :func:`quantize_kv`: f32 values ``q * scale``."""
+    import jax.numpy as jnp
+
+    return q.astype(jnp.float32) * scale[..., None].astype(jnp.float32)
+
+
+def observe_kv_absmax(samples) -> float:
+    """Run the PTQ :class:`~paddle_tpu.quantization.AbsmaxObserver` over
+    sample KV tensors and return the observed per-tensor absmax — the
+    static-scale calibration the fp8 seam (and scale sanity checks) use.
+    The int8 page path does NOT need this: its per-token scales are
+    computed in-program at write time."""
+    from ..quantization import AbsmaxObserver
+
+    obs = AbsmaxObserver()._instance(None)
+    for x in samples:
+        obs(x)
+    return float(obs.scales().numpy()[0])
+
+
+def _dtype_code(kv_dtype: str) -> str:
+    return {"bf16": "bf16", "int8": "s8", "fp8": "f8e4m3fn"}[kv_dtype]
+
+
+def kv_page_bytes(page_tokens: int, kv_heads: int, head_dim: int,
+                  kv_dtype: str, *, n_layers: int = 1) -> int:
+    """HBM bytes of ONE pool page's k+v arena slices across ``n_layers``,
+    priced through ``analysis.program.DTYPE_BYTES`` (the one table every
+    byte-accounting rule shares).  Excludes scale buffers — see
+    :func:`kv_scale_page_bytes`."""
+    from ..analysis.program import DTYPE_BYTES
+
+    per = DTYPE_BYTES[_dtype_code(kv_dtype)]
+    return 2 * n_layers * page_tokens * kv_heads * head_dim * per
+
+
+def kv_scale_page_bytes(page_tokens: int, kv_heads: int, kv_dtype: str,
+                        *, n_layers: int = 1) -> int:
+    """Bytes of one page's k+v scale slices (f32 per token-slot per
+    kv-head); zero for the unquantized dtype."""
+    from ..analysis.program import DTYPE_BYTES
+
+    if kv_dtype == "bf16":
+        return 0
+    return 2 * n_layers * page_tokens * kv_heads * DTYPE_BYTES["f32"]

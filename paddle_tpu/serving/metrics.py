@@ -108,6 +108,13 @@ class SLOMeter:
         self.shed_total = 0
         self.rejected_total = 0
         self.deadline_misses_total = 0
+        # speculative decoding + quantized-KV gauges (ISSUE 13)
+        self.spec_proposed_total = 0
+        self.spec_accepted_total = 0
+        self.spec_emitted_total = 0
+        self.spec_verify_steps = 0
+        self.spec_rows_total = 0
+        self.kv_bytes_per_token: Optional[float] = None
 
     def clock(self, rid) -> RequestClock:
         return self._clocks[rid]
@@ -253,6 +260,42 @@ class SLOMeter:
         self.occupancy_peak = max(self.occupancy_peak, float(frac))
         set_gauge("serving.kv_pool_occupancy", float(frac))
 
+    def set_kv_bytes_per_token(self, b: float) -> None:
+        """HBM bytes one KV token slot costs (arena + scales, all layers)
+        — the denominator the int8-page halving shows up in."""
+        self.kv_bytes_per_token = float(b)
+        set_gauge("serving.kv_bytes_per_token", float(b))
+
+    def spec_step(self, *, proposed: int, accepted: int, emitted: int,
+                  rows: int) -> None:
+        """One speculative verify step's acceptance bookkeeping across
+        ``rows`` live batch rows: ``proposed`` drafts went in, ``accepted``
+        matched the target's argmax, ``emitted`` tokens came out (always
+        >= rows — each row gets at least the target's own next token)."""
+        self.spec_proposed_total += int(proposed)
+        self.spec_accepted_total += int(accepted)
+        self.spec_emitted_total += int(emitted)
+        self.spec_rows_total += int(rows)
+        self.spec_verify_steps += 1
+        set_gauge("serving.spec_acceptance_rate", self.spec_acceptance())
+        set_gauge("serving.effective_tokens_per_step",
+                  self.effective_tokens_per_step())
+        bump("serving.spec_tokens_proposed_total", int(proposed))
+        bump("serving.spec_tokens_accepted_total", int(accepted))
+
+    def spec_acceptance(self) -> float:
+        """Fraction of drafted tokens the target's own argmax confirmed."""
+        if self.spec_proposed_total <= 0:
+            return 0.0
+        return self.spec_accepted_total / self.spec_proposed_total
+
+    def effective_tokens_per_step(self) -> float:
+        """Mean tokens emitted per row per verify step — the speculative
+        speedup numerator (serial decode is exactly 1.0)."""
+        if self.spec_rows_total <= 0:
+            return 0.0
+        return self.spec_emitted_total / self.spec_rows_total
+
     # -- rollup ------------------------------------------------------------
     def summary(self) -> Dict[str, object]:
         """SLO rollup (milliseconds); percentiles over the bounded window,
@@ -279,6 +322,12 @@ class SLOMeter:
             "deadline_miss_rate": round(self.deadline_miss_rate(), 4),
             "evictions": self.evictions_total,
             "kv_pool_occupancy_peak": round(self.occupancy_peak, 4),
+            "spec_acceptance": (round(self.spec_acceptance(), 4)
+                                if self.spec_verify_steps else None),
+            "effective_tokens_per_step": (
+                round(self.effective_tokens_per_step(), 4)
+                if self.spec_verify_steps else None),
+            "kv_bytes_per_token": self.kv_bytes_per_token,
         }
 
 

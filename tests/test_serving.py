@@ -202,9 +202,9 @@ class TestServingEngine:
 
         pa, ba = eng._param_arrays()
         import jax.numpy as jnp
-        args = (pa, ba, eng._ks, eng._vs,
-                jnp.zeros((2,), jnp.int32), jnp.zeros((2,), jnp.int32),
-                jnp.zeros((2, 4), jnp.int32))
+        args = (pa, ba, eng._arenas,
+                jnp.zeros((2, 1), jnp.int32), jnp.zeros((2,), jnp.int32),
+                jnp.zeros((2, 4), jnp.int32), jnp.ones((2,), jnp.int32))
         bad = jax.jit(eng._decode_fn).lower(*args).compile()
         with pytest.raises(RuntimeError, match="alias"):
             check_decode_donation(bad, eng._arena_bytes)
