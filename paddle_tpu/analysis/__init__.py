@@ -48,6 +48,7 @@ _LAZY = {
     "Finding": ".findings", "LintReport": ".findings",
     "Severity": ".findings",
     "Baseline": ".baseline", "load_baseline": ".baseline",
+    "strict_baseline_enabled": ".baseline",
     "DEFAULT_BASELINE_PATH": ".baseline",
     "parse_partitioner_diagnostics": ".rules.remat",
     "analyze_perm": ".rules.ring", "check_overlap_rings": ".rules.ring",
@@ -70,7 +71,8 @@ def __getattr__(name: str):
 __all__ = [
     "lint", "collect", "run_rules", "RULES",
     "Finding", "LintReport", "Severity", "ProgramArtifacts",
-    "Baseline", "load_baseline", "DEFAULT_BASELINE_PATH",
+    "Baseline", "load_baseline", "strict_baseline_enabled",
+    "DEFAULT_BASELINE_PATH",
     "capture_compile_diagnostics", "jaxpr_primitives",
     "parse_partitioner_diagnostics", "analyze_perm", "check_overlap_rings",
     "check_jax_compat_seam", "check_source_text",

@@ -40,7 +40,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from .findings import Finding
 
-__all__ = ["Baseline", "load_baseline", "DEFAULT_BASELINE_PATH"]
+__all__ = ["Baseline", "load_baseline", "strict_baseline_enabled",
+           "DEFAULT_BASELINE_PATH"]
 
 DEFAULT_BASELINE_PATH = os.path.join(os.path.dirname(__file__),
                                      "baseline.json")
@@ -87,6 +88,15 @@ class Baseline:
     def unused(self) -> List[Dict[str, Any]]:
         return [{k: v for k, v in e.items() if not k.startswith("_")}
                 for e in self.exemptions if e["_used"] == 0]
+
+
+def strict_baseline_enabled() -> bool:
+    """``PADDLE_TPU_LINT_STRICT_BASELINE=1``: an exemption no finding
+    matched is itself an ERROR finding (``stale-baseline-exemption``) —
+    fixed debt must have its entry deleted, not left to silently exempt
+    the next regression at the same site.  On in the dryrun gate."""
+    return os.environ.get("PADDLE_TPU_LINT_STRICT_BASELINE", "0") \
+        not in ("0", "false", "")
 
 
 def load_baseline(path: Optional[str] = None) -> Baseline:

@@ -20,8 +20,8 @@ from __future__ import annotations
 
 from typing import Any, Callable, List, Optional, Sequence
 
-from .baseline import Baseline, load_baseline
-from .findings import LintReport
+from .baseline import Baseline, load_baseline, strict_baseline_enabled
+from .findings import Finding, LintReport, Severity
 from .program import ProgramArtifacts, collect
 from .rules import run_rules
 
@@ -60,6 +60,21 @@ def lint(target, args: Sequence[Any] = (), rules: Optional[List[str]] = None,
         unused = bl.unused()
     else:
         new, exempted, unused = findings, [], []
+    if unused and strict_baseline_enabled():
+        # strict mode (dryrun gate): a stale exemption is debt the table
+        # still claims but the program no longer has — delete the entry
+        for e in unused:
+            new.append(Finding(
+                rule="stale-baseline-exemption",
+                severity=Severity.ERROR,
+                subject=f"{e.get('rule', '*')}: {e.get('match', '')!r}",
+                message="baseline exemption matched no finding in this "
+                        "program; delete the entry from "
+                        f"{getattr(bl, 'path', 'baseline.json')} "
+                        f"(reason was: {e.get('reason', '?')})",
+                fix="remove the exemption, or fix its regex if the defect "
+                    "still exists under a different signature",
+                source=getattr(bl, "path", None)))
     report = LintReport(
         name=artifacts.name, findings=new, exempted=exempted,
         unused_exemptions=unused,

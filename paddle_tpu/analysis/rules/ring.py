@@ -187,16 +187,22 @@ def check_overlap_rings(mesh, axis: str = "model") -> List[Finding]:
     x = jax.ShapeDtypeStruct((rows, k), jnp.float32)
     w = jax.ShapeDtypeStruct((k, n), jnp.float32)
     g_ag = jax.ShapeDtypeStruct((rows, n), jnp.float32)
+    # seq variants: [b, s, K] with b over the row axes and s over the ring
+    xs = jax.ShapeDtypeStruct((row_prod * 2, p * 2, k), jnp.float32)
+    gs = jax.ShapeDtypeStruct((row_prod * 2, p * 2, n), jnp.float32)
 
     findings: List[Finding] = []
-    for name, fn, gshape in (
-            ("all_gather_matmul", cm._ag_mm_fn(mesh, axis), g_ag),
-            ("matmul_reduce_scatter", cm._mm_rs_fn(mesh, axis), g_ag)):
+    for name, fn, x_sd, gshape in (
+            ("all_gather_matmul", cm._ag_mm_fn(mesh, axis), x, g_ag),
+            ("matmul_reduce_scatter", cm._mm_rs_fn(mesh, axis), x, g_ag),
+            ("all_gather_matmul_seq", cm._ag_mm_seq_fn(mesh, axis), xs, gs),
+            ("matmul_reduce_scatter_seq",
+             cm._mm_rs_seq_fn(mesh, axis), xs, gs)):
         legs = {
             "fwd": lambda xx, ww, f=fn: f(xx, ww),
             "vjp": lambda xx, ww, gg, f=fn: jax.vjp(f, xx, ww)[1](gg),
         }
-        leg_args = {"fwd": (x, w), "vjp": (x, w, gshape)}
+        leg_args = {"fwd": (x_sd, w), "vjp": (x_sd, w, gshape)}
         tables: Dict[str, List[Tuple]] = {}
         for leg, lf in legs.items():
             try:

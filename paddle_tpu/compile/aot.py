@@ -76,6 +76,16 @@ def fingerprint(stablehlo_text: str, extras: Optional[Dict[str, Any]] = None,
         env["overlap"] = overlap_fingerprint()
     except Exception:
         pass
+    try:
+        # sequence-parallel identity: PADDLE_TPU_SP flips the activation
+        # layout between TP regions (seq-sharded ag/rs vs replicated
+        # all-reduce) — a different program even when the model source and
+        # the rest of the env agree
+        from ..distributed.meta_parallel import sp_fingerprint
+
+        env["sp"] = sp_fingerprint()
+    except Exception:
+        pass
     if extras:
         env["extras"] = extras
     h = hashlib.sha256()

@@ -40,7 +40,39 @@ from ..tensor.tensor import Tensor
 from .topology import HybridCommunicateGroup
 from ..framework.jax_compat import pcast as _pcast, shard_map as _shard_map
 
-__all__ = ["DistributedTrainStep", "ScannedLayers", "GPipeLayers", "gpipe_spmd_step"]
+__all__ = ["DistributedTrainStep", "ScannedLayers", "GPipeLayers",
+           "gpipe_spmd_step", "param_storage_spec", "state_storage_spec",
+           "param_compute_spec", "grad_comm_axes"]
+
+
+# -- sharding spec policy (the ONE home) ------------------------------------
+#
+# Three layouts exist for every parameter, derived here and nowhere else:
+#
+#   layer   — what the model's layers built (TP "model" dims, the pipe-
+#             stacked leading dim): ``_current_spec`` reads it off the
+#             placed array.
+#   storage — layer + the ZeRO "sharding" axis on the largest divisible
+#             dim (params at stage >= 3, optimizer states / fp32 masters
+#             at stage >= 1): what device_put and the compiled step's
+#             in/out_shardings pin.  :func:`param_storage_spec` /
+#             :func:`state_storage_spec`.
+#   compute — storage MINUS the engine-added "sharding" axis (== layer):
+#             the just-in-time gather layout every forward/backward use
+#             sees.  :func:`param_compute_spec`.  The step constrains its
+#             run params to it (``TrainStep._constrain_compute``) so the
+#             ZeRO storage sharding never propagates into activation
+#             layouts.  Before this constraint existed, GSPMD pushed
+#             hidden-dim "sharding" shards from small params (norm
+#             scales, biases) into the scanned decoder's activations,
+#             where they collided with the ("data","sharding") batch
+#             layout and the partitioner fell back to replicate-then-
+#             repartition at every scan boundary — the involuntary-remat
+#             family that used to be pinned in analysis/baseline.json.
+#
+# Gradient communication shares the same home: :func:`grad_comm_axes` is
+# the reduction-axes tuple both the GradientBucketer constraint and the
+# engine's collective telemetry use.
 
 
 def _current_spec(arr, mesh: Mesh) -> List:
@@ -54,19 +86,87 @@ def _current_spec(arr, mesh: Mesh) -> List:
 
 
 def _add_axis(spec: List, axis: str, mesh: Mesh, shape) -> List:
-    """Shard the largest still-unsharded divisible dim over ``axis``."""
+    """Shard the FIRST still-unsharded divisible dim over ``axis``.
+
+    Row-major-leading on purpose: a flat (bucketed) tensor sharded
+    contiguously un-flattens onto a leading-dim tiling for free, so the
+    grad-bucket → storage-layout hop stays a nested reshard instead of a
+    replicate-then-repartition (the heuristic used to pick the LARGEST
+    dim, which put "sharding" on trailing dims and forced exactly that
+    fallback at every bucket split)."""
     size = mesh.shape[axis]
     if size == 1:
         return spec
     for s in spec:  # already sharded on this axis (e.g. placed by a prior pass)
         if s == axis or (isinstance(s, tuple) and axis in s):
             return spec
-    order = sorted(range(len(shape)), key=lambda d: -shape[d])
-    for d in order:
-        if spec[d] is None and shape[d] % size == 0 and shape[d] >= size:
-            spec[d] = axis
+
+    def _axes_of(entry):
+        if entry is None:
+            return ()
+        return entry if isinstance(entry, tuple) else (entry,)
+
+    def _tiling(entry):
+        n = 1
+        for a in _axes_of(entry):
+            n *= mesh.shape.get(a, 1)
+        return n
+
+    # A dim whose entry only names size-1 axes (e.g. "model" on an mp=1
+    # mesh) is not actually tiled: fold "sharding" in as a tuple rather
+    # than skipping to a later dim, which would break the leading-dim
+    # nesting with the flat gradient bucket.
+    for d in range(len(shape)):
+        if _tiling(spec[d]) == 1 and shape[d] % size == 0 and shape[d] >= size:
+            prior = _axes_of(spec[d])
+            spec[d] = prior + (axis,) if prior else axis
             return spec
     return spec  # nothing divisible: stay replicated on this axis
+
+
+def _strip_axis(spec: List, axis: str) -> List:
+    """Remove ``axis`` from a spec (inverse of ``_add_axis``): the entry
+    becomes None, or the remaining members of a tuple entry."""
+    out: List = []
+    for s in spec:
+        if s == axis:
+            out.append(None)
+        elif isinstance(s, tuple) and axis in s:
+            rest = tuple(a for a in s if a != axis)
+            out.append(rest if len(rest) > 1 else (rest[0] if rest else None))
+        else:
+            out.append(s)
+    return out
+
+
+def param_storage_spec(arr, mesh: Mesh, stage: int) -> P:
+    """Parameter STORAGE layout: layer layout + ZeRO-3 "sharding"."""
+    spec = _current_spec(arr, mesh)
+    if stage >= 3:
+        spec = _add_axis(spec, "sharding", mesh, arr.shape)
+    return P(*spec)
+
+
+def state_storage_spec(arr, mesh: Mesh, stage: int) -> P:
+    """Optimizer-state / master STORAGE layout: sharded from stage 1."""
+    spec = _current_spec(arr, mesh)
+    if stage >= 1:
+        spec = _add_axis(spec, "sharding", mesh, arr.shape)
+    return P(*spec)
+
+
+def param_compute_spec(storage: P) -> P:
+    """COMPUTE (just-in-time gather) layout: storage minus the engine's
+    "sharding" axis — the layer layout the model's uses expect."""
+    return P(*_strip_axis(list(storage), "sharding"))
+
+
+def grad_comm_axes(mesh: Mesh) -> tuple:
+    """The sized gradient-reduction axes (DP × ZeRO), SHARDING-major: the
+    bucket tiles then nest inside the "sharding"-only storage shards, so
+    the post-comm reshard is a subgroup all-gather over "data" instead of
+    a replicate-then-repartition of the whole bucket."""
+    return tuple(a for a in ("sharding", "data") if mesh.shape.get(a, 1) > 1)
 
 
 class DistributedTrainStep(TrainStep):
@@ -108,8 +208,10 @@ class DistributedTrainStep(TrainStep):
                          health_guard=health_guard,
                          persistent_cache=persistent_cache,
                          snapshotter=snapshotter)
-        self._grad_bucketer = self._build_bucketer()
         self._place_state()
+        # after placement: the bucket plan reads each param's compute spec
+        # to keep TP-tiled grads out of the flat buckets
+        self._grad_bucketer = self._build_bucketer()
         # every compiled variant must pin the SAME shardings (else XLA is
         # free to re-lay state out and the next differently-compiled step
         # rejects it) — one source of truth for the pinning tuples
@@ -166,12 +268,28 @@ class DistributedTrainStep(TrainStep):
             getattr(self.optimizer, "_grad_bucket_bytes", None))
         if bb <= 0:
             return None
-        sizes, keys = [], []
-        for p in self._params:
+        def _keeps_other_tiling(spec: P) -> bool:
+            # a grad that must stay tiled on an axis OUTSIDE the reduction
+            # axes (TP "model" dims; SP pins those layouts hard via the
+            # ring programs' shard_map types) cannot ride a flat bucket —
+            # the 1-D concat drops the tiling and the partitioner gathers
+            # it back as an involuntary full remat. Reduce those grads
+            # per-tensor on their native layout instead (the Megatron TP
+            # grad path); everything DP/ZeRO-only still buckets.
+            red = set(grad_comm_axes(self.mesh))
+            for entry in spec:
+                for a in (entry if isinstance(entry, tuple) else (entry,)):
+                    if a and a not in red and self.mesh.shape.get(a, 1) > 1:
+                        return True
+            return False
+
+        sizes, keys, skip = [], [], []
+        for p, cs in zip(self._params, self._compute_shardings):
             sizes.append(p._value.size * p._value.dtype.itemsize)
             keys.append(str(p._value.dtype))
+            skip.append(_keeps_other_tiling(cs.spec))
         bucketer = GradientBucketer(sizes, bucket_bytes=bb, keys=keys,
-                                    reverse=True)
+                                    reverse=True, skip=skip)
         try:
             from .. import telemetry
 
@@ -190,7 +308,14 @@ class DistributedTrainStep(TrainStep):
         # grads pair with compute_params (fp32 masters for bf16 params):
         # the bucket plan keyed per-param dtype still applies bucket
         # boundaries; coalescing uses each grad's actual dtype
-        return b.constrain(grads, self.mesh, axes=("data", "sharding"))
+        grads = b.constrain(grads, self.mesh, axes=grad_comm_axes(self.mesh))
+        # land each split grad directly on the STATE storage layout the
+        # optimizer update consumes — without this the partitioner
+        # reconciles the bucket layout with the storage layout at the
+        # un-flatten reshape via replicate-then-repartition (the last
+        # involuntary-remat the old baseline pinned at bucketer.py)
+        return [jax.lax.with_sharding_constraint(g, s)
+                for g, s in zip(grads, self._grad_shardings)]
 
     def _fingerprint_extras(self, tag):
         """AOT fingerprint identity for the sharded step: mesh shape +
@@ -218,27 +343,37 @@ class DistributedTrainStep(TrainStep):
         annotate_device_placement implementation (probed empirically)."""
         return jax.devices()[0].platform == "tpu"
 
-    # -- sharding rules ---------------------------------------------------
+    # -- sharding rules (delegating to the module-level spec policy) ------
     def _param_spec(self, p: Tensor) -> P:
-        spec = _current_spec(p._value, self.mesh)
-        if self.sharding_stage >= 3:
-            spec = _add_axis(spec, "sharding", self.mesh, p._value.shape)
-        return P(*spec)
+        return param_storage_spec(p._value, self.mesh, self.sharding_stage)
 
     def _state_spec(self, p: Tensor) -> P:
-        spec = _current_spec(p._value, self.mesh)
-        if self.sharding_stage >= 1:
-            spec = _add_axis(spec, "sharding", self.mesh, p._value.shape)
-        return P(*spec)
+        return state_storage_spec(p._value, self.mesh, self.sharding_stage)
+
+    def _constrain_compute(self, arrays):
+        """Pin each run param to its COMPUTE spec (storage minus ZeRO
+        "sharding") so the just-in-time gather happens at the param, not
+        wherever GSPMD first reconciles the storage layout with the
+        activation layout (the old scan-boundary remats)."""
+        return [jax.lax.with_sharding_constraint(a, s)
+                for a, s in zip(arrays, self._compute_shardings)]
 
     def _place_state(self):
         mesh = self.mesh
         self._param_shardings = []
+        self._compute_shardings = []
+        self._grad_shardings = []
         self._state_shardings = []
         for p in self._params:
             ps = NamedSharding(mesh, self._param_spec(p))
             p._value = jax.device_put(p._value, ps)
             self._param_shardings.append(ps)
+            self._compute_shardings.append(
+                NamedSharding(mesh, param_compute_spec(ps.spec)))
+            # grads land on the state storage layout (device memory — the
+            # offload memory kind applies to resident states only)
+            self._grad_shardings.append(
+                NamedSharding(mesh, self._state_spec(p)))
             # offload (reference `group_sharded_stage3.py:85` offload=True →
             # CPU slices): optimizer states + master weights live in host
             # memory; XLA streams them through the update
@@ -288,8 +423,7 @@ class DistributedTrainStep(TrainStep):
                 if not getattr(p, "stop_gradient", False))
             kind = "reduce_scatter" if (self.sharding_stage >= 1
                                         and n_shard > 1) else "all_reduce"
-            axes = [a for a, n in (("data", n_data), ("sharding", n_shard))
-                    if n > 1]
+            axes = list(grad_comm_axes(self.mesh))
             if self._grad_bucketer is not None:
                 # bucketed: one reduce-scatter per bucket (reverse-
                 # topological firing order) instead of a monolithic one

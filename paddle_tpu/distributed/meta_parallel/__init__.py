@@ -7,6 +7,8 @@ from .pipeline_parallel import (PipelineParallel,  # noqa: F401
 from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
 from .sequence_parallel import (AllGatherOp, ColumnSequenceParallelLinear, GatherOp,  # noqa: F401
                                 ReduceScatterOp, RowSequenceParallelLinear, ScatterOp,
+                                is_sequence_parallel_parameter,
                                 mark_as_sequence_parallel_parameter,
-                                register_sequence_parallel_allreduce_hooks)
+                                register_sequence_parallel_allreduce_hooks,
+                                sequence_parallel_enabled, sp_fingerprint)
 from .context_parallel import ring_attention, ulysses_attention  # noqa: F401

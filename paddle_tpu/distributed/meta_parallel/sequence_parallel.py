@@ -1,15 +1,38 @@
 """Megatron-style sequence parallelism (reference:
 `fleet/utils/sequence_parallel_utils.py` — ScatterOp:84/GatherOp:96/
 AllGatherOp:110/ReduceScatterOp:126 PyLayers, ColumnSequenceParallelLinear:229,
-RowSequenceParallelLinear:339, mark_as_sequence_parallel_parameter:147).
+RowSequenceParallelLinear:339, mark_as_sequence_parallel_parameter:147;
+Korthikanti et al., "Reducing Activation Recomputation in Large Transformer
+Models").
 
-TPU-native: activations between TP regions carry a seq-dim sharding over the
-"model" axis (constraint), so XLA emits exactly the reference's
-allgather-before-column / reduce-scatter-after-row pattern fused into the
-matmuls. The op classes are kept as callable parity shims that apply/release
-the seq-dim constraint."""
+Between TP regions the activations live SEQ-SHARDED over the "model" axis
+(the SP residency): the residual stream, norms and dropout touch 1/mp of
+the tokens per device, and the two collectives per TP region become an
+all-gather before the column matmul and a reduce-scatter after the row
+matmul — same wire bytes as the all-reduce they replace, but splittable
+and overlappable.
+
+Two lowerings, chosen per call by :func:`~paddle_tpu.distributed.overlap.
+should_decompose_seq`:
+
+- **ring** (``PADDLE_TPU_TP_OVERLAP``, shapes above the chunk threshold):
+  the seq-dim ag/rs rides the SAME ring ``shard_map`` programs as PR 5's
+  collective matmul (``all_gather_matmul_seq`` / ``matmul_reduce_scatter_seq``
+  in ``distributed/overlap/collective_matmul.py``) — partial dots hide the
+  ppermute hops, custom_vjp mirrors the rings in backward;
+- **fused GSPMD** (small shapes, sep>1, pipe>1, or overlap disabled):
+  sharding constraints express the residency and XLA fuses the
+  collectives into the matmuls.
+
+The op classes are callable parity shims that apply/release the seq-dim
+constraint; ``sequence_parallel_enabled`` is the ONE gate (flag wins,
+``PADDLE_TPU_SP`` overrides, default on when mp>1) and
+``sp_fingerprint`` folds it into the compile cache key."""
 
 from __future__ import annotations
+
+import os
+from typing import Optional
 
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -24,10 +47,37 @@ from .mp_layers import _U, _constrain, _last_dim_spec, _mesh, _shard_param
 __all__ = ["ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
            "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
            "mark_as_sequence_parallel_parameter", "is_sequence_parallel_parameter",
-           "register_sequence_parallel_allreduce_hooks"]
+           "register_sequence_parallel_allreduce_hooks",
+           "sequence_parallel_enabled", "sp_fingerprint"]
 
 _SEQ_AXIS = 0  # paddle SP convention: [s, b, h] with seq leading; we accept [b, s, h]
                # via seq_dim arg defaulting to 1 (batch-first framework layout)
+
+
+def sequence_parallel_enabled(flag: Optional[bool] = None) -> bool:
+    """The ONE sequence-parallel gate.
+
+    Precedence: an explicit model/config ``flag`` wins; else the
+    ``PADDLE_TPU_SP`` env knob ("0"/"false" off, anything else on); else
+    default ON exactly when a hybrid group with model degree >= 2 is
+    live — SP costs nothing extra in wire bytes, so mp>1 always wants it."""
+    if flag is not None:
+        return bool(flag)
+    v = os.environ.get("PADDLE_TPU_SP")
+    if v is not None:
+        return v not in ("0", "false")
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        return False
+    return hcg.mesh.shape.get("model", 1) > 1
+
+
+def sp_fingerprint() -> dict:
+    """Compile-cache key material for the SP config (the
+    ``overlap_fingerprint`` pattern): toggling ``PADDLE_TPU_SP`` must
+    never warm-load an executable compiled for the other residency."""
+    return {"sp_env": os.environ.get("PADDLE_TPU_SP"),
+            "sp": sequence_parallel_enabled()}
 
 
 def _seq_spec(ndim: int, seq_dim: int) -> P:
@@ -67,11 +117,17 @@ class ReduceScatterOp:
 
 
 def mark_as_sequence_parallel_parameter(parameter: Tensor) -> None:
-    """Tag params living in the SP region (LayerNorm weights etc.): their
-    grads must be summed over the mp group (reference :147, hooks at :191).
-    Under GSPMD this happens automatically (grad of a replicated param used
-    by sharded activations is psummed); the tag is kept for the hybrid
-    optimizer's bookkeeping/tests."""
+    """Tag params living in the SP region (LayerNorm scales/biases etc.):
+    their grads are produced from 1/mp of the tokens per device and must be
+    SUMMED over the mp group (reference :147, hooks at :191).
+
+    On this engine the sum is emitted by the SPMD partitioner: the param is
+    replicated over "model" while the activations it touches are
+    seq-sharded, so its cotangent is Partial over "model" and lowers to the
+    exact all-reduce the reference's backward hook issues (verified
+    analytically by ``tests/test_sequence_parallel.py``). The tag feeds
+    :func:`register_sequence_parallel_allreduce_hooks`' bookkeeping and the
+    hybrid grad-clip."""
     parameter.sequence_parallel = True  # type: ignore[attr-defined]
 
 
@@ -79,16 +135,81 @@ def is_sequence_parallel_parameter(parameter: Tensor) -> bool:
     return getattr(parameter, "sequence_parallel", False)
 
 
-def register_sequence_parallel_allreduce_hooks(model: Layer, accumulation_steps: int = 1,
-                                               fuse_sequence_parallel_allreduce: bool = False):
-    """Parity no-op on TPU: GSPMD already reduces SP-param grads over the
-    model axis (see mark_as_sequence_parallel_parameter)."""
+def register_sequence_parallel_allreduce_hooks(
+        model: Layer, accumulation_steps: int = 1,
+        fuse_sequence_parallel_allreduce: bool = False) -> Layer:
+    """Wire the SP-parameter grad reduction for ``model`` (reference :191).
+
+    The reference registers a backward hook per marked param that
+    all-reduces its grad over the mp group (optionally fused across
+    params). Here the reduction itself is the partitioner's job — a
+    replicated param consumed by "model"-seq-sharded activations gets a
+    Partial cotangent that GSPMD lowers to that same all-reduce — so this
+    function does the part that is NOT automatic:
+
+    - auto-marks the params of SP-region sublayers (anything that is not a
+      parallel linear/embedding: norms, biases, rotary scales) so
+      ``is_sequence_parallel_parameter`` and the hybrid grad-clip see them;
+    - records the accumulation contract on each marked param
+      (``p._sp_accumulation_steps``) for the gradient-merge engine;
+    - refuses loudly where the automatic path does not exist.
+    """
+    if fuse_sequence_parallel_allreduce:
+        raise NotImplementedError(
+            "fuse_sequence_parallel_allreduce=True is the reference's "
+            "manually-fused allreduce; on the GSPMD engine the mp-axis "
+            "grad reduction is emitted by the partitioner per-param and "
+            "fusing it by hand would fight the latency-hiding scheduler. "
+            "Leave it False.")
+    if accumulation_steps < 1:
+        raise ValueError(f"accumulation_steps must be >= 1, "
+                         f"got {accumulation_steps}")
+    from .mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                            VocabParallelEmbedding)
+
+    tp_types = (ColumnParallelLinear, RowParallelLinear,
+                VocabParallelEmbedding, ColumnSequenceParallelLinear,
+                RowSequenceParallelLinear)
+    for layer in model.sublayers(include_self=True):
+        if isinstance(layer, tp_types):
+            continue
+        for p in layer.parameters(include_sublayers=False):
+            if not getattr(p, "is_distributed", False):
+                mark_as_sequence_parallel_parameter(p)
+    for p in model.parameters():
+        if is_sequence_parallel_parameter(p):
+            p._sp_accumulation_steps = accumulation_steps  # type: ignore
     return model
+
+
+def _overlap_linear_seq(kind: str, x: Tensor, weight: Tensor, bias,
+                        mesh) -> Tensor:
+    """Ring path for one SP parallel-linear call: the seq-dim all-gather /
+    reduce-scatter rides the collective-matmul rings (same programs PR 5's
+    flat variants use, one rank up — ``collective_matmul.py``). Bias is
+    added outside the manual region. Caller has already decided via
+    ``should_decompose_seq``."""
+    from ...amp import maybe_autocast_tensors
+    from ..overlap import all_gather_matmul_seq, matmul_reduce_scatter_seq
+
+    x, weight = maybe_autocast_tensors("linear", x, weight)
+    if bias is not None:
+        (bias,) = maybe_autocast_tensors("linear", bias)
+    prim = (all_gather_matmul_seq if kind == "column"
+            else matmul_reduce_scatter_seq)
+
+    def fn(xv, wv, *bv):
+        out = prim(xv, wv, mesh)
+        return out + bv[0] if bv else out
+
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return apply_op(f"collective_matmul_{kind}_seq", fn, args)
 
 
 class ColumnSequenceParallelLinear(Layer):
     """Column-parallel linear whose INPUT arrives seq-sharded; the seq
-    all-gather fuses into the matmul boundary (reference :229)."""
+    all-gather fuses into the matmul boundary (reference :229) — or, on
+    the ring path, hides under its partial dots."""
 
     def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
                  gather_output=False, mp_group=None, name=None):
@@ -111,11 +232,19 @@ class ColumnSequenceParallelLinear(Layer):
         self._mesh = mesh
 
     def forward(self, x):
-        # input is seq-sharded [b, s/mp, h]; gather seq, shard hidden out
-        spec = [_U] * x.ndim
-        spec[1] = None
-        x = _constrain(x, P(*spec), self._mesh)
-        out = F.linear(x, self.weight, self.bias)
+        from ..overlap import should_decompose_seq
+
+        if should_decompose_seq(tuple(x.shape), self._mesh):
+            # ring gather(X over seq) @ W: the seq all-gather hides under
+            # the partial matmuls (PADDLE_TPU_TP_OVERLAP)
+            out = _overlap_linear_seq("column", x, self.weight, self.bias,
+                                      self._mesh)
+        else:
+            # fused GSPMD: release the seq shard, shard the out dim
+            spec = [_U] * x.ndim
+            spec[1] = None
+            x = _constrain(x, P(*spec), self._mesh)
+            out = F.linear(x, self.weight, self.bias)
         if self.gather_output:
             return _constrain(out, _last_dim_spec(out.ndim, None), self._mesh)
         return _constrain(out, _last_dim_spec(out.ndim, "model"), self._mesh)
@@ -123,7 +252,8 @@ class ColumnSequenceParallelLinear(Layer):
 
 class RowSequenceParallelLinear(Layer):
     """Row-parallel linear whose OUTPUT leaves seq-sharded via
-    reduce-scatter (reference :339)."""
+    reduce-scatter (reference :339) — fused into the matmul by GSPMD, or
+    run as the mirrored partial-sum ring."""
 
     def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
                  input_is_parallel=True, mp_group=None, name=None):
@@ -139,12 +269,25 @@ class RowSequenceParallelLinear(Layer):
         self.weight.split_axis = 0
         self.bias = self.create_parameter([out_features], attr=None, is_bias=True) \
             if has_bias else None
+        if self.bias is not None:
+            # applied to the seq-sharded output after the reduction →
+            # replicated, marked SP so its grad gets the mp-axis sum
+            mark_as_sequence_parallel_parameter(self.bias)
         self._mesh = mesh
         self.input_is_parallel = input_is_parallel
 
     def forward(self, x, seq_dim: int = 1):
+        from ..overlap import should_decompose_seq
+
         if not self.input_is_parallel:
             x = _constrain(x, _last_dim_spec(x.ndim, "model"), self._mesh)
+        if seq_dim == x.ndim - 2 and \
+                should_decompose_seq(tuple(x.shape), self._mesh):
+            # ring reduce_scatter(X @ W over seq): lands directly on the
+            # SP residency, partial-sum hops hidden under the dots
+            return _overlap_linear_seq("row", x, self.weight, self.bias,
+                                       self._mesh)
         out = F.linear(x, self.weight, self.bias)
-        # reduce partials + shard seq dim in one constraint (reduce-scatter)
+        # reduce partials + shard the seq dim in one constraint
+        # (reduce-scatter)
         return _constrain(out, _seq_spec(out.ndim, seq_dim), self._mesh)

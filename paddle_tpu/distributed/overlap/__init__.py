@@ -21,8 +21,11 @@ cached executable.
 from .bucketer import (DEFAULT_BUCKET_MB, GradientBucketer,  # noqa: F401
                        grad_bucket_bytes)
 from .collective_matmul import (MODEL_AXIS, all_gather_matmul,  # noqa: F401
-                                matmul_reduce_scatter, overlap_min_rows,
-                                should_decompose, tp_overlap_enabled)
+                                all_gather_matmul_seq,
+                                matmul_reduce_scatter,
+                                matmul_reduce_scatter_seq, overlap_min_rows,
+                                should_decompose, should_decompose_seq,
+                                tp_overlap_enabled)
 from .measure import (hidden_comm_seconds,  # noqa: F401
                       overlap_fraction_from_trace)
 from .xla_flags import (OVERLAP_TPU_FLAGS, apply_overlap_xla_flags,  # noqa: F401
@@ -31,6 +34,8 @@ from .xla_flags import (OVERLAP_TPU_FLAGS, apply_overlap_xla_flags,  # noqa: F40
 
 __all__ = [
     "all_gather_matmul", "matmul_reduce_scatter", "should_decompose",
+    "all_gather_matmul_seq", "matmul_reduce_scatter_seq",
+    "should_decompose_seq",
     "tp_overlap_enabled", "overlap_min_rows", "MODEL_AXIS",
     "GradientBucketer", "grad_bucket_bytes", "DEFAULT_BUCKET_MB",
     "overlap_xla_flags", "apply_overlap_xla_flags", "applied_overlap_flags",

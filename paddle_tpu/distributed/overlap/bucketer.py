@@ -55,7 +55,8 @@ class GradientBucketer:
     order (see module docstring)."""
 
     def __init__(self, sizes: Sequence[int], bucket_bytes: Optional[int] = None,
-                 keys: Optional[Sequence[Any]] = None, reverse: bool = True):
+                 keys: Optional[Sequence[Any]] = None, reverse: bool = True,
+                 skip: Optional[Sequence[bool]] = None):
         self.sizes = [int(s) for s in sizes]
         self.bucket_bytes = grad_bucket_bytes(bucket_bytes)
         self.reverse = bool(reverse)
@@ -63,12 +64,22 @@ class GradientBucketer:
         if len(keys) != len(self.sizes):
             raise ValueError("keys and sizes must have equal length")
         self.keys = keys
+        # skip[i]: leave tensor i out of every bucket (it passes through
+        # constrain() untouched). A flat 1-D bucket can only express a
+        # contiguous leading-dim tiling — a grad that must KEEP a tiling on
+        # another mesh axis (TP "model" dims) cannot ride a bucket without
+        # the partitioner gathering that axis back (involuntary remat);
+        # such grads reduce per-tensor on their native layout instead.
+        skip = list(skip) if skip is not None else [False] * len(self.sizes)
+        if len(skip) != len(self.sizes):
+            raise ValueError("skip and sizes must have equal length")
+        self.skip = [bool(s) for s in skip]
         self.buckets: List[List[int]] = self._plan()
 
     def _plan(self) -> List[List[int]]:
-        order = range(len(self.sizes))
+        order = (i for i in range(len(self.sizes)) if not self.skip[i])
         if self.reverse:
-            order = reversed(order)
+            order = reversed(list(order))
         buckets: List[List[int]] = []
         cur: List[int] = []
         cur_bytes = 0
@@ -145,4 +156,6 @@ class GradientBucketer:
         sharding = NamedSharding(mesh, P(spec))
         flats = self.coalesce(grads)
         flats = [jax.lax.with_sharding_constraint(f, sharding) for f in flats]
-        return self.split(flats, [tuple(g.shape) for g in grads])
+        out = self.split(flats, [tuple(g.shape) for g in grads])
+        # skipped tensors belong to no bucket: pass their grads through
+        return [g if o is None else o for o, g in zip(out, grads)]

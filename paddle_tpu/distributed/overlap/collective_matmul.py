@@ -56,7 +56,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ...framework.jax_compat import bound_axis_names, shard_map
 
-__all__ = ["all_gather_matmul", "matmul_reduce_scatter", "should_decompose",
+__all__ = ["all_gather_matmul", "matmul_reduce_scatter",
+           "all_gather_matmul_seq", "matmul_reduce_scatter_seq",
+           "should_decompose", "should_decompose_seq",
            "tp_overlap_enabled", "overlap_min_rows", "MODEL_AXIS"]
 
 MODEL_AXIS = "model"
@@ -198,6 +200,110 @@ def _dw_circulate_g(idx, x_blk, g_blk, axis: str, p: int):
     return dw
 
 
+def should_decompose_seq(x_shape: Sequence[int], mesh: Mesh,
+                         axis: str = MODEL_AXIS) -> bool:
+    """Gate for the sequence-parallel ring entry points: ``x`` is the
+    GLOBAL [..., seq, K] activation whose seq dim is ring-sharded between
+    TP regions. Same gates as :func:`should_decompose` (the per-step
+    chunk has rows_local == rows // (p * batch axes) either way), plus
+    seq divisibility by the ring and no "sep" tiling — context parallelism
+    already owns the seq dim there, and a composite (sep, model) tiling of
+    one dim is better served by the fused GSPMD path."""
+    if len(x_shape) < 3:
+        return False
+    p = mesh.shape.get(axis, 1)
+    if p < 2 or int(x_shape[-2]) % p:
+        return False
+    if mesh.shape.get("sep", 1) > 1:
+        return False
+    b = 1
+    for d in x_shape[:-2]:
+        b *= int(d)
+    for a in _row_axes(mesh):
+        if b % mesh.shape[a]:
+            return False
+        b //= mesh.shape[a]
+    return should_decompose(x_shape, mesh, axis)
+
+
+# -- sequence-parallel ring bodies ------------------------------------------
+#
+# Same rings, one rank higher: the circulated chunk is a [b_loc, s/p, K]
+# SEQ slice instead of a flattened row block. A seq-sharded [b, s, h]
+# tensor does NOT reshape onto the flattened P((row, axis)) layout when
+# each data group holds >1 batch row (the tiles interleave), so the 2-D
+# bodies can't be reused via reshape — but the ring structure (permute
+# schedule, update/slice offsets, accumulation order) is identical, and
+# the ring-consistency audit (analysis/rules/ring.py) checks both
+# families against the same canonical rotation tables.
+
+
+def _ag_mm_seq_local(idx, x_blk, w_blk, axis: str, p: int):
+    """Seq-dim gather(X) @ W: x_blk [b, s/p, K] (this shard's seq slice),
+    w_blk [K, n_loc] → [b, s, n_loc] (full seq, local columns)."""
+    m = x_blk.shape[1]
+    out = jnp.zeros((x_blk.shape[0], p * m, w_blk.shape[1]),
+                    jnp.result_type(x_blk, w_blk))
+    chunk = x_blk
+    for i in range(p):
+        part = jnp.dot(chunk, w_blk)
+        out = jax.lax.dynamic_update_slice(
+            out, part.astype(out.dtype), (0, ((idx + i) % p) * m, 0))
+        if i != p - 1:
+            chunk = jax.lax.ppermute(chunk, axis, perm=_ring_perm(p))
+    return out
+
+
+def _mm_rs_seq_local(idx, a_blk, b_blk, axis: str, p: int):
+    """Seq-dim reduce_scatter(A @ B): a_blk [b, s, j_loc], b_blk [j_loc, n]
+    → [b, s/p, n] (this shard's summed seq slice)."""
+    m = a_blk.shape[1] // p
+    acc = None
+    for i in range(p):
+        blk = (idx + i + 1) % p
+        rows = jax.lax.dynamic_slice(
+            a_blk, (0, blk * m, 0), (a_blk.shape[0], m, a_blk.shape[2]))
+        part = jnp.dot(rows, b_blk)
+        acc = part if acc is None else acc + part
+        if i != p - 1:
+            acc = jax.lax.ppermute(acc, axis, perm=_ring_perm(p))
+    return acc
+
+
+def _dw_circulate_x_seq(idx, x_blk, g_blk, axis: str, p: int):
+    """dW for the seq gather-matmul: einsum('bsk,bsn->kn') over the full
+    seq, accumulated while X seq-chunks circulate."""
+    m = x_blk.shape[1]
+    dw = jnp.zeros((x_blk.shape[2], g_blk.shape[2]),
+                   jnp.result_type(x_blk, g_blk))
+    chunk = x_blk
+    for i in range(p):
+        b = (idx + i) % p
+        rows = jax.lax.dynamic_slice(
+            g_blk, (0, b * m, 0), (g_blk.shape[0], m, g_blk.shape[2]))
+        dw = dw + jnp.einsum("bsk,bsn->kn", chunk, rows).astype(dw.dtype)
+        if i != p - 1:
+            chunk = jax.lax.ppermute(chunk, axis, perm=_ring_perm(p))
+    return dw
+
+
+def _dw_circulate_g_seq(idx, x_blk, g_blk, axis: str, p: int):
+    """dW for the seq matmul→reduce-scatter: x_local^T against the
+    circulating scattered output-grad seq-chunks."""
+    m = g_blk.shape[1]
+    dw = jnp.zeros((x_blk.shape[2], g_blk.shape[2]),
+                   jnp.result_type(x_blk, g_blk))
+    chunk = g_blk
+    for i in range(p):
+        b = (idx + i) % p
+        rows = jax.lax.dynamic_slice(
+            x_blk, (0, b * m, 0), (x_blk.shape[0], m, x_blk.shape[2]))
+        dw = dw + jnp.einsum("bsj,bsn->jn", rows, chunk).astype(dw.dtype)
+        if i != p - 1:
+            chunk = jax.lax.ppermute(chunk, axis, perm=_ring_perm(p))
+    return dw
+
+
 def _sm(body, mesh: Mesh, in_specs, out_specs):
     return shard_map(body, mesh, in_specs, out_specs, check_vma=False)
 
@@ -290,6 +396,79 @@ def _mm_rs_fn(mesh: Mesh, axis: str):
     return f
 
 
+@functools.lru_cache(maxsize=None)
+def _ag_mm_seq_fn(mesh: Mesh, axis: str):
+    """Sequence-parallel gather-matmul (ag-before-column): global custom_vjp
+    over shard_map ring programs, exactly like :func:`_ag_mm_fn` one rank
+    up. x [b, s, K] seq-sharded over ``axis`` → [b, s, N] with N sharded
+    (the TP-region layout)."""
+    p = mesh.shape[axis]
+    row = _row_axes(mesh)
+    x_spec = P(row if row else None, axis, None)   # seq over the ring
+    g_spec = P(row if row else None, None, axis)   # full seq, cols ringed
+    w_spec = P(None, axis)
+
+    def fwd_program(x, w):
+        body = lambda i, xx, ww: _ag_mm_seq_local(i[0], xx, ww, axis, p)
+        return _sm(body, mesh, (P(axis), x_spec, w_spec),
+                   g_spec)(_iota(p), x, w)
+
+    def dx_program(g, w):
+        body = lambda i, gg, ww: _mm_rs_seq_local(i[0], gg, ww.T, axis, p)
+        return _sm(body, mesh, (P(axis), g_spec, w_spec),
+                   x_spec)(_iota(p), g, w)
+
+    def dw_program(x, g):
+        def body(i, xx, gg):
+            dw = _dw_circulate_x_seq(i[0], xx, gg, axis, p)
+            return jax.lax.psum(dw, row) if row else dw
+
+        return _sm(body, mesh, (P(axis), x_spec, g_spec),
+                   w_spec)(_iota(p), x, g)
+
+    f = jax.custom_vjp(fwd_program)
+    f.defvjp(lambda x, w: (fwd_program(x, w), (x, w)),
+             lambda res, g: (dx_program(g, res[1]).astype(res[0].dtype),
+                             dw_program(res[0], g).astype(res[1].dtype)))
+    return f
+
+
+@functools.lru_cache(maxsize=None)
+def _mm_rs_seq_fn(mesh: Mesh, axis: str):
+    """Sequence-parallel matmul→reduce-scatter (rs-after-row): x [b, s, K]
+    K-sharded over ``axis`` → [b, s, N] seq-sharded (the SP residency the
+    norms/dropout/residual between TP regions run on)."""
+    p = mesh.shape[axis]
+    row = _row_axes(mesh)
+    x_spec = P(row if row else None, None, axis)   # K over the ring
+    out_spec = P(row if row else None, axis, None)  # seq over the ring
+    w_spec = P(axis, None)
+
+    def fwd_program(x, w):
+        body = lambda i, xx, ww: _mm_rs_seq_local(i[0], xx, ww, axis, p)
+        return _sm(body, mesh, (P(axis), x_spec, w_spec),
+                   out_spec)(_iota(p), x, w)
+
+    def dx_program(g, w):
+        body = lambda i, gg, ww: _ag_mm_seq_local(i[0], gg, ww.T, axis, p)
+        return _sm(body, mesh, (P(axis), out_spec, w_spec),
+                   x_spec)(_iota(p), g, w)
+
+    def dw_program(x, g):
+        def body(i, xx, gg):
+            dw = _dw_circulate_g_seq(i[0], xx, gg, axis, p)
+            return jax.lax.psum(dw, row) if row else dw
+
+        return _sm(body, mesh, (P(axis), x_spec, out_spec),
+                   w_spec)(_iota(p), x, g)
+
+    f = jax.custom_vjp(fwd_program)
+    f.defvjp(lambda x, w: (fwd_program(x, w), (x, w)),
+             lambda res, g: (dx_program(g, res[1]).astype(res[0].dtype),
+                             dw_program(res[0], g).astype(res[1].dtype)))
+    return f
+
+
 def _record(kind: str, nbytes: int, p: int, axis: str) -> None:
     """Telemetry: the ring moves (p-1)/p of the payload as ppermutes; a
     trace-time record when called under someone's jit (always, in
@@ -329,3 +508,37 @@ def matmul_reduce_scatter(x, w, mesh: Mesh, axis: str = MODEL_AXIS):
     _record("matmul_reduce_scatter",
             x.size * x.dtype.itemsize // max(1, p), p, axis)
     return _mm_rs_fn(mesh, axis)(x, w)
+
+
+def all_gather_matmul_seq(x, w, mesh: Mesh, axis: str = MODEL_AXIS):
+    """Sequence-parallel ``gather(X over seq) @ W`` ring (ag-before-column).
+
+    ``x``: global [..., s, K] with s sharded over ``axis`` (the SP
+    residency); ``w``: global [K, N] with N sharded over ``axis``.
+    Returns global [..., s, N] == ``x @ w`` with full seq and N
+    ``axis``-sharded — the TP-region layout — with the seq all-gather
+    hidden under the partial dots. Leading batch dims are flattened into
+    one (a layout-free reshape: they are tiled on dim0 only)."""
+    lead = x.shape[:-2]
+    x3 = x.reshape((-1, x.shape[-2], x.shape[-1]))
+    _record("all_gather_matmul_seq", x.size * x.dtype.itemsize,
+            mesh.shape[axis], axis)
+    out = _ag_mm_seq_fn(mesh, axis)(x3, w)
+    return out.reshape((*lead, out.shape[-2], out.shape[-1]))
+
+
+def matmul_reduce_scatter_seq(x, w, mesh: Mesh, axis: str = MODEL_AXIS):
+    """Sequence-parallel ``reduce_scatter(X @ W over seq)`` ring
+    (rs-after-row).
+
+    ``x``: global [..., s, K] with K sharded over ``axis``; ``w``: global
+    [K, N] with K sharded over ``axis``. Returns global [..., s, N] ==
+    ``x @ w`` with s sharded over ``axis`` — the SP residency the
+    norm/dropout/residual section runs on."""
+    p = mesh.shape[axis]
+    lead = x.shape[:-2]
+    x3 = x.reshape((-1, x.shape[-2], x.shape[-1]))
+    _record("matmul_reduce_scatter_seq",
+            x.size * x.dtype.itemsize // max(1, p), p, axis)
+    out = _mm_rs_seq_fn(mesh, axis)(x3, w)
+    return out.reshape((*lead, out.shape[-2], out.shape[-1]))

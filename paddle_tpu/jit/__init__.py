@@ -503,6 +503,15 @@ class TrainStep:
             extras["overlap"] = overlap_fingerprint()
         except Exception:
             pass
+        try:
+            # SP changes the between-region activation layout (ag/rs vs
+            # all-reduce): same model source, different program — the flag
+            # must split the executable cache the same way overlap does
+            from ..distributed.meta_parallel import sp_fingerprint
+
+            extras["sp"] = sp_fingerprint()
+        except Exception:
+            pass
         return extras
 
     def _note_compile(self, info: Dict[str, Any]) -> None:
@@ -555,6 +564,14 @@ class TrainStep:
         reduce-scatter per bucket instead of a monolithic one."""
         return grads
 
+    def _constrain_compute(self, arrays):
+        """Hook: pin the COMPUTE layout of the params entering the forward
+        (value-identity). DistributedTrainStep overrides to constrain each
+        param to its compute spec (storage spec minus the ZeRO "sharding"
+        axis) so the storage sharding never propagates into activation
+        layouts — see the spec-policy section in distributed/engine.py."""
+        return arrays
+
     def _step(self, param_arrays, opt_states, buffer_arrays, key, lr, batch_arrays,
               check_numerics: bool = False, health_probe: bool = False):
         if getattr(self, "offload", False):
@@ -571,6 +588,7 @@ class TrainStep:
 
         def loss_of(p_arr, bufs, batch_mb, key_):
             run_p = [p.astype(orig.dtype) for p, orig in zip(p_arr, param_arrays)]
+            run_p = self._constrain_compute(run_p)
             with _StateSwap(self._params, run_p), \
                     _StateSwap(self._buffers, bufs), key_scope(key_), no_grad():
                 loss_t = self.loss_fn(self.model, *[Tensor(a) for a in batch_mb])

@@ -3,14 +3,18 @@
 
 Composition (each maps to a SURVEY §2.3 strategy):
 - VocabParallelEmbedding + Column/RowParallelLinear   → TP over "model"
+- Column/RowSequenceParallelLinear + ScatterOp        → SP: activations
+  between TP regions seq-sharded over "model" (default on when mp>1;
+  ``sequence_parallel`` flag / ``PADDLE_TPU_SP`` override)
 - ScannedLayers over the decoder stack                → PP over "pipe"
 - DistributedTrainStep(sharding_stage=...)            → DP + ZeRO over
                                                         ("data","sharding")
 - batch seq-dim sharded over "sep"                    → SEP/context parallel
 - ParallelCrossEntropy on vocab-sharded logits        → TP loss
 
-All collectives are inserted by GSPMD from these shardings; the whole train
-step is ONE compiled XLA program."""
+All collectives are inserted by GSPMD from these shardings (or, above the
+overlap shape threshold, by the ring-decomposed collective matmuls); the
+whole train step is ONE compiled XLA program."""
 
 from __future__ import annotations
 
@@ -22,6 +26,9 @@ from ..distributed.engine import ScannedLayers
 from ..distributed.meta_parallel.mp_layers import (ColumnParallelLinear, RowParallelLinear,
                                                    VocabParallelEmbedding, _constrain,
                                                    _last_dim_spec)
+from ..distributed.meta_parallel.sequence_parallel import (
+    ColumnSequenceParallelLinear, RowSequenceParallelLinear, ScatterOp,
+    register_sequence_parallel_allreduce_hooks, sequence_parallel_enabled)
 from ..distributed.topology import HybridCommunicateGroup
 from ..nn import functional as F
 from ..tensor.manipulation import reshape
@@ -31,24 +38,35 @@ from .llama import LlamaConfig, _normalize_mask, _rope_tables
 __all__ = ["LlamaForCausalLMHybrid"]
 
 
+def _linear_types(sequence_parallel: bool):
+    """The column/row implementations for one TP region: the SP variants
+    keep the activations seq-sharded between regions (ag-before-column /
+    rs-after-row), the plain ones keep them replicated (all-reduce)."""
+    if sequence_parallel:
+        return ColumnSequenceParallelLinear, RowSequenceParallelLinear
+    return ColumnParallelLinear, RowParallelLinear
+
+
 class HybridLlamaAttention(nn.Layer):
     """TP attention: heads sharded over "model" (q/k/v column-parallel,
     output row-parallel)."""
 
-    def __init__(self, config: LlamaConfig, context_parallel: str = "none"):
+    def __init__(self, config: LlamaConfig, context_parallel: str = "none",
+                 sequence_parallel: bool = False):
         super().__init__()
         self.config = config
         self.context_parallel = context_parallel  # "none" | "ring" | "ulysses"
         h, kv, d = config.num_attention_heads, config.num_key_value_heads, config.head_dim
         init = nn.initializer.Normal(0.0, config.initializer_range)
-        self.q_proj = ColumnParallelLinear(config.hidden_size, h * d, weight_attr=init,
-                                           has_bias=False, gather_output=False)
-        self.k_proj = ColumnParallelLinear(config.hidden_size, kv * d, weight_attr=init,
-                                           has_bias=False, gather_output=False)
-        self.v_proj = ColumnParallelLinear(config.hidden_size, kv * d, weight_attr=init,
-                                           has_bias=False, gather_output=False)
-        self.o_proj = RowParallelLinear(h * d, config.hidden_size, weight_attr=init,
-                                        has_bias=False, input_is_parallel=True)
+        Column, Row = _linear_types(sequence_parallel)
+        self.q_proj = Column(config.hidden_size, h * d, weight_attr=init,
+                             has_bias=False, gather_output=False)
+        self.k_proj = Column(config.hidden_size, kv * d, weight_attr=init,
+                             has_bias=False, gather_output=False)
+        self.v_proj = Column(config.hidden_size, kv * d, weight_attr=init,
+                             has_bias=False, gather_output=False)
+        self.o_proj = Row(h * d, config.hidden_size, weight_attr=init,
+                          has_bias=False, input_is_parallel=True)
 
     def forward(self, x, cos, sin, attn_mask=None):
         from .llama import apply_rotary_pos_emb
@@ -79,28 +97,31 @@ class HybridLlamaAttention(nn.Layer):
 
 
 class HybridLlamaMLP(nn.Layer):
-    def __init__(self, config: LlamaConfig):
+    def __init__(self, config: LlamaConfig, sequence_parallel: bool = False):
         super().__init__()
         init = nn.initializer.Normal(0.0, config.initializer_range)
-        self.gate_proj = ColumnParallelLinear(config.hidden_size, config.intermediate_size,
-                                              weight_attr=init, has_bias=False,
-                                              gather_output=False)
-        self.up_proj = ColumnParallelLinear(config.hidden_size, config.intermediate_size,
-                                            weight_attr=init, has_bias=False,
-                                            gather_output=False)
-        self.down_proj = RowParallelLinear(config.intermediate_size, config.hidden_size,
-                                           weight_attr=init, has_bias=False,
-                                           input_is_parallel=True)
+        Column, Row = _linear_types(sequence_parallel)
+        self.gate_proj = Column(config.hidden_size, config.intermediate_size,
+                                weight_attr=init, has_bias=False,
+                                gather_output=False)
+        self.up_proj = Column(config.hidden_size, config.intermediate_size,
+                              weight_attr=init, has_bias=False,
+                              gather_output=False)
+        self.down_proj = Row(config.intermediate_size, config.hidden_size,
+                             weight_attr=init, has_bias=False,
+                             input_is_parallel=True)
 
     def forward(self, x):
         return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
 
 
 class HybridLlamaDecoderLayer(nn.Layer):
-    def __init__(self, config: LlamaConfig, context_parallel: str = "none"):
+    def __init__(self, config: LlamaConfig, context_parallel: str = "none",
+                 sequence_parallel: bool = False):
         super().__init__()
-        self.self_attn = HybridLlamaAttention(config, context_parallel)
-        self.mlp = HybridLlamaMLP(config)
+        self.self_attn = HybridLlamaAttention(config, context_parallel,
+                                              sequence_parallel)
+        self.mlp = HybridLlamaMLP(config, sequence_parallel)
         self.input_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
         self.post_attention_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
 
@@ -113,14 +134,26 @@ class HybridLlamaDecoderLayer(nn.Layer):
 class LlamaForCausalLMHybrid(nn.Layer):
     """``context_parallel``: "none" | "ring" | "ulysses" — how attention
     handles a seq dim sharded over "sep" (auto-picks ring when sep>1 and
-    head counts allow, else ulysses, when left at "auto")."""
+    head counts allow, else ulysses, when left at "auto").
+
+    ``sequence_parallel``: keep activations BETWEEN TP regions seq-sharded
+    over "model" (Megatron SP — the residual all-reduce becomes
+    ag-before-column + rs-after-row). ``None`` defers to ``PADDLE_TPU_SP``
+    / the mp>1 default (:func:`sequence_parallel_enabled`); forced off
+    when sep>1 — context parallelism already owns the seq dim there, and
+    stacking "model" on top would double-tile it."""
 
     def __init__(self, config: LlamaConfig, hcg: HybridCommunicateGroup,
-                 context_parallel: str = "auto"):
+                 context_parallel: str = "auto",
+                 sequence_parallel: "bool | None" = None):
         super().__init__()
         self.config = config
         self.hcg = hcg
         sep = hcg.mesh.shape.get("sep", 1)
+        mp = hcg.mesh.shape.get("model", 1)
+        sp = sequence_parallel_enabled(sequence_parallel) \
+            and mp > 1 and sep == 1
+        self.sequence_parallel = sp
         if context_parallel == "auto":
             # ring handles GQA (grouped KV chunks rotate unrepeated); it is
             # the memory-scaling default whenever the seq dim is sharded
@@ -145,11 +178,14 @@ class LlamaForCausalLMHybrid(nn.Layer):
         pp = hcg.get_pipe_parallel_world_size()
         if config.num_hidden_layers % pp != 0:
             raise ValueError(f"num_hidden_layers {config.num_hidden_layers} % pp {pp} != 0")
-        blocks = [HybridLlamaDecoderLayer(config, context_parallel)
+        blocks = [HybridLlamaDecoderLayer(config, context_parallel, sp)
                   for _ in range(config.num_hidden_layers)]
         self.decoder = ScannedLayers(blocks, mesh=hcg.mesh, pipe_axis="pipe")
         self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
-        self.lm_head = ColumnParallelLinear(
+        # under SP the final norm runs on the seq-sharded residual and the
+        # lm_head's input seq all-gather hides in its own boundary
+        LMHead = ColumnSequenceParallelLinear if sp else ColumnParallelLinear
+        self.lm_head = LMHead(
             config.hidden_size, config.vocab_size,
             weight_attr=nn.initializer.Normal(0.0, config.initializer_range),
             has_bias=False, gather_output=False)
@@ -157,12 +193,20 @@ class LlamaForCausalLMHybrid(nn.Layer):
                                 config.rope_theta)
         self.register_buffer("rope_cos", Tensor(cos), persistable=False)
         self.register_buffer("rope_sin", Tensor(sin), persistable=False)
+        if sp:
+            # marks the norm scales (grads need the mp-axis sum — emitted
+            # by the partitioner, verified by tests/test_sequence_parallel)
+            register_sequence_parallel_allreduce_hooks(self)
 
     def forward(self, input_ids, labels=None, attn_mask=None):
         if input_ids.shape[1] > self.config.max_position_embeddings:
             raise ValueError("sequence too long")
         attn_mask = _normalize_mask(attn_mask)
         x = self.embed_tokens(input_ids)
+        if self.sequence_parallel:
+            # enter the SP residency: tokens scatter over "model" and stay
+            # scattered through every norm/residual until the lm_head
+            x = ScatterOp.apply(x)
         x = self.decoder(x, self.rope_cos._value, self.rope_sin._value, attn_mask)
         x = self.norm(x)
         logits = self.lm_head(x)  # vocab-sharded over "model"

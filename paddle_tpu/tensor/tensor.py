@@ -53,7 +53,8 @@ class Tensor:
 
     __slots__ = ("_value", "stop_gradient", "_grad", "_producer", "_hooks", "name",
                  "persistable", "optimize_attr", "regularizer", "need_clip",
-                 "is_distributed", "split_axis", "_partial_axes", "__weakref__")
+                 "is_distributed", "split_axis", "_partial_axes",
+                 "sequence_parallel", "_sp_accumulation_steps", "__weakref__")
 
     def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None):
         if isinstance(value, Tensor):

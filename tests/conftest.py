@@ -36,7 +36,13 @@ os.environ["PADDLE_TPU_XLA_OVERLAP_FLAGS"] = "0"
 # poll and deadlines down so lease expiry → poison → gang exit resolves in
 # ~1-2s. setdefault: a test that needs its own timing can still override,
 # and launched subprocesses inherit these.
-for _k, _v in (("PADDLE_TPU_HB_INTERVAL", "0.25"),
+for _k, _v in (("PADDLE_TPU_SP", "1"),
+               # sequence parallelism: pin the gate ON (its mp>1 default)
+               # so tier-1 compiles don't depend on the developer's shell;
+               # the strict-baseline lint mode stays opt-in per test so
+               # ad-hoc baselines under lint() don't all have to be fresh
+               ("PADDLE_TPU_LINT_STRICT_BASELINE", "0"),
+               ("PADDLE_TPU_HB_INTERVAL", "0.25"),
                ("PADDLE_TPU_HB_TTL", "1.5"),
                ("PADDLE_TPU_POISON_POLL", "0.2"),
                ("PADDLE_TPU_ABORT_DEADLINE", "5"),
