@@ -31,6 +31,18 @@ capture/ship path — or ``"any"``); ``pattern`` is an
 window which matching calls fire, and ``p``/``seed`` make probabilistic
 campaigns reproducible.
 
+The ``sdc`` op models a *silently* defective chip: mode ``"bitflip"``
+flips ONE seeded mantissa bit in the tensor payload handed to ``fire``
+and returns the corrupted copy — no exception, no crash, just wrong
+numbers, exactly the failure the fingerprint/vote ladder in
+:mod:`..health.sdc` exists to catch. The flip seed advances with every
+fire (``seed + fired``), so a sticky spec corrupts *differently* on each
+re-execution — a replaying suspect cannot accidentally reproduce the
+majority answer, matching real sticky-ALU behavior. Same scope / seed /
+``after`` / ``times`` discipline as every other spec; chaos tests route a
+grad through ``fire("sdc", f"grad_rank{rank}", data=grad)`` on the rank
+under test.
+
 The ``serve`` op family covers the serving engine's hot path:
 ``"serve_prefill"`` / ``"serve_decode"`` fire before the compiled
 prefill/decode programs run (state untouched — the engine's step loop
@@ -65,10 +77,10 @@ from typing import List, Optional
 __all__ = ["FaultSpec", "InjectedIOError", "InjectedCrash", "inject",
            "scope", "fire", "active", "reset"]
 
-_MODES = ("error", "crash", "truncate", "delay", "sigterm")
+_MODES = ("error", "crash", "truncate", "delay", "sigterm", "bitflip")
 _OPS = ("write", "read", "rename", "commit", "snap", "serve",
         "serve_prefill", "serve_decode", "serve_pool", "serve_journal",
-        "any")
+        "sdc", "any")
 
 
 class InjectedIOError(OSError):
@@ -131,14 +143,19 @@ class FaultSpec:
         return True
 
     # -- action ------------------------------------------------------------
-    def _act(self, op: str, path: str, data: Optional[bytes]) -> None:
+    def _act(self, op: str, path: str, data):
+        """Perform the armed action; returns the (possibly transformed)
+        payload — only ``bitflip`` transforms, every other mode returns
+        ``data`` unchanged or raises."""
         _record(self, op, path)
+        if self.mode == "bitflip":
+            return self._bitflip(data)
         if self.mode == "delay":
             time.sleep(self.delay_s)
-            return
+            return data
         if self.mode == "sigterm":
             os.kill(os.getpid(), signal.SIGTERM)
-            return
+            return data
         if self.mode == "truncate":
             if data is not None:
                 cut = max(1, int(len(data) * self.truncate_frac))
@@ -151,6 +168,43 @@ class FaultSpec:
             raise InjectedCrash(f"{self.message}: crashed at {op} {path}")
         raise InjectedIOError(f"{self.message}: {op} {path} failed "
                               f"(fire {self.fired}/{self.times})")
+
+    def _bitflip(self, data):
+        """Flip one seeded bit in the payload and return the corrupted
+        copy. Float arrays get a MANTISSA bit (a silently-wrong value of
+        the same magnitude class, the classic SDC signature); other arrays
+        and raw bytes get an arbitrary bit. The element/bit draw is seeded
+        ``seed + fired`` so every fire of the same spec flips differently."""
+        import numpy as np
+
+        if data is None:
+            return None
+        rng = np.random.default_rng(self.seed + self.fired)
+        if isinstance(data, (bytes, bytearray)):
+            buf = bytearray(data)
+            pos = int(rng.integers(0, len(buf))) if buf else 0
+            if buf:
+                buf[pos] ^= 1 << int(rng.integers(0, 8))
+            return bytes(buf)
+        arr = np.array(data, copy=True)
+        if arr.size == 0:
+            return arr
+        idx = int(rng.integers(0, arr.size))
+        flat = arr.reshape(-1)
+        if arr.dtype == np.float32:
+            bits = flat.view(np.uint32)
+            bits[idx] ^= np.uint32(1 << int(rng.integers(0, 23)))
+        elif arr.dtype == np.float64:
+            bits = flat.view(np.uint64)
+            bits[idx] ^= np.uint64(1 << int(rng.integers(0, 52)))
+        elif arr.dtype == np.float16:
+            bits = flat.view(np.uint16)
+            bits[idx] ^= np.uint16(1 << int(rng.integers(0, 10)))
+        else:
+            bits = arr.reshape(-1).view(np.uint8)
+            pos = int(rng.integers(0, bits.size))
+            bits[pos] ^= np.uint8(1 << int(rng.integers(0, 8)))
+        return arr
 
 
 _active: List[FaultSpec] = []
@@ -192,18 +246,22 @@ def inject(**kw) -> scope:
     return scope(FaultSpec(**kw))
 
 
-def fire(op: str, path: str, data: Optional[bytes] = None) -> None:
-    """Injection point — called by the storage layer before each I/O step.
+def fire(op: str, path: str, data=None):
+    """Injection point — called by the storage layer before each I/O step
+    (and by chaos seams like the SDC grad tap). Returns the payload,
+    transformed by any armed ``bitflip`` spec that fired; existing callers
+    that pass bytes-for-truncate and ignore the return are unaffected.
     No-op (and near-zero cost) when nothing is armed."""
     if not _active:
-        return
+        return data
     with _lock:
         specs = [s for s in _active if s._matches(op, path)]
         # counters are advanced under the lock; actions run outside it so a
         # delay/sleep doesn't serialize unrelated I/O
         to_fire = [s for s in specs if s._should_fire()]
     for s in to_fire:
-        s._act(op, path, data)
+        data = s._act(op, path, data)
+    return data
 
 
 def active() -> List[FaultSpec]:

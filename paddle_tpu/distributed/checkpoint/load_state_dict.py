@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import os
 import pickle
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
@@ -41,12 +41,42 @@ __all__ = ["load_state_dict"]
 
 class _ShardFiles:
     """Lazy per-file shard cache: rank files are read (and their CRC32
-    verified against the save-time checksum) at most once."""
+    verified against the save-time checksum) at most once. When the
+    metadata carries value fingerprints, each accessed shard's values are
+    re-fingerprinted after deserialization and checked against the
+    save-time digest — the end-to-end integrity rung above the CRC."""
 
-    def __init__(self, path: str, checksums: Dict[str, int]):
+    def __init__(self, path: str, checksums: Dict[str, int],
+                 fingerprints: Optional[Dict[str, str]] = None,
+                 fp_seed: int = 0):
         self.path = path
         self.checksums = checksums
+        self.fingerprints = fingerprints or {}
+        self.fp_seed = fp_seed
+        self._fp_checked: set = set()
         self._cache: Dict[str, Dict[tuple, np.ndarray]] = {}
+
+    def _verify_fp(self, file_name: str, key: str, offset: tuple,
+                   arr: np.ndarray) -> None:
+        from ..health.sdc import shard_fp_name, tree_fingerprints
+
+        name = shard_fp_name(key, offset)
+        if name in self._fp_checked:
+            return
+        self._fp_checked.add(name)
+        want = self.fingerprints.get(name)
+        if want is None:
+            return
+        got = tree_fingerprints({name: arr}, self.fp_seed)[name]
+        if got != want:
+            raise CheckpointCorruptionError(
+                f"value-fingerprint mismatch in tensor {key!r} (shard "
+                f"offset {offset}, file {file_name!r}) of checkpoint "
+                f"{self.path!r}: the deserialized values do not match the "
+                f"fingerprint recorded before serialization at save time — "
+                f"the payload was silently corrupted between device-get "
+                f"and commit (a window the per-file CRC cannot see). Set "
+                f"PADDLE_TPU_SDC_VERIFY_LOAD=0 to load anyway.")
 
     def get(self, file_name: str, key: str, offset: tuple) -> np.ndarray:
         if file_name not in self._cache:
@@ -66,7 +96,10 @@ class _ShardFiles:
                     f"shard file {file_name!r} of checkpoint {self.path!r} "
                     f"is undecodable ({type(e).__name__}: {e}); its bytes "
                     f"are damaged") from e
-        return self._cache[file_name][(key, offset)]
+        arr = self._cache[file_name][(key, offset)]
+        if self.fingerprints:
+            self._verify_fp(file_name, key, offset, arr)
+        return arr
 
 
 def _check_committed(path: str) -> None:
@@ -104,7 +137,13 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
         raise CheckpointCorruptionError(
             f"metadata file of checkpoint {path!r} is undecodable "
             f"({type(e).__name__}: {e})") from e
-    files = _ShardFiles(path, getattr(meta, "file_checksums", {}) or {})
+    from ..health.sdc import SDCPolicy, verify_load_enabled
+
+    fps = (getattr(meta, "tensor_fingerprints", None) or {}) \
+        if verify_load_enabled() else {}
+    files = _ShardFiles(path, getattr(meta, "file_checksums", {}) or {},
+                        fingerprints=fps,
+                        fp_seed=SDCPolicy.from_env().seed)
     flat, mapping = flatten_state_dict(state_dict)
 
     for key, leaf in flat.items():

@@ -13,6 +13,15 @@ Mirrors the reference's mesh-independent format
   fails with a checksum error naming the file, not a pickle traceback);
   absent in checkpoints written before the commit protocol — loaders use
   ``getattr(meta, "file_checksums", {})``
+- ``tensor_fingerprints``: ``"key@offset"`` → hex fingerprint
+  (:func:`~..health.sdc.host_fingerprint`: seeded ±1 projection +
+  abs-sum) of each saved shard's VALUES, computed from the in-memory
+  arrays *before* serialization and re-verified after deserialization on
+  load — end-to-end integrity the CRC cannot give (the CRC covers the
+  serialized bytes, so corruption between device-get and pickling is
+  CRC-self-consistent). Same back-compat discipline:
+  ``getattr(meta, "tensor_fingerprints", {})``; load verification is
+  skipped with ``PADDLE_TPU_SDC_VERIFY_LOAD=0``
 
 Because the schema speaks only in global offsets/shapes, a checkpoint saved
 under one mesh/parallelism config can be loaded under any other — the loader
@@ -49,3 +58,4 @@ class Metadata:
     storage_metadata: Dict[LocalTensorIndex, str] = field(default_factory=dict)
     flat_mapping: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
     file_checksums: Dict[str, int] = field(default_factory=dict)
+    tensor_fingerprints: Dict[str, str] = field(default_factory=dict)

@@ -27,6 +27,7 @@ as ``checkpoint_save_failed``, and re-raised on the main thread at the next
 from __future__ import annotations
 
 import atexit
+import json
 import os
 import pickle
 import sys
@@ -37,6 +38,7 @@ import jax
 import numpy as np
 
 from . import commit as _commit
+from . import faults
 from . import storage
 from .errors import AsyncSaveError
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
@@ -160,6 +162,28 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
             if seen_offsets.get(offset) == rank and (key, offset) not in local_shards:
                 local_shards[(key, offset)] = np.asarray(shard.data)
 
+    # value fingerprints: computed from the in-memory arrays BEFORE
+    # serialization — the integrity window the CRC cannot see (the CRC is
+    # taken over the serialized bytes, so corruption between device-get
+    # and pickling yields a self-consistent CRC). One fingerprint per
+    # owned shard, keyed "key@offset"; load_state_dict recomputes them
+    # after deserialization (PADDLE_TPU_SDC_VERIFY_LOAD=0 opts out).
+    from ..health.sdc import SDCPolicy, shard_fp_name, tree_fingerprints
+
+    fp_seed = SDCPolicy.from_env().seed
+    shard_fps = tree_fingerprints(
+        {shard_fp_name(key, off): arr
+         for (key, off), arr in local_shards.items()}, fp_seed)
+    # chaos seam: an armed "sdc"/bitflip spec corrupts the payload HERE —
+    # after fingerprinting, before serialization — modeling exactly the
+    # silent corruption the fingerprints exist to catch
+    if faults.active():
+        for key_off in list(local_shards):
+            flipped = faults.fire("sdc", f"ckpt_serialize/{key_off[0]}",
+                                  data=local_shards[key_off])
+            if flipped is not local_shards[key_off]:
+                local_shards[key_off] = flipped
+
     staging = _commit.staging_dir(path)
     shard_name = f"rank_{rank}.distcp"
 
@@ -172,12 +196,19 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
         # its shard file; the coordinator folds them into the metadata
         storage.write_bytes(os.path.join(staging, shard_name + ".crc32"),
                             str(crc).encode())
+        # fingerprint sidecar: same discipline for the value fingerprints
+        storage.write_bytes(os.path.join(staging, shard_name + ".fp"),
+                            json.dumps(shard_fps).encode())
         _barrier("staged")
         if rank == coordinator_rank:
             for f in sorted(os.listdir(staging)):
                 if f.endswith(".crc32"):
                     meta.file_checksums[f[:-len(".crc32")]] = \
                         int(storage.read_bytes(os.path.join(staging, f)))
+                    os.remove(os.path.join(staging, f))
+                elif f.endswith(".fp"):
+                    meta.tensor_fingerprints.update(json.loads(
+                        storage.read_bytes(os.path.join(staging, f))))
                     os.remove(os.path.join(staging, f))
             storage.write_bytes(os.path.join(staging, "metadata"),
                                 pickle.dumps(meta,

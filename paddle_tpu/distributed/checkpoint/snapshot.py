@@ -142,6 +142,20 @@ def _materialize(state_dict: Dict[str, Any]) -> Dict[str, Any]:
     return {"shards": shards, "shapes": shapes}
 
 
+def _snapshot_fingerprints(shards: Dict[str, List[Tuple[Tuple[int, ...],
+                                                        np.ndarray]]],
+                           seed: int) -> Dict[str, str]:
+    """Per-shard value fingerprints of a snapshot entry (same "key@offset"
+    naming as the disk checkpoint metadata)."""
+    from ..health.sdc import shard_fp_name, tree_fingerprints
+
+    named = {}
+    for key, entries in shards.items():
+        for off, arr in entries:
+            named[shard_fp_name(key, off)] = arr
+    return tree_fingerprints(named, seed)
+
+
 def _restore_into(state_dict: Dict[str, Any], snap: Dict[str, Any]) -> int:
     """Fill ``state_dict`` in place from a snapshot entry, resharding the
     available pieces onto each target's current sharding (the same overlap
@@ -150,6 +164,27 @@ def _restore_into(state_dict: Dict[str, Any], snap: Dict[str, Any]) -> int:
     to the next rung instead of resuming partial state."""
     flat, mapping = flatten_state_dict(state_dict)
     shards, shapes = snap["shards"], snap["shapes"]
+    fps = snap.get("fp")
+    if fps:
+        # shipped generations carry value fingerprints (stamped on the
+        # background ship path, off the step cadence): a replica whose
+        # values no longer match — corrupted in the depot, in transit, or
+        # in the holder's RAM — fails THIS rung and the ladder falls
+        # through to an intact source instead of resuming silent damage
+        from ..health.sdc import SDCPolicy, verify_load_enabled
+
+        if verify_load_enabled():
+            got = _snapshot_fingerprints(shards, SDCPolicy.from_env().seed)
+            for name, want in fps.items():
+                if name in got and got[name] != want:
+                    key = name.split("@", 1)[0]
+                    _record_event("snapshot_fingerprint_mismatch", key,
+                                  gen=snap.get("gen"), step=snap.get("step"))
+                    raise SnapshotRestoreError(
+                        f"snapshot (gen {snap.get('gen')}) value-"
+                        f"fingerprint mismatch in tensor {key!r} "
+                        f"(shard {name!r}) — the replica's values were "
+                        f"silently corrupted after capture")
     for key, leaf in flat.items():
         if key not in shards:
             raise SnapshotRestoreError(
@@ -351,10 +386,21 @@ class Snapshotter:
         try:
             faults.fire("snap",
                         f"ship_step{entry['step']}_rank{self.rank}")
-            payload = pickle.dumps(
-                {k: entry[k] for k in
-                 ("shards", "shapes", "step", "gen", "rank")},
-                protocol=pickle.HIGHEST_PROTOCOL)
+            doc = {k: entry[k] for k in
+                   ("shards", "shapes", "step", "gen", "rank")}
+            try:
+                # value fingerprints ride with the payload (off the step
+                # cadence — this thread is already off the critical path);
+                # restore recomputes them, catching depot/transit/holder-RAM
+                # corruption the transport CRC cannot (the CRC is taken
+                # over bytes that may already be silently wrong)
+                from ..health.sdc import SDCPolicy
+
+                doc["fp"] = _snapshot_fingerprints(
+                    entry["shards"], SDCPolicy.from_env().seed)
+            except Exception:
+                pass  # degrade to an unfingerprinted ship
+            payload = pickle.dumps(doc, protocol=pickle.HIGHEST_PROTOCOL)
             crc = crc32(payload)
             holders = [self.rank] if self.peer is None \
                 else [self.rank, self.peer]

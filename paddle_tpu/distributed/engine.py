@@ -228,15 +228,18 @@ class DistributedTrainStep(TrainStep):
             **self._sharding_pins(extra_out=True),
         )
 
-    def _sharding_pins(self, extra_out: bool = False) -> dict:
+    def _sharding_pins(self, extra_out: bool = False,
+                       extra_in: bool = False) -> dict:
         """in/out sharding kwargs shared by every compiled step variant;
-        ``extra_out`` appends the unpinned slot for a flags/probe output."""
+        ``extra_out`` appends the unpinned slot for a flags/probe output,
+        ``extra_in`` the unpinned scalar slot for the SDC vote flag."""
         out = (None, self._param_shardings, self._state_shardings,
                self._buffer_shardings)
+        ins = (self._param_shardings, self._state_shardings,
+               self._buffer_shardings, None, None,
+               self._batch_shardings_holder)
         return {
-            "in_shardings": (self._param_shardings, self._state_shardings,
-                             self._buffer_shardings, None, None,
-                             self._batch_shardings_holder),
+            "in_shardings": ins + ((None,) if extra_in else ()),
             "out_shardings": out + ((None,) if extra_out else ()),
         }
 
@@ -245,10 +248,12 @@ class DistributedTrainStep(TrainStep):
         on — skips are selected in-program, never recovered host-side."""
         import functools as _ft
 
+        mon = getattr(self, "_sdc_monitor", None)
         return self._maybe_aot(jax.jit(
             _ft.partial(self._step, health_probe=True),
             donate_argnums=(0, 1) if self._donate else (),
-            **self._sharding_pins(extra_out=True),
+            **self._sharding_pins(extra_out=True,
+                                  extra_in=mon is not None and mon.active),
         ), "guarded_step")
 
     def _build_bucketer(self):
@@ -316,6 +321,18 @@ class DistributedTrainStep(TrainStep):
         # involuntary-remat the old baseline pinned at bucketer.py)
         return [jax.lax.with_sharding_constraint(g, s)
                 for g, s in zip(grads, self._grad_shardings)]
+
+    def _sdc_pre_reduce_groups(self, grads):
+        """Per-bucket pre-reduce fingerprint taps: one rank-local lane pair
+        per comm bucket (plus unbucketed TP grads), so a confirmed
+        suspect's post-mortem names WHICH reduction diverged. These lanes
+        are diagnostic only — pre-reduce grads come from different data
+        shards and legitimately differ across ranks, so the vote never
+        compares them."""
+        b = self._grad_bucketer
+        if b is None:
+            return [], []
+        return b.fingerprint_groups(grads)
 
     def _fingerprint_extras(self, tag):
         """AOT fingerprint identity for the sharded step: mesh shape +

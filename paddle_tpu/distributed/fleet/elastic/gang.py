@@ -23,6 +23,15 @@ above it must relaunch the whole gang.  That something is
   ``GangPolicy.min_procs``), shrinking the DP degree — the relaunched ranks
   ride the checkpoint reshard-on-load path under the smaller mesh (the
   "resume under a different mesh" property PR 2's tests established);
+- an ``sdc_suspect`` poison (the SDC monitor confirmed a chip silently
+  computing wrong numbers) triggers an **exclude-list relaunch** instead
+  of a plain restart: the launcher dumps the poison doc to
+  ``<log_dir>/epoch_N/poison.json``, the supervisor maps the culprit rank
+  to its physical slot, adds it to ``excluded_slots`` (exported as
+  ``PADDLE_TPU_EXCLUDE_SLOTS``), and relaunches the SAME topology minus
+  the quarantined slot with a FRESH restart budget — distinct from
+  degrade, which shrinks the world because hosts keep dying, not because
+  one of them lies;
 - relaunched ranks resume through the **in-memory snapshot ladder**
   (:func:`~....checkpoint.snapshot.resume`: own RAM → snapshot-store copy
   → peer replica → committed disk checkpoint).  The supervisor hosts the
@@ -123,6 +132,7 @@ class FleetSupervisor:
         self.epoch = 0                  # launch attempts so far
         self.gang_restarts = 0          # relaunches at the CURRENT world
         self.degrades = 0
+        self.excluded_slots: List[int] = []   # quarantined physical slots
         self.world_size = self.nnodes * self.nproc_per_node
         self.exit_codes: List[int] = []
         # in-memory snapshot depot: hosted HERE (this process outlives
@@ -164,6 +174,9 @@ class FleetSupervisor:
             env["PADDLE_TPU_COMPILE_CACHE"] = self.compile_cache
         if self._snap_addr:
             env["PADDLE_TPU_SNAP_STORE"] = self._snap_addr
+        if self.excluded_slots:
+            env["PADDLE_TPU_EXCLUDE_SLOTS"] = ",".join(
+                str(s) for s in sorted(self.excluded_slots))
         env.update(self.env)
         return env
 
@@ -220,6 +233,53 @@ class FleetSupervisor:
                 else:
                     os.environ[k] = v
 
+    # -- SDC quarantine ----------------------------------------------------
+    def _check_quarantine(self, epoch: int) -> Optional[int]:
+        """After a failed attempt, read the launcher's poison dump for
+        this epoch. An ``sdc_suspect`` poison quarantines the culprit's
+        physical slot: the relaunch keeps the SAME topology minus that
+        slot, with a FRESH restart budget — an exclude-list relaunch, not
+        a degrade (the host isn't dying; it's lying). Returns the newly
+        excluded slot, or None."""
+        import json
+
+        path = os.path.join(self.log_dir, f"epoch_{epoch}", "poison.json")
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if doc.get("reason") != "sdc_suspect":
+            return None
+        culprit = doc.get("culprit")
+        if not isinstance(culprit, int):
+            return None
+        # dense ranks → physical slots: rank r of the poisoned epoch ran on
+        # the r-th non-excluded slot (the launcher's spawn loop skips
+        # excluded slots and assigns dense ranks in slot order)
+        avail = [s for s in range(self.nnodes * self.nproc_per_node)
+                 if s not in self.excluded_slots]
+        if culprit < 0 or culprit >= len(avail):
+            return None
+        if len(avail) - 1 < max(1, self.policy.min_procs):
+            # excluding would drop below the floor: let the normal restart
+            # budget (and eventually giveup) decide instead
+            self._event("gang_quarantine_refused", epoch=epoch,
+                        culprit_rank=culprit,
+                        world=self.world_size,
+                        min_procs=self.policy.min_procs)
+            return None
+        slot = avail[culprit]
+        self.excluded_slots.append(slot)
+        self.world_size = self.nnodes * self.nproc_per_node \
+            - len(self.excluded_slots)
+        self.gang_restarts = 0   # fresh budget: the bad actor is gone
+        self._event("gang_quarantine", epoch=epoch, slot=slot,
+                    culprit_rank=culprit, step=doc.get("step"),
+                    excluded_slots=sorted(self.excluded_slots),
+                    world=self.world_size)
+        return slot
+
     # -- degrade -----------------------------------------------------------
     def _degrade(self) -> bool:
         """Shrink the gang one step; False when already at the floor."""
@@ -255,7 +315,12 @@ class FleetSupervisor:
                 self._event("fleet_supervisor_fatal", exit_code=rc,
                             epoch=self.epoch, **resume)
                 return rc
-            if self.gang_restarts >= self.policy.max_gang_restarts:
+            if self._check_quarantine(self.epoch) is not None:
+                # exclude-list relaunch: budget already reset, world size
+                # already shrunk by the quarantined slot — fall through to
+                # the backoff + relaunch without spending a restart
+                pass
+            elif self.gang_restarts >= self.policy.max_gang_restarts:
                 # budget for this world size is spent: a persistently
                 # missing host keeps killing every relaunch — degrade the
                 # mesh instead of burning forever (or give up at the floor)

@@ -700,7 +700,10 @@ class FaultDomain:
         if self.state_provider is None or not self.ckpt_root:
             return
         if doc.get("culprit") == self.rank and doc.get("reason") in (
-                "health_escalation", "watchdog_hang"):
+                "health_escalation", "watchdog_hang", "sdc_suspect"):
+            # sdc_suspect: a chip that silently computes wrong numbers has
+            # wrong state by definition — an emergency checkpoint from the
+            # suspect would preserve exactly the corruption being evicted
             return
         try:
             from ..checkpoint import save_state_dict

@@ -18,15 +18,27 @@ north-star 7B run needs the full detect → skip → rewind loop).
   run skips past the poisoned batches; repeated rewinds at the same step
   fail loudly.
 
+- :class:`SDCPolicy` / :class:`SDCMonitor` (:mod:`.sdc`) — the silent-
+  data-corruption ladder: deterministic step fingerprints fused into the
+  same device probe, cross-replica bitwise vote through the fleet store,
+  transient-vs-sticky confirmation, ``sdc_suspect`` quarantine with a
+  pre-corruption rewind window.
+
 Flight-recorder event kinds: ``health_skip`` (step withheld),
 ``health_anomaly`` (finite spike), ``health_rewind`` (escalation → dump →
-exit 101), ``health_fast_forward`` (restart skipped the poisoned window).
-Env: ``PADDLE_TPU_HEALTH=0`` disables the guard.
+exit 101), ``health_fast_forward`` (restart skipped the poisoned window);
+plus ``sdc_vote`` / ``sdc_confirm`` / ``sdc_transient`` / ``sdc_suspect``
+from the SDC ladder. Env: ``PADDLE_TPU_HEALTH=0`` disables the guard;
+``PADDLE_TPU_SDC=0`` the SDC monitor.
 """
 
 from .detector import SpikeDetector  # noqa: F401
 from .guard import REWIND_EXIT_CODE, HealthGuard, HealthPolicy  # noqa: F401
 from .ledger import LEDGER_NAME, HealthError, RewindLedger  # noqa: F401
+from .sdc import (SDC_POISON_REASON, SDCMonitor, SDCPolicy,  # noqa: F401
+                  host_fingerprint, tree_fingerprints)
 
 __all__ = ["SpikeDetector", "HealthGuard", "HealthPolicy", "HealthError",
-           "RewindLedger", "LEDGER_NAME", "REWIND_EXIT_CODE"]
+           "RewindLedger", "LEDGER_NAME", "REWIND_EXIT_CODE",
+           "SDCMonitor", "SDCPolicy", "SDC_POISON_REASON",
+           "host_fingerprint", "tree_fingerprints"]

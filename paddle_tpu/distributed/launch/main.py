@@ -20,7 +20,12 @@ pill written by anyone else (a rank's CommWatchdog, a HealthGuard
 escalation) tears this pod down even when every local child still looks
 healthy.  Teardown is TERM → ``PADDLE_TPU_TEARDOWN_GRACE`` seconds → KILL,
 after an initial self-exit window so ranks get to finish their emergency
-checkpoints.
+checkpoints.  ``PADDLE_TPU_EXCLUDE_SLOTS`` (exported by the
+``FleetSupervisor`` after an ``sdc_suspect`` quarantine) names physical
+slots this launcher must NOT spawn — surviving slots get dense ranks
+0..world−1 — and the final poison doc is dumped to
+``<log_dir>/poison.json`` so the quarantine decision survives the epoch's
+store.
 
 In-memory snapshots (``PADDLE_TPU_SNAP``, default on): the launcher hosts
 the :class:`~..checkpoint.replicator.SnapshotStore` — a process-global
@@ -217,7 +222,23 @@ class _PodWatch:
 def launch(argv=None) -> int:
     args = _parse(argv if argv is not None else sys.argv[1:])
     nproc = args.nproc_per_node
-    world = args.nnodes * nproc
+    # SDC quarantine (exclude-list relaunch): the FleetSupervisor exports
+    # the physical slots it quarantined; this launcher skips them and the
+    # surviving slots get DENSE ranks 0..world-1 — downstream the
+    # relaunched gang is an ordinary, smaller world
+    excluded_slots = set()
+    for _tok in os.environ.get("PADDLE_TPU_EXCLUDE_SLOTS", "").split(","):
+        _tok = _tok.strip()
+        if _tok:
+            try:
+                excluded_slots.add(int(_tok))
+            except ValueError:
+                pass
+    live_slots = [s for s in range(args.nnodes * nproc)
+                  if s not in excluded_slots]
+    if not live_slots:
+        raise SystemExit("PADDLE_TPU_EXCLUDE_SLOTS excludes every slot")
+    world = len(live_slots)
     master = args.master
     node_rank = args.node_rank
     store = None
@@ -306,7 +327,12 @@ def launch(argv=None) -> int:
     logs = []
     try:
         for local in range(nproc):
-            rank = node_rank * nproc + local
+            slot = node_rank * nproc + local
+            if slot in excluded_slots:
+                _record_event("slot_excluded", slot=slot, local=local,
+                              node_rank=node_rank)
+                continue
+            rank = live_slots.index(slot)
             env = os.environ.copy()
             env.update({
                 "PADDLE_TRAINER_ID": str(rank),
@@ -443,6 +469,25 @@ def launch(argv=None) -> int:
         for f in logs:
             f.close()
         if watch is not None:
+            # persist the poison doc for the FleetSupervisor: the pill dies
+            # with the epoch's store, but an sdc_suspect quarantine decision
+            # must survive teardown — the dump names the culprit rank the
+            # exclude-list relaunch removes
+            doc = watch.poisoned
+            if doc is None:
+                try:
+                    doc = watch.domain.check_poison()
+                except Exception:
+                    doc = None
+            if doc is not None:
+                import json
+
+                try:
+                    with open(os.path.join(args.log_dir, "poison.json"),
+                              "w") as f:
+                        json.dump(doc, f, indent=1)
+                except (OSError, TypeError, ValueError):
+                    pass
             watch.stop()
         if snap is not None:
             snap.stop()

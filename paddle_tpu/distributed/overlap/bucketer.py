@@ -139,6 +139,26 @@ class GradientBucketer:
                 off += n
         return out
 
+    def fingerprint_groups(self, arrays: Sequence[Any]):
+        """SDC fingerprint tap: ``(labels, groups)`` mirroring the comm
+        plan — one member group per bucket (firing order) plus one
+        singleton group per skipped tensor, so each pre-reduce fingerprint
+        lane corresponds 1:1 to a reduction the step actually emits (a
+        diverging lane names the bucket). Trace-time helper; the grouping
+        matches :meth:`coalesce`, so XLA's CSE dedupes the reads against
+        the comm path's own concat."""
+        if len(arrays) != len(self.sizes):
+            raise ValueError(
+                f"bucketer planned over {len(self.sizes)} tensors, "
+                f"got {len(arrays)}")
+        labels = [f"bucket{bi}" for bi in range(len(self.buckets))]
+        groups = [[arrays[i] for i in b] for b in self.buckets]
+        for i, skipped in enumerate(self.skip):
+            if skipped:
+                labels.append(f"unbucketed{i}")
+                groups.append([arrays[i]])
+        return labels, groups
+
     def constrain(self, grads: Sequence[Any], mesh, axes=("data", "sharding")):
         """Trace-time application inside a compiled step: route each
         bucket's grads through a concat pinned to shard over ``axes`` —

@@ -401,6 +401,7 @@ class TrainStep:
         self._donate = donate
         self._health_guard = health_guard
         self._snapshotter = snapshotter
+        self._sdc_monitor = None
         if persistent_cache is not None:
             from ..compile import resolve_cache
 
@@ -461,6 +462,20 @@ class TrainStep:
         untouched, so attaching/detaching never recompiles."""
         self._snapshotter = snapshotter
 
+    # -- SDC monitor -------------------------------------------------------
+    def attach_sdc_monitor(self, monitor) -> None:
+        """Arm a :class:`~paddle_tpu.distributed.health.SDCMonitor`: the
+        guarded program's probe grows deterministic step-fingerprint lanes
+        (per-bucket pre-reduce, post-allreduce grad, parameter tree) that
+        the monitor resolves ``max_lag`` late and votes across replicas.
+        The lanes are traced into the guarded variant, which compiles
+        lazily on first use — attach BEFORE the first guarded call and the
+        run still pays exactly one guarded trace (no added recompile);
+        attaching (or detaching) later drops the cached guarded executable
+        for one documented retrace, never a silent stale program."""
+        self._sdc_monitor = monitor
+        self._compiled_guarded = None
+
     def _make_guarded_jit(self):
         """Compiled variant with the fused health probe. Donation is safe:
         a skipped step's old state feeds the in-program select, never a
@@ -512,6 +527,12 @@ class TrainStep:
             extras["sp"] = sp_fingerprint()
         except Exception:
             pass
+        mon = getattr(self, "_sdc_monitor", None)
+        if mon is not None and mon.active:
+            # fingerprint lanes change the guarded program's output arity:
+            # an AOT executable traced without (or with a different) SDC
+            # layout must never warm-load for this configuration
+            extras["sdc"] = mon.trace_signature()
         return extras
 
     def _note_compile(self, info: Dict[str, Any]) -> None:
@@ -564,6 +585,14 @@ class TrainStep:
         reduce-scatter per bucket instead of a monolithic one."""
         return grads
 
+    def _sdc_pre_reduce_groups(self, grads):
+        """Hook: ``(labels, groups)`` of PRE-reduce grad groups for the SDC
+        fingerprint's rank-local diagnostic lanes. The base step has no
+        comm buckets — no lanes; DistributedTrainStep taps each
+        reverse-topological grad bucket so a suspect's divergence is
+        localized to a bucket in the post-mortem."""
+        return [], []
+
     def _constrain_compute(self, arrays):
         """Hook: pin the COMPUTE layout of the params entering the forward
         (value-identity). DistributedTrainStep overrides to constrain each
@@ -573,7 +602,8 @@ class TrainStep:
         return arrays
 
     def _step(self, param_arrays, opt_states, buffer_arrays, key, lr, batch_arrays,
-              check_numerics: bool = False, health_probe: bool = False):
+              sdc_vote=None, check_numerics: bool = False,
+              health_probe: bool = False):
         if getattr(self, "offload", False):
             # offloaded states arrive in host memory; TPU arithmetic cannot
             # mix memory spaces, so stream them to device here — the update's
@@ -635,7 +665,17 @@ class TrainStep:
                 ok &= jnp.all(jnp.isfinite(g))
             gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
                                  for g in grads))
+        sdc_on = health_probe and self._sdc_monitor is not None \
+            and self._sdc_monitor.active
+        sdc_labels, sdc_groups = self._sdc_pre_reduce_groups(grads) \
+            if sdc_on else ([], [])
         grads = self._comm_grads(grads)
+        if sdc_on:
+            # post-allreduce global grad: bitwise-identical across DP
+            # replicas (same reduction, same order) — the first VOTED
+            # fingerprint pair; earlier bucket pairs are rank-local
+            sdc_labels = list(sdc_labels) + ["grad"]
+            sdc_groups = list(sdc_groups) + [list(grads)]
         grads = self._clip_grads(grads)
         new_params, new_states = [], []
         for i, (p_arr, g, st) in enumerate(zip(compute_params, grads, opt_states)):
@@ -680,8 +720,37 @@ class TrainStep:
                                    for k, v in st_new.items()})
             new_states = sel_states
             new_buf = [_sel(n, o) for n, o in zip(new_buf, buffer_arrays)]
-            probe = jnp.stack([loss.astype(jnp.float32),
-                               ok.astype(jnp.float32), gnorm])
+            probe_vals = [loss.astype(jnp.float32),
+                          ok.astype(jnp.float32), gnorm]
+            probe = jnp.stack(probe_vals)
+            if sdc_on:
+                # parameter tree AFTER the update + skip-select: the second
+                # voted pair — replicas applying the same reduced grad to
+                # the same params must land bitwise-identical
+                from ..distributed.health.sdc import fingerprint_lanes
+
+                sdc_labels.append("params")
+                sdc_groups.append(list(new_params))
+                seed = self._sdc_monitor.policy.seed
+
+                def _lanes():
+                    return jnp.stack(fingerprint_lanes(sdc_groups, seed))
+
+                if sdc_vote is None:
+                    lanes = _lanes()
+                else:
+                    # cadence gate INSIDE the program: the projection work
+                    # runs only on vote steps (the host passes the flag as
+                    # a dynamic scalar — both values share one trace), so
+                    # at production cadence the defense is ~free
+                    lanes = jax.lax.cond(
+                        jnp.asarray(sdc_vote, bool), _lanes,
+                        lambda: jnp.zeros((2 * len(sdc_groups),),
+                                          jnp.float32))
+                probe = jnp.concatenate([probe, lanes])
+                # trace-time bookkeeping: the monitor learns the lane
+                # layout it will resolve (host-side list write, no tracer)
+                self._sdc_monitor.set_lane_labels(sdc_labels)
             return loss, new_params, new_states, new_buf, probe
         return loss, new_params, new_states, new_buf
 
@@ -754,12 +823,22 @@ class TrainStep:
                         f"gradient_merge k={self._merge_k} needs every batch "
                         f"arg's dim0 divisible by k, got shape {a.shape}")
         guard = self._health_guard
+        mon = self._sdc_monitor
         probe = None
-        if guard is not None and guard.active:
+        if (guard is not None and guard.active) or \
+                (mon is not None and mon.active):
             # guarded path wins over check_nan_inf: it subsumes the check
             # (detects the same non-finites) and recovers instead of raising
+            call_args = args
+            if mon is not None and mon.active:
+                # this step's number (post-increment) against the vote
+                # cadence: off-cadence steps skip the fingerprint work
+                # in-program (lax.cond on this dynamic flag — no retrace)
+                nxt = self.optimizer._step_count + 1
+                call_args = args + (
+                    nxt % max(1, mon.policy.every) == 0,)
             loss, new_params, new_states, new_buf, probe = \
-                self._get_guarded()(*args)
+                self._get_guarded()(*call_args)
         elif get_flags("check_nan_inf")["check_nan_inf"]:
             loss, new_params, new_states, new_buf, finite = \
                 self._compiled_checked(*args)
@@ -787,14 +866,26 @@ class TrainStep:
             # state is already rebound (skips selected in-program); the
             # guard resolves the probe max_lag steps late and may raise
             # SystemExit(101) here to hand control to the Supervisor
-            guard.on_step(probe, step=self.optimizer._step_count)
+            if guard is not None and guard.active:
+                guard.on_step(probe, step=self.optimizer._step_count)
+            if mon is not None and mon.active:
+                # same late-resolve discipline over the fingerprint lanes;
+                # a sticky-confirmed suspect exits 101 here too (the
+                # supervisor answers with an exclude-list relaunch)
+                mon.on_step(probe, step=self.optimizer._step_count)
         # in-memory snapshot cadence: the capture device-gets the JUST
         # REBOUND state synchronously (the next step donates these arrays,
         # so a lazy capture would read invalidated buffers); serialization
         # + peer replication leave on the snapshotter's background thread
         if self._snapshotter is not None:
             try:
-                self._snapshotter.on_step(self.optimizer._step_count)
+                if self._snapshotter.on_step(self.optimizer._step_count) \
+                        and mon is not None:
+                    # the SDC rewind anchor only advances to generations
+                    # that actually exist — a suspect verdict rewinds to
+                    # the newest snapshot at or before the last
+                    # fingerprint-clean step
+                    mon.note_checkpoint(self.optimizer._step_count)
             except Exception:
                 pass  # degraded RPO must never kill the step
         # supervisor goodput probe: first completed step of this process

@@ -14,11 +14,16 @@ TPU-native translation: ``dynamic_decode`` compiles the WHOLE decode — every
   ``gather_tree`` collapses), where the reference would simply have stopped
   appending.  Callers use ``sequence_lengths`` (``return_length=True``) to
   trim, exactly as with the reference.
-- per-step selection follows the reference exactly: cumulative log-probs,
-  finished beams frozen through the ``noend`` mask (only ``end_token``
-  continuable at probability 1), NO length penalty (the reference's
-  ``# TODO: length penalty`` — the penalty lives in
-  ``generate(num_beams=...)``, `generation/beam_search.py`).
+- per-step selection follows the reference: cumulative log-probs, finished
+  beams frozen through the ``noend`` mask (only ``end_token`` continuable
+  at probability 1).  The reference's ``# TODO: length penalty`` is
+  resolved here: ``BeamSearchDecoder(length_penalty=alpha)`` ranks
+  candidates by the Wu et al. (GNMT, 2016) normalized score
+  ``log_prob / ((5 + len) / 6) ** alpha`` while the state carries the RAW
+  cumulative log-probs (the penalty is a re-ranking, not an accumulation —
+  folding it into the carried sum would compound it every step).  The
+  default ``alpha = 0`` reproduces the reference's unpenalized selection
+  bit-for-bit.
 """
 
 from __future__ import annotations
@@ -72,7 +77,13 @@ class BeamSearchDecoder(Decoder):
 
     ``cell(inputs, states) -> (outputs, new_states)`` with batch dim
     ``batch*beam`` (merged); ``embedding_fn`` maps selected token ids to the
-    next step's inputs; ``output_fn`` maps cell outputs to logits."""
+    next step's inputs; ``output_fn`` maps cell outputs to logits.
+
+    ``length_penalty`` is Wu et al.'s alpha: candidates are selected (and
+    ``OutputWrapper.scores`` reported) by ``log_prob / ((5+len)/6)**alpha``
+    where ``len`` counts the candidate's tokens after this step; alpha > 0
+    favors longer hypotheses.  ``StateWrapper.log_probs`` stays the raw
+    cumulative sum regardless."""
 
     OutputWrapper = collections.namedtuple(
         "OutputWrapper", ("scores", "predicted_ids", "parent_ids"))
@@ -80,13 +91,15 @@ class BeamSearchDecoder(Decoder):
         "StateWrapper", ("cell_states", "log_probs", "finished", "lengths"))
 
     def __init__(self, cell, start_token, end_token, beam_size,
-                 embedding_fn=None, output_fn=None):
+                 embedding_fn=None, output_fn=None,
+                 length_penalty: float = 0.0):
         self.cell = cell
         self.embedding_fn = embedding_fn
         self.output_fn = output_fn
         self.start_token = int(start_token)
         self.end_token = int(end_token)
         self.beam_size = int(beam_size)
+        self.length_penalty = float(length_penalty)
 
     @staticmethod
     def tile_beam_merge_with_batch(x, beam_size):
@@ -149,11 +162,24 @@ class BeamSearchDecoder(Decoder):
         step_log_probs = jnp.where(states.finished[:, :, None],
                                    noend[None, None, :], step_log_probs)
         log_probs = step_log_probs + states.log_probs[:, :, None]
-        scores = log_probs.reshape(batch, K * V)
+        raw = log_probs.reshape(batch, K * V)
+        if self.length_penalty:
+            # Wu et al. (2016) eq. 14: rank by log_prob / ((5+len)/6)^alpha
+            # where len is the candidate's length AFTER this step (finished
+            # beams stop growing through the noend mask, so each finished
+            # hypothesis keeps competing at its final length)
+            cand_len = states.lengths + (~states.finished).astype(jnp.int32)
+            lp = ((5.0 + cand_len.astype(jnp.float32)) / 6.0) \
+                ** self.length_penalty
+            scores = (log_probs / lp[:, :, None]).reshape(batch, K * V)
+        else:
+            scores = raw
         topk_scores, topk_idx = jax.lax.top_k(scores, K)
         beam_idx = topk_idx // V
         token_idx = (topk_idx % V).astype(jnp.int32)
-        next_log_probs = jnp.take_along_axis(scores, topk_idx, axis=1)
+        # the state carries the RAW cumulative log-probs — the penalty is a
+        # re-ranking of the selection, never folded into the running sum
+        next_log_probs = jnp.take_along_axis(raw, topk_idx, axis=1)
         next_cell = _map(lambda v: self._gather(v, beam_idx), next_cell)
         next_finished = self._gather(states.finished, beam_idx)
         next_lengths = self._gather(states.lengths, beam_idx)

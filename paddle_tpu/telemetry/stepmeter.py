@@ -268,4 +268,10 @@ class StepMeter:
         if self._first_loss is not None:
             out["first_loss"] = self._first_loss
             out["final_loss"] = self._last_loss
+        # SDC defense aggregates (schema-additive: the keys appear only
+        # once the monitor has actually checked something this process)
+        cnt = runtime.counters()
+        if cnt.get("sdc_checks_total"):
+            out["sdc_checks"] = int(cnt["sdc_checks_total"])
+            out["sdc_mismatches"] = int(cnt.get("sdc_mismatch_total", 0))
         return out

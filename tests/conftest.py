@@ -53,6 +53,13 @@ for _k, _v in (("PADDLE_TPU_SP", "1"),
                # budget — snapshot every 2 steps, fail transports fast
                ("PADDLE_TPU_SNAP_EVERY", "2"),
                ("PADDLE_TPU_SNAP_TIMEOUT", "10"),
+               # SDC defense: production cadence (vote every 16 steps,
+               # 10s vote deadline) would make the bitflip chaos e2e idle
+               # through most of the tier-1 budget — vote every 2 steps,
+               # confirm with 2 replays, give up on an absent voter fast
+               ("PADDLE_TPU_SDC_EVERY", "2"),
+               ("PADDLE_TPU_SDC_CONFIRM", "2"),
+               ("PADDLE_TPU_SDC_VOTE_TIMEOUT", "5"),
                # serving suite: production page/pool sizes (16-token pages,
                # 64-page arenas) allocate real HBM-scale buffers — pin the
                # paged-KV geometry down so the CPU tier-1 engines compile

@@ -1,7 +1,8 @@
 """nn.BeamSearchDecoder + nn.dynamic_decode (reference nn/decode.py:153,994):
 the compiled-scan decode must match an eager python reimplementation of the
 reference's beam step (cumulative log-probs, frozen finished beams via the
-noend mask, NO length penalty) plus gather_tree backtrace."""
+noend mask; the reference's length-penalty TODO resolved as Wu et al.
+re-ranking with alpha=0 bit-exact unpenalized) plus gather_tree backtrace."""
 
 import numpy as np
 import pytest
@@ -19,13 +20,18 @@ def _log_softmax(x):
 
 
 def _ref_beam_decode(cell_np, embed_w, out_w, out_b, h0, start, end, K,
-                     max_step_num):
-    """Eager numpy replica of reference BeamSearchDecoder semantics."""
+                     max_step_num, alpha=0.0):
+    """Eager numpy replica of reference BeamSearchDecoder semantics.
+
+    ``alpha`` is the Wu et al. length penalty: selection ranks by
+    ``raw / ((5 + len)/6)**alpha`` while the carried cumulative log-prob
+    stays raw."""
     batch, H = h0.shape
     V = out_w.shape[1]
     h = np.repeat(h0[:, None, :], K, axis=1)          # [b, K, H]
     log_probs = np.tile([[0.0] + [-NEG] * (K - 1)], (batch, 1))
     finished = np.zeros((batch, K), bool)
+    lengths = np.zeros((batch, K), np.int64)
     tok = np.full((batch, K), start, np.int64)
     all_pred, all_parent = [], []
     for t in range(max_step_num + 1):
@@ -37,15 +43,23 @@ def _ref_beam_decode(cell_np, embed_w, out_w, out_b, h0, start, end, K,
         noend = np.full((V,), -NEG)
         noend[end] = 0.0
         step_lp = np.where(finished[:, :, None], noend[None, None, :], step_lp)
-        scores = (step_lp + log_probs[:, :, None]).reshape(batch, K * V)
+        raw3 = step_lp + log_probs[:, :, None]        # [b, K, V]
+        raw = raw3.reshape(batch, K * V)
+        if alpha:
+            cand_len = lengths + (~finished).astype(np.int64)
+            lp = ((5.0 + cand_len.astype(np.float32)) / 6.0) ** alpha
+            sel = (raw3 / lp[:, :, None]).reshape(batch, K * V)
+        else:
+            sel = raw
         # lax.top_k tie-break: lower flat index wins
-        idx = np.argsort(-scores, axis=1, kind="stable")[:, :K]
-        topk = np.take_along_axis(scores, idx, axis=1)
+        idx = np.argsort(-sel, axis=1, kind="stable")[:, :K]
         beam = idx // V
         token = (idx % V).astype(np.int64)
-        log_probs = topk
+        log_probs = np.take_along_axis(raw, idx, axis=1)   # raw, never sel
         h = np.take_along_axis(h_new, beam[:, :, None], axis=1)
         finished = np.take_along_axis(finished, beam, axis=1)
+        lengths = np.take_along_axis(lengths, beam, axis=1)
+        lengths = lengths + (~finished).astype(np.int64)
         finished = finished | (token == end)
         tok = token
         all_pred.append(token)
@@ -103,6 +117,99 @@ def test_dynamic_decode_matches_reference_semantics(setup):
     want = _ref_beam_decode(gru_np, embed.weight.numpy(), out.weight.numpy(),
                             out.bias.numpy(), h0, 0, 1, K, max_step)
     np.testing.assert_array_equal(got, np.transpose(want, (1, 0, 2)))
+
+
+def _gru_np(cell):
+    wi, wh = cell.weight_ih.numpy(), cell.weight_hh.numpy()
+    bi, bh = cell.bias_ih.numpy(), cell.bias_hh.numpy()
+
+    def f(x, h):
+        gi = x @ wi.T + bi
+        gh = h @ wh.T + bh
+        H_ = h.shape[1]
+        rz = 1.0 / (1.0 + np.exp(-(gi[:, :2 * H_] + gh[:, :2 * H_])))
+        r, z = rz[:, :H_], rz[:, H_:]
+        c = np.tanh(gi[:, 2 * H_:] + r * gh[:, 2 * H_:])
+        return (h - c) * z + c
+
+    return f
+
+
+def test_length_penalty_reranks_analytically():
+    """Wu et al. penalty, analytic: a finished 2-token hypothesis with a
+    BETTER raw score than the best 5-token continuation must win at
+    alpha=0 and LOSE at alpha=1 — and the carried state must hold the raw
+    cumulative log-prob, never the penalized ranking value."""
+    import jax.numpy as jnp
+
+    K, V, end = 2, 3, 0
+    logits_b1 = np.array([0.0, 1.0, 2.0], np.float32)
+    L0, L1 = -1.0, -0.7       # cumulative raw log-probs entering the step
+
+    def cell(inputs, states, **kw):
+        return states, states  # cell_states ARE the per-beam logits
+
+    # beam 0: finished at length 2 (its logits row is dead: noend mask);
+    # beam 1: alive at length 4, continuing to length 5 this step
+    states = nn.BeamSearchDecoder.StateWrapper(
+        cell_states=jnp.asarray([[[9.0, 9.0, 9.0], logits_b1]], jnp.float32),
+        log_probs=jnp.asarray([[L0, L1]], jnp.float32),
+        finished=jnp.asarray([[True, False]]),
+        lengths=jnp.asarray([[2, 4]], jnp.int32))
+    inputs = jnp.zeros((1, K), jnp.int32)
+
+    lsm = _log_softmax(logits_b1[None])[0]
+    best_raw_b1 = L1 + lsm[2]           # beam 1's best continuation (tok 2)
+    assert L0 > best_raw_b1             # shorter hypothesis wins raw...
+    assert best_raw_b1 / (10 / 6) > L0 / (7 / 6)   # ...and loses penalized
+
+    def run(alpha):
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=end,
+                                   beam_size=K, length_penalty=alpha)
+        return dec.step(0, inputs, states)
+
+    out0, st0, _, _ = run(0.0)
+    assert int(out0.parent_ids[0, 0]) == 0          # finished beam on top
+    assert int(out0.predicted_ids[0, 0]) == end
+    np.testing.assert_allclose(np.asarray(out0.scores[0, 0]), L0, rtol=1e-6)
+    # alpha=0: scores ARE the carried log-probs (bit-exact legacy ranking)
+    np.testing.assert_array_equal(np.asarray(out0.scores),
+                                  np.asarray(st0.log_probs))
+
+    out1, st1, _, _ = run(1.0)
+    assert int(out1.parent_ids[0, 0]) == 1          # longer hypothesis wins
+    assert int(out1.predicted_ids[0, 0]) == 2
+    # reported score is penalized: raw / ((5+5)/6)
+    np.testing.assert_allclose(np.asarray(out1.scores[0, 0]),
+                               best_raw_b1 / (10 / 6), rtol=1e-6)
+    # carried log-prob stays RAW (penalty re-ranks, never accumulates)
+    np.testing.assert_allclose(np.asarray(st1.log_probs[0, 0]),
+                               best_raw_b1, rtol=1e-6)
+    assert int(st1.lengths[0, 0]) == 5
+    # runner-up is the frozen finished hypothesis, length unchanged
+    assert int(out1.parent_ids[0, 1]) == 0
+    assert int(out1.predicted_ids[0, 1]) == end
+    assert int(st1.lengths[0, 1]) == 2
+    assert bool(st1.finished[0, 1])
+
+
+def test_length_penalty_dynamic_decode_matches_reference(setup):
+    """The penalized selection compiled into the scan must match the eager
+    numpy replica of Wu et al. re-ranking end to end (backtraced ids)."""
+    _, cell, embed, out, (V, E, H, K) = setup
+    alpha = 0.8
+    dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=1, beam_size=K,
+                               embedding_fn=embed, output_fn=out,
+                               length_penalty=alpha)
+    batch, max_step = 3, 7
+    h0 = np.random.default_rng(2).standard_normal((batch, H)).astype("float32")
+    outputs, states, lengths = nn.dynamic_decode(
+        dec, inits=Tensor(h0), max_step_num=max_step, return_length=True)
+    want = _ref_beam_decode(_gru_np(cell), embed.weight.numpy(),
+                            out.weight.numpy(), out.bias.numpy(), h0, 0, 1,
+                            K, max_step, alpha=alpha)
+    np.testing.assert_array_equal(outputs.numpy(),
+                                  np.transpose(want, (1, 0, 2)))
 
 
 def test_dynamic_decode_time_major_and_lengths(setup):
