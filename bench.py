@@ -1640,6 +1640,11 @@ def bench_serving(on_accel: bool, peak: float):
         raise RuntimeError(
             f"nominal serving leg shed/rejected {shed_rate:.2%} of an "
             f"in-capacity trace — admission control regressed")
+    if s.get("trace_coverage") != 1.0:
+        raise RuntimeError(
+            f"nominal serving leg trace_coverage "
+            f"{s.get('trace_coverage')} != 1.0 — some finished request "
+            "lost its submit->admit->first_token->finish span chain")
 
     # --- over-capacity leg: shedding must engage, accepted TTFT must hold
     ttft_budget_s = 60.0 if on_accel else 30.0
@@ -1778,6 +1783,36 @@ def bench_serving(on_accel: bool, peak: float):
                     f"fleet leg rid {rid}: {len(delivered.get(rid, []))} "
                     f"tokens delivered, wanted {mn} — failover replay is "
                     "not exactly-once")
+        # job-level rollup over the two replicas' meters: the aggregate
+        # req/s is an exact sum and the p99 comes from MERGED histograms
+        # (never averaged percentiles); trace coverage is finished-
+        # request weighted across both engines — one trace_id must have
+        # survived routing, journaling, death and failover replay
+        from paddle_tpu.telemetry.aggregator import local_snapshot, rollup
+
+        s0 = r0.engine.meter.summary()
+        s1 = r1.engine.meter.summary()
+        fin_tot = s0["requests_finished"] + s1["requests_finished"]
+        fleet_trace_cov = round(
+            (s0["trace_coverage"] * s0["requests_finished"]
+             + s1["trace_coverage"] * s1["requests_finished"])
+            / fin_tot, 4) if fin_tot else 1.0
+        if fleet_trace_cov != 1.0:
+            raise RuntimeError(
+                f"fleet leg trace_coverage {fleet_trace_cov} != 1.0 — "
+                "the trace chain broke across the failover")
+        agg = rollup({
+            "r0": local_snapshot(slo_summary=s0,
+                                 hists=r0.engine.meter.hist_docs()),
+            "r1": local_snapshot(slo_summary=s1,
+                                 hists=r1.engine.meter.hist_docs()),
+        })
+        if agg["requests_finished_total"] != fin_tot:
+            raise RuntimeError(
+                f"rollup finished_total {agg['requests_finished_total']} "
+                f"!= sum of per-replica counters {fin_tot}")
+        fleet_agg_req_s = agg["fleet_agg_req_s"]
+        ttft_p99_agg = agg["ttft_p99_agg_ms"]
         r1.stop()
         fe.stop()
     finally:
@@ -1877,6 +1912,10 @@ def bench_serving(on_accel: bool, peak: float):
             "fleet_replicas": 2,
             "failovers": fleet_failovers,
             "replayed_requests": fleet_replayed,
+            "trace_coverage": s["trace_coverage"],
+            "fleet_trace_coverage": fleet_trace_cov,
+            "fleet_agg_req_s": fleet_agg_req_s,
+            "ttft_p99_agg": ttft_p99_agg,
             "kv_dtype": eng.kv_dtype,
             "kv_bytes_per_token": s["kv_bytes_per_token"],
             "spec_acceptance": spec_acceptance,
@@ -1892,6 +1931,10 @@ def bench_serving(on_accel: bool, peak: float):
                     "failovers/replayed_requests from the two-replica "
                     "fleet leg (one replica dies mid-stream, survivor "
                     "finishes every request exactly-once); "
+                    "trace_coverage gated ==1.0 on both legs (every "
+                    "finished request keeps one trace_id end to end); "
+                    "fleet_agg_req_s/ttft_p99_agg from the job rollup "
+                    "(merged histograms, not averaged percentiles); "
                     "spec_acceptance/effective_tokens_per_step gated "
                     ">0 / >1 on the speculative leg; int8 leg gated at "
                     "exactly half the bf16 pool bytes/page",

@@ -156,6 +156,9 @@ class SnapshotStore(threading.Thread):
         # replica -> minimum epoch the depot still accepts puts for
         self._journal: Dict[Tuple[str, int, int], Dict[str, Any]] = {}
         self._fence: Dict[str, int] = {}
+        # telemetry snapshots: src name -> latest pushed metrics doc
+        # (last-write-wins; the launcher's rollup pulls the whole map)
+        self._metrics: Dict[str, Dict[str, Any]] = {}
         self._stop = threading.Event()
         self.start()
 
@@ -376,6 +379,17 @@ class SnapshotStore(threading.Thread):
             return {"fence_epoch":
                     self._fence.get(str(head["replica"]), 0)}, b""
 
+    def _cmd_metrics_push(self, head, payload):
+        doc = json.loads(payload) if payload else {}
+        with self._lock:
+            self._metrics[str(head["src"])] = doc
+        return {"ok": True}, b""
+
+    def _cmd_metrics_pull(self, head, payload):
+        with self._lock:
+            docs = dict(self._metrics)
+        return {"ok": True}, json.dumps(docs).encode()
+
 
 class SnapshotClient:
     """Rank-side client of :class:`SnapshotStore` (one socket, lock-
@@ -543,6 +557,17 @@ class SnapshotClient:
         resp, _ = self._call({"cmd": "fence_epoch",
                               "replica": str(replica)})
         return int(resp.get("fence_epoch", 0))
+
+    # -- telemetry snapshots (the fleet observability plane) ---------------
+    def metrics_push(self, src: str, doc: dict) -> None:
+        """Publish one process's latest metrics snapshot (last-write-wins
+        per ``src``); the launcher's rollup pulls the whole map."""
+        self._call({"cmd": "metrics_push", "src": str(src)},
+                   json.dumps(doc, default=repr).encode())
+
+    def metrics_pull(self) -> Dict[str, dict]:
+        _resp, payload = self._call({"cmd": "metrics_pull"})
+        return json.loads(payload) if payload else {}
 
 
 # -- KV fallback transport ---------------------------------------------------
@@ -714,6 +739,19 @@ class KVTransport:
             doc = self._get(k)
             if doc is not None:
                 out[rank] = doc
+        return out
+
+    # -- telemetry snapshots (same surface as SnapshotClient) --------------
+    def metrics_push(self, src: str, doc: dict) -> None:
+        self._set(f"metrics/{src}",
+                  json.loads(json.dumps(doc, default=repr)))
+
+    def metrics_pull(self) -> Dict[str, dict]:
+        out: Dict[str, dict] = {}
+        for k in self._keys("metrics/"):
+            doc = self._get(k)
+            if doc is not None:
+                out[k.split("/", 1)[1]] = doc
         return out
 
 

@@ -287,16 +287,19 @@ class Supervisor:
                 if rc == 0:
                     self._event("supervisor_done", restarts=self.restarts,
                                 time_to_first_step_s=ttfs, **resume)
+                    self._dump_blackbox("supervisor_done")
                     return 0
                 if rc not in self.restart_codes:
                     self._event("supervisor_fatal", exit_code=rc,
                                 restarts=self.restarts,
                                 time_to_first_step_s=ttfs, **resume)
+                    self._dump_blackbox("supervisor_fatal")
                     return rc
                 if self.restarts >= self.policy.max_restarts:
                     self._event("supervisor_giveup", exit_code=rc,
                                 restarts=self.restarts,
                                 time_to_first_step_s=ttfs, **resume)
+                    self._dump_blackbox("supervisor_giveup")
                     return rc
                 self.restarts += 1
                 delay = self.policy.delay(self.restarts)
@@ -336,6 +339,20 @@ class Supervisor:
             from .... import telemetry
 
             telemetry.record_event("supervisor", name, **data)
+        except Exception:
+            pass
+
+    @staticmethod
+    def _dump_blackbox(reason: str) -> None:
+        """Terminal-state dump: when a job-level epoch dir exists, leave
+        the parent's restart narrative next to the workers' dumps so
+        ``telemetry.blackbox.merge`` folds the supervisor's view in."""
+        if not os.environ.get("PADDLE_TPU_EPOCH_DIR"):
+            return
+        try:
+            from .... import telemetry
+
+            telemetry.dump_flight_recorder(reason=reason)
         except Exception:
             pass
 

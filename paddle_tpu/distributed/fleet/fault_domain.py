@@ -436,6 +436,12 @@ class LeaseMonitor:
         self.stragglers = stragglers
         _set_gauge("fleet_live_ranks", len(leases) - len(dead))
         _set_gauge("fleet_dead_ranks", len(dead))
+        # the job rollup cross-checks its step-skew straggler against
+        # these: aggregator.rollup names a straggler from snapshot skew,
+        # and straggler_confirmed means the lease monitor agrees
+        _set_gauge("fleet_straggler_count", len(stragglers))
+        if stragglers:
+            _set_gauge("fleet_straggler_rank", stragglers[0])
         steps = [d.get("step") or 0 for d in leases.values()]
         if steps:
             _set_gauge("fleet_max_step", max(steps))

@@ -301,6 +301,10 @@ def launch(argv=None) -> int:
             sys.stderr.write(f"[launch] snapshot store unavailable: {e!r}\n")
             snap = None
     os.makedirs(args.log_dir, exist_ok=True)
+    # the job's "epoch dir": every process (launcher included) defaults
+    # its flight-recorder dumps and periodic metric spills here, so
+    # telemetry.blackbox.merge can fold ONE causally ordered timeline
+    os.environ["PADDLE_TPU_EPOCH_DIR"] = os.path.abspath(args.log_dir)
 
     if args.mode == "serve":
         # serving pod: same store + depot hosting as a training pod (the
@@ -309,6 +313,7 @@ def launch(argv=None) -> int:
         try:
             return _serve_pod(args, node_rank, fleet_store_addr, snap)
         finally:
+            _observability_teardown(args.log_dir, snap)
             if watch is not None:
                 watch.stop()
             if snap is not None:
@@ -489,11 +494,48 @@ def launch(argv=None) -> int:
                 except (OSError, TypeError, ValueError):
                     pass
             watch.stop()
+        _observability_teardown(args.log_dir, snap)
         if snap is not None:
             snap.stop()
         if fleet_store is not None:
             fleet_store.close()
     return rc
+
+
+def _observability_teardown(log_dir: str, snap) -> None:
+    """Job-level observability epilogue (best-effort, never raises):
+    dump the launcher's own flight recorder next to the workers' dumps,
+    pull the metrics depot into one ``metrics_rollup.json``, and fold
+    every per-process dump into the merged black-box timeline."""
+    try:
+        from ... import telemetry
+        telemetry.dump_flight_recorder(
+            os.path.join(log_dir, f"flight_launcher_pid{os.getpid()}.json"),
+            reason="launch_teardown")
+    except Exception:
+        pass
+    if snap is not None and getattr(snap, "addr", None):
+        try:
+            import json
+
+            from ...telemetry.aggregator import rollup
+            from ..checkpoint.replicator import SnapshotClient
+            cli = SnapshotClient.from_address(snap.addr)
+            try:
+                snaps = cli.metrics_pull()
+            finally:
+                cli.close()
+            if snaps:
+                with open(os.path.join(log_dir, "metrics_rollup.json"),
+                          "w") as f:
+                    json.dump(rollup(snaps), f, indent=1, default=repr)
+        except Exception:
+            pass
+    try:
+        from ...telemetry import blackbox
+        blackbox.merge(log_dir)
+    except Exception:
+        pass
 
 
 def _serve_pod(args, node_rank: int, fleet_store_addr: Optional[str],

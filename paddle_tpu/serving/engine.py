@@ -75,6 +75,7 @@ from ..distributed.checkpoint import faults as _faults
 from ..distributed.checkpoint.replicator import env_int as _env_int
 from ..distributed.fleet.fault_domain import _env_float
 from ..telemetry import record_event as _event
+from ..telemetry import tracing
 from ..telemetry.runtime import bump as _bump
 from .admission import AdmissionController, Deadline, Overloaded
 from .journal import ServingJournal
@@ -96,7 +97,8 @@ class Request:
 
     def __init__(self, prompt, max_new_tokens: int,
                  eos_token_id: Optional[int],
-                 rid: Optional[int] = None):
+                 rid: Optional[int] = None,
+                 trace_id: Optional[str] = None):
         if rid is None:
             rid = Request._next_rid
             Request._next_rid += 1
@@ -104,6 +106,7 @@ class Request:
             rid = int(rid)
             Request._next_rid = max(Request._next_rid, rid + 1)
         self.rid = rid
+        self.trace_id = trace_id
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size == 0:
             raise ValueError("empty prompt")
@@ -310,7 +313,8 @@ class ServingEngine:
                deadline: Optional[Deadline] = None,
                rid: Optional[int] = None,
                delivered_tokens: Optional[List[int]] = None,
-               age_s: float = 0.0) -> int:
+               age_s: float = 0.0,
+               trace_id: Optional[str] = None) -> int:
         """Admit a request or refuse it.  Raises ``ValueError`` for a
         request the engine could NEVER serve (malformed, or worst-case
         page demand beyond the whole pool), :class:`Overloaded` for a
@@ -321,8 +325,13 @@ class ServingEngine:
         request replayed from a dead replica arrives with the tokens its
         client already saw (delivered high-water mark — regenerated but
         not re-emitted) and the wall-clock age it accrued there (deadlines
-        keep aging across the failover)."""
-        r = Request(prompt, max_new_tokens, eos_token_id, rid=rid)
+        keep aging across the failover).  ``trace_id`` is its
+        distributed-trace id (minted here for edge submits, passed
+        through for fleet/replay submits) — one trace spans the request's
+        whole life, across any number of replicas."""
+        trace_id = tracing.mint(trace_id)
+        r = Request(prompt, max_new_tokens, eos_token_id, rid=rid,
+                    trace_id=trace_id)
         if rid is not None and (
                 rid in self._results or rid in self.shed or
                 any(q.rid == rid for q in list(self._queue)) or
@@ -366,9 +375,9 @@ class ServingEngine:
             self.journal.submit_durable(r.rid, r.prompt, r.max_new_tokens,
                                         r.eos_token_id, r.deadline,
                                         primed=r.delivered_tokens or None,
-                                        age_s=age_s)
+                                        age_s=age_s, trace_id=trace_id)
         self._queue.append(r)
-        self.meter.submit(r.rid, age_s=age_s)
+        self.meter.submit(r.rid, age_s=age_s, trace_id=trace_id)
         self.meter.set_queue_depth(len(self._queue))
         self._work.set()
         return r.rid
@@ -399,7 +408,8 @@ class ServingEngine:
                         "eos_token_id": r.eos_token_id,
                         "deadline": (None if r.deadline is None
                                      else r.deadline.to_doc()),
-                        "age_s": age_s})
+                        "age_s": age_s,
+                        "trace_id": r.trace_id})
         if out and self.journal is not None:
             try:
                 self.journal.flush()
@@ -861,6 +871,16 @@ class ServingEngine:
         if self._on_token is not None:
             for rid, idx, tok in self._pending_delivery:
                 self._on_token(rid, idx, tok)
+        if self._pending_delivery:
+            # one deliver span per request per flush (not per token): the
+            # trace shows WHEN tokens became client-visible, the journal
+            # holds the per-token detail
+            per_rid: Dict[int, int] = {}
+            for rid, _idx, _tok in self._pending_delivery:
+                per_rid[rid] = per_rid.get(rid, 0) + 1
+            for rid, n in per_rid.items():
+                _event("serve_deliver", str(rid), tokens=n,
+                       trace=self.meter.trace_of(rid))
         self._pending_delivery.clear()
 
     def recover(self) -> dict:
@@ -880,7 +900,8 @@ class ServingEngine:
         for rid in st.open_rids():
             rec = st.requests[rid]
             r = Request(np.asarray(rec["prompt"], np.int32),
-                        rec["max_new_tokens"], rec["eos_token_id"], rid=rid)
+                        rec["max_new_tokens"], rec["eos_token_id"], rid=rid,
+                        trace_id=rec.get("trace_id"))
             r.deadline = Deadline.from_doc(rec.get("deadline"))
             toks = st.delivered.get(rid, [])
             r.delivered = len(toks)
@@ -892,19 +913,22 @@ class ServingEngine:
             # a client that gave up long ago
             age = max(0.0, time.time() - rec.get("submit_wall",
                                                  time.time()))
-            self.meter.submit(r.rid, age_s=age)
+            self.meter.submit(r.rid, age_s=age, trace_id=r.trace_id)
             replayed += 1
-        for rid in st.finished:
-            self._results[rid] = np.asarray(st.delivered.get(rid, []),
-                                            np.int32)
-        for rid, reason in st.shed.items():
-            self.shed[rid] = reason
+        # re-offer BEFORE restoring _results: a status poll must never see
+        # a rid finished while its journaled tokens are still on their way
+        # back to the sink
         if self._on_token is not None:
             for rid in sorted(st.delivered):
                 if rid in st.shed:
                     continue
                 for idx, tok in enumerate(st.delivered[rid]):
                     self._on_token(rid, idx, tok)
+        for rid in st.finished:
+            self._results[rid] = np.asarray(st.delivered.get(rid, []),
+                                            np.int32)
+        for rid, reason in st.shed.items():
+            self.shed[rid] = reason
         info = {"replayed": replayed, "finished": len(st.finished),
                 "shed": len(st.shed), "truncated": st.truncated,
                 "known_rids": sorted(st.requests)}

@@ -69,6 +69,8 @@ from ..distributed.checkpoint.replicator import (FencedEpoch, SnapshotClient,
 from ..distributed.fleet.fault_domain import (HeartbeatLease, _adapt_kv,
                                               _env_float, lease_expired)
 from ..telemetry import record_event as _event
+from ..telemetry import tracing
+from ..telemetry.aggregator import start_metrics_pusher
 from .admission import Deadline, Overloaded
 from .engine import ServingEngine
 from .journal import JournalState, ServingJournal
@@ -159,6 +161,10 @@ class JournalShipper:
 
     def __call__(self, seq: int, data: bytes) -> None:
         self.depot.journal_put(self.replica, self.epoch, int(seq), data)
+        # black-box happens-before anchor: blackbox.merge orders this
+        # ship BEFORE any fold of (replica, epoch) that consumed this seq
+        _event("fleet_ship", self.replica, epoch=self.epoch, seq=int(seq),
+               nbytes=len(data))
 
 
 def adopt_epoch(depot: SnapshotClient, replica: str) -> int:
@@ -167,7 +173,9 @@ def adopt_epoch(depot: SnapshotClient, replica: str) -> int:
     Supervisor relaunch safe even when the frontend never saw the death —
     the new incarnation's segments can never collide with (or be shadowed
     by) the old one's, and the old zombie is refused from here on."""
-    return depot.fence(replica, depot.fence_epoch(replica) + 1)
+    epoch = depot.fence(replica, depot.fence_epoch(replica) + 1)
+    _event("fleet_fence", str(replica), epoch=int(epoch))
+    return epoch
 
 
 def fold_depot_journal(depot: SnapshotClient, replica: str,
@@ -191,6 +199,10 @@ def fold_depot_journal(depot: SnapshotClient, replica: str,
         for rec in records:
             ServingJournal._fold(st, rec)
         st.segments_read += 1
+    # high_seq names the last segment this fold consumed: blackbox.merge
+    # draws ship(seq<=high_seq) -> this fold happens-before edges from it
+    _event("fleet_fold", str(replica), epoch=int(epoch),
+           high_seq=st.segments_read - 1, truncated=st.truncated)
     return st
 
 
@@ -292,10 +304,16 @@ class TokenCollector(_FramedServer):
 # -- replica (both in-process and subprocess shapes) -------------------------
 
 def _engine_status(engine: ServingEngine) -> dict:
+    # a rid whose final tokens are still awaiting _flush_delivery must not
+    # be reported finished: the frontend's wait_all would unblock on this
+    # status before the emission reaches the sink (the next poll picks the
+    # rid up once the flush lands)
+    pending = {rid for rid, _i, _t in list(engine._pending_delivery)}
     return {"queue_depth": len(engine._queue),
             "active": len(engine._active),
             "est_first_token_s": engine.meter.est_first_token_s(),
-            "finished": sorted(engine._results),
+            "finished": sorted(r for r in engine._results
+                               if r not in pending),
             "shed": {int(r): v for r, v in engine.shed.items()},
             "summary": engine.meter.summary()}
 
@@ -392,11 +410,12 @@ class EngineReplica:
                deadline: Optional[Deadline] = None,
                rid: Optional[int] = None,
                delivered_tokens: Optional[List[int]] = None,
-               age_s: float = 0.0) -> int:
+               age_s: float = 0.0,
+               trace_id: Optional[str] = None) -> int:
         return self.engine.submit(prompt, max_new_tokens, eos_token_id,
                                   deadline=deadline, rid=rid,
                                   delivered_tokens=delivered_tokens,
-                                  age_s=age_s)
+                                  age_s=age_s, trace_id=trace_id)
 
     def status(self) -> dict:
         return _engine_status(self.engine)
@@ -428,7 +447,8 @@ class ReplicaServer(_FramedServer):
                 deadline=Deadline.from_doc(head.get("deadline")),
                 rid=head.get("rid"),
                 delivered_tokens=head.get("delivered_tokens"),
-                age_s=float(head.get("age_s", 0.0)))
+                age_s=float(head.get("age_s", 0.0)),
+                trace_id=head.get("trace_id"))
         except Overloaded as e:
             return {"refused": "overloaded", "msg": str(e),
                     "retry_after_s": e.retry_after_s,
@@ -466,7 +486,8 @@ class RemoteReplica:
                deadline: Optional[Deadline] = None,
                rid: Optional[int] = None,
                delivered_tokens: Optional[List[int]] = None,
-               age_s: float = 0.0) -> int:
+               age_s: float = 0.0,
+               trace_id: Optional[str] = None) -> int:
         resp, _ = self._client._call({
             "cmd": "submit", "prompt": [int(x) for x in prompt],
             "max_new_tokens": int(max_new_tokens),
@@ -476,7 +497,8 @@ class RemoteReplica:
             "rid": rid,
             "delivered_tokens": (None if not delivered_tokens else
                                  [int(t) for t in delivered_tokens]),
-            "age_s": float(age_s)})
+            "age_s": float(age_s),
+            "trace_id": None if trace_id is None else str(trace_id)})
         if resp.get("ok"):
             return int(resp["rid"])
         if resp.get("refused") == "overloaded":
@@ -557,6 +579,10 @@ def run_replica(model, name: Optional[str] = None, *,
     status = _StatusLoop(lease, engine, _status_interval(t))
     lease.start()
     status.start()
+    # push StepMeter/SLOMeter snapshots to the launcher's depot and spill
+    # the flight-recorder ring to the epoch dir on the same cadence — a
+    # SIGKILL'd replica still leaves its spans for blackbox.merge
+    metrics = start_metrics_pusher(depot, engine, src=name)
     _event("serve_replica_up", name, epoch=epoch, address=server.address)
     clean = False
     try:
@@ -565,6 +591,13 @@ def run_replica(model, name: Optional[str] = None, *,
         return outs
     finally:
         status.stop()
+        metrics.stop(final_push=clean)
+        if clean and os.environ.get("PADDLE_TPU_EPOCH_DIR"):
+            try:
+                from ..telemetry import dump_flight_recorder
+                dump_flight_recorder(reason=f"replica_{name}_stop")
+            except Exception:
+                pass
         # only a CLEAN exit releases the lease; a crash/wedge must leave
         # it to expire so the frontend fences and fails the work over
         lease.stop(release=clean)
@@ -683,7 +716,11 @@ class ServingFrontend:
                                      else int(eos_token_id)),
                     "deadline": (None if deadline is None
                                  else deadline.to_doc()),
-                    "submit_wall": self._wall()}
+                    "submit_wall": self._wall(),
+                    # one trace per client request, minted HERE: the same
+                    # id rides the route, the replica's journal, any
+                    # failover replay, and the merged black box
+                    "trace_id": tracing.mint()}
             self.requests[rid] = desc
         try:
             self._route_submit(desc, rid=rid, delivered=None, age_s=0.0)
@@ -707,8 +744,9 @@ class ServingFrontend:
                       delivered: Optional[List[int]], age_s: float,
                       exclude: Set[str] = frozenset()) -> str:
         deadline = Deadline.from_doc(desc.get("deadline"))
+        trace_id = desc.get("trace_id")
         order = self.router.order(self._routable(exclude), deadline,
-                                  age_s=age_s)
+                                  age_s=age_s, trace_id=trace_id)
         if not order:
             raise Overloaded("no live serving replicas",
                              reason="no_replicas")
@@ -720,7 +758,8 @@ class ServingFrontend:
             try:
                 h.submit(desc["prompt"], desc["max_new_tokens"],
                          desc["eos_token_id"], deadline=deadline, rid=rid,
-                         delivered_tokens=delivered, age_s=age_s)
+                         delivered_tokens=delivered, age_s=age_s,
+                         trace_id=trace_id)
             except Overloaded as e:
                 last = e          # replica-side refusal: spill onward
                 continue
@@ -732,6 +771,8 @@ class ServingFrontend:
                 continue
             with self._lock:
                 self.assignments[rid] = st.name
+            _event("serve_route", st.name, rid=int(rid), trace=trace_id,
+                   replay=delivered is not None)
             return st.name
         raise last if last is not None else \
             Overloaded("all replicas refused", reason="queue_full")
@@ -813,7 +854,8 @@ class ServingFrontend:
                     "max_new_tokens": rec["max_new_tokens"],
                     "eos_token_id": rec.get("eos_token_id"),
                     "deadline": rec.get("deadline"),
-                    "submit_wall": rec.get("submit_wall", self._wall())}
+                    "submit_wall": rec.get("submit_wall", self._wall()),
+                    "trace_id": rec.get("trace_id")}
             with self._lock:
                 self.requests.setdefault(rid, desc)
             delivered = list(st.delivered.get(rid, []))
@@ -873,7 +915,8 @@ class ServingFrontend:
                     "max_new_tokens": d["max_new_tokens"],
                     "eos_token_id": d.get("eos_token_id"),
                     "deadline": d.get("deadline"),
-                    "submit_wall": self._wall() - float(d.get("age_s", 0.0))}
+                    "submit_wall": self._wall() - float(d.get("age_s", 0.0)),
+                    "trace_id": d.get("trace_id")}
             if self._replay_one(rid, desc, [], exclude={name}):
                 moved += 1
         self.meter.handback(name, moved)
@@ -923,7 +966,8 @@ class ServingFrontend:
                         "eos_token_id": rec.get("eos_token_id"),
                         "deadline": rec.get("deadline"),
                         "submit_wall": rec.get("submit_wall",
-                                               self._wall())})
+                                               self._wall()),
+                        "trace_id": rec.get("trace_id")})
                     if rid not in jstate.finished and \
                             rid not in jstate.shed:
                         self.assignments[rid] = name

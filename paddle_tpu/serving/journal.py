@@ -113,7 +113,7 @@ class ServingJournal:
     @staticmethod
     def _submit_record(rid: int, prompt, max_new_tokens: int,
                        eos_token_id, deadline, primed=None,
-                       age_s: float = 0.0) -> dict:
+                       age_s: float = 0.0, trace_id=None) -> dict:
         rec = {"t": "submit", "rid": int(rid),
                "prompt": [int(x) for x in prompt],
                "max_new_tokens": int(max_new_tokens),
@@ -132,19 +132,24 @@ class ServingJournal:
             # delivered — folded as this rid's starting high-water mark so
             # THIS journal has no gap before its first deliver record
             rec["primed"] = [int(x) for x in primed]
+        if trace_id is not None:
+            # distributed-trace id (schema-additive: old journals simply
+            # lack the key): the replay path re-mints from this, so one
+            # trace survives any number of crashes and fail-overs
+            rec["trace_id"] = str(trace_id)
         return rec
 
     def submit(self, rid: int, prompt, max_new_tokens: int,
                eos_token_id, deadline, primed=None,
-               age_s: float = 0.0) -> None:
+               age_s: float = 0.0, trace_id=None) -> None:
         with self._lock:
             self._pending.append(self._submit_record(
                 rid, prompt, max_new_tokens, eos_token_id, deadline,
-                primed=primed, age_s=age_s))
+                primed=primed, age_s=age_s, trace_id=trace_id))
 
     def submit_durable(self, rid: int, prompt, max_new_tokens: int,
                        eos_token_id, deadline, primed=None,
-                       age_s: float = 0.0) -> None:
+                       age_s: float = 0.0, trace_id=None) -> None:
         """Record an accepted request and flush it to disk as ONE atomic
         operation.  On a flush failure exactly this record is dropped
         from the buffer (other threads' pending records — e.g. the
@@ -153,7 +158,8 @@ class ServingJournal:
         and no ghost request can be replayed after a crash."""
         rec = self._submit_record(rid, prompt, max_new_tokens,
                                   eos_token_id, deadline,
-                                  primed=primed, age_s=age_s)
+                                  primed=primed, age_s=age_s,
+                                  trace_id=trace_id)
         with self._lock:
             self._pending.append(rec)
             try:

@@ -31,7 +31,8 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from ..telemetry import record_event
-from ..telemetry.runtime import bump, set_gauge
+from ..telemetry.aggregator import Histogram
+from ..telemetry.runtime import bump, identity, set_gauge
 
 __all__ = ["RequestClock", "SLOMeter", "FleetMeter"]
 
@@ -57,6 +58,9 @@ class RequestClock:
     n_tokens: int = 0
     evictions: int = 0
     replay_watermark: int = 0   # tokens produced before the last eviction
+    # distributed-trace id (telemetry.tracing): minted at the edge, carried
+    # through journal replay and fail-over, stamped on every span event
+    trace_id: Optional[str] = None
 
     @property
     def ttft_s(self) -> Optional[float]:
@@ -115,26 +119,64 @@ class SLOMeter:
         self.spec_verify_steps = 0
         self.spec_rows_total = 0
         self.kv_bytes_per_token: Optional[float] = None
+        # TTFT/TPOT/latency histograms (telemetry.aggregator.Histogram):
+        # mergeable bucket counts the MetricsPusher ships to the depot so
+        # the fleet p99 is computed from summed buckets, never averaged
+        # percentiles.  Observations also bump `serving.<kind>_hist.*`
+        # runtime counters, which prometheus_text() renders as real
+        # _bucket/_sum/_count series.
+        self.hists: Dict[str, Histogram] = {
+            "ttft_s": Histogram(), "tpot_s": Histogram(),
+            "latency_s": Histogram()}
+        # trace-coverage accounting: of finished requests, how many had a
+        # complete traced span chain (counters, not clocks — clocks are
+        # dropped at finish)
+        self._trace_complete = 0
 
     def clock(self, rid) -> RequestClock:
         return self._clocks[rid]
 
+    def trace_of(self, rid) -> Optional[str]:
+        c = self._clocks.get(rid)
+        return None if c is None else c.trace_id
+
+    def _observe(self, kind: str, value: float) -> None:
+        h = self.hists[kind]
+        h.observe(value)
+        for i, ub in enumerate(h.buckets):
+            if value <= ub:
+                bump(f"serving.{kind}_hist.bucket.{ub}")
+                break
+        else:
+            bump(f"serving.{kind}_hist.bucket_inf")
+        bump(f"serving.{kind}_hist.sum", float(value))
+        bump(f"serving.{kind}_hist.count")
+
+    def hist_docs(self) -> Dict[str, dict]:
+        return {k: h.to_doc() for k, h in self.hists.items()}
+
     # -- lifecycle ---------------------------------------------------------
-    def submit(self, rid, age_s: float = 0.0) -> None:
+    def submit(self, rid, age_s: float = 0.0,
+               trace_id: Optional[str] = None) -> None:
         """``age_s`` backdates the clock: a journal-replayed request has
         already waited that long in its previous incarnation, and its
-        deadline budgets must keep aging across the crash."""
+        deadline budgets must keep aging across the crash.  ``trace_id``
+        is the request's distributed-trace id (same id across replay and
+        fail-over); the submit span and every later span carry it."""
         t = self._now() - max(0.0, float(age_s))
-        self._clocks[rid] = RequestClock(rid=rid, submit_t=t)
+        self._clocks[rid] = RequestClock(rid=rid, submit_t=t,
+                                         trace_id=trace_id)
         if self._t_first_submit is None:
             self._t_first_submit = t
+        record_event("serve_submit", str(rid), trace=trace_id,
+                     age_s=round(float(age_s), 6))
         bump("serving.requests_submitted")
 
     def admit(self, rid, *, queue_depth: int, pages: int) -> None:
         c = self._clocks[rid]
         c.admit_t = self._now()
         record_event("serve_admit", str(rid), pages=pages,
-                     queue_depth=queue_depth,
+                     queue_depth=queue_depth, trace=c.trace_id,
                      queued_s=round(c.admit_t - c.submit_t, 6))
         bump("serving.requests_admitted")
 
@@ -145,6 +187,12 @@ class SLOMeter:
             c.first_token_t = t     # an eviction-replay re-prefill must
             if c.admit_t is not None:    # not reset the client's TTFT
                 self._ft_window.append(t - c.admit_t)
+            if c.ttft_s is not None:
+                self._observe("ttft_s", c.ttft_s)
+            # the prefill span: submit -> first token out
+            record_event("serve_first_token", str(rid), trace=c.trace_id,
+                         ttft_s=(None if c.ttft_s is None
+                                 else round(c.ttft_s, 6)))
         c.last_token_t = t
         c.n_tokens += 1
         self._count_token(c)
@@ -175,7 +223,8 @@ class SLOMeter:
         c.replay_watermark = max(c.replay_watermark, c.n_tokens)
         c.n_tokens = 0
         record_event("serve_evict", str(rid), reason=reason,
-                     pages_freed=pages_freed, evictions=c.evictions)
+                     pages_freed=pages_freed, evictions=c.evictions,
+                     trace=c.trace_id)
         bump("serving.evictions")
 
     def shed(self, rid, *, reason: str) -> None:
@@ -184,6 +233,7 @@ class SLOMeter:
         c = self._clocks.pop(rid, None)
         self.shed_total += 1
         record_event("serve_shed", str(rid), reason=reason,
+                     trace=None if c is None else c.trace_id,
                      queued_s=(None if c is None else
                                round(self._now() - c.submit_t, 6)))
         bump("serving.requests_shed_total")
@@ -220,9 +270,18 @@ class SLOMeter:
                 bump("serving.deadline_misses_total")
         self._window.append((c.finish_t, c.ttft_s, c.tpot_s, c.latency_s,
                              miss))
+        if c.tpot_s is not None:
+            self._observe("tpot_s", c.tpot_s)
+        if c.latency_s is not None:
+            self._observe("latency_s", c.latency_s)
+        # traced span chain complete?  (submit span always exists; admit +
+        # first token are the waypoints a lost trace would have dropped)
+        if c.trace_id is not None and c.admit_t is not None \
+                and c.first_token_t is not None:
+            self._trace_complete += 1
         set_gauge("serving.deadline_miss_rate", self.deadline_miss_rate())
         record_event("serve_finish", str(rid), n_tokens=n_tokens,
-                     latency_s=round(c.latency_s, 6),
+                     latency_s=round(c.latency_s, 6), trace=c.trace_id,
                      evictions=c.evictions, deadline_miss=miss)
         bump("serving.requests_finished")
 
@@ -308,7 +367,18 @@ class SLOMeter:
                 self._t_last_finish is not None:
             span = max(self._t_last_finish - self._t_first_submit, 1e-9)
         n = self.finished_total
+        ident = identity()
         return {
+            # self-identification (schema-additive): a summary pushed to
+            # the launcher's metrics depot names its replica/rank and its
+            # own wall stamp
+            "wall_time": time.time(),
+            "replica": ident.get("replica"),
+            "rank": ident.get("rank"),
+            # the CI gate: fraction of finished requests whose traced span
+            # chain stayed complete through eviction/replay/fail-over
+            "trace_coverage": round(self._trace_complete / n, 4) if n
+            else 1.0,
             "requests_finished": n,
             "requests_shed": self.shed_total,
             "requests_rejected": self.rejected_total,

@@ -24,6 +24,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import List, Optional
 
+from ..telemetry import record_event
 from .admission import Deadline
 
 __all__ = ["ReplicaStatus", "Router"]
@@ -64,32 +65,47 @@ class Router:
 
     def pick(self, replicas: List[ReplicaStatus],
              deadline: Optional[Deadline] = None, *,
-             age_s: float = 0.0) -> Optional[ReplicaStatus]:
+             age_s: float = 0.0,
+             trace_id: Optional[str] = None) -> Optional[ReplicaStatus]:
         """Best replica for one request, or ``None`` when no routable
-        replica exists at all (every one dead or draining)."""
+        replica exists at all (every one dead or draining).  With a
+        ``trace_id`` the decision is stamped into the flight recorder
+        (``fleet_route``) so the merged black box shows WHY a request
+        landed where it did."""
         cands = [r for r in replicas if not r.draining]
         if not cands:
             return None
         budget = None
         if deadline is not None and deadline.ttft_s is not None:
             budget = deadline.ttft_s - age_s
+        spilled = False
         if budget is not None:
             fits = [r for r in cands
                     if r.est_first_token_s is None
                     or r.est_first_token_s <= budget]
             if fits:
+                spilled = len(fits) < len(cands)
                 cands = fits   # spill toward replicas that can make TTFT
-        return min(cands, key=lambda r: (r.load, r.name))
+        best = min(cands, key=lambda r: (r.load, r.name))
+        if trace_id is not None:
+            record_event("fleet_route", best.name, trace=trace_id,
+                         load=round(best.load, 4), spilled=spilled,
+                         candidates=len(replicas))
+        return best
 
     def order(self, replicas: List[ReplicaStatus],
               deadline: Optional[Deadline] = None, *,
-              age_s: float = 0.0) -> List[ReplicaStatus]:
+              age_s: float = 0.0,
+              trace_id: Optional[str] = None) -> List[ReplicaStatus]:
         """All routable replicas, best first — the frontend walks this so
-        a replica-side refusal (``Overloaded``) spills to the next one."""
+        a replica-side refusal (``Overloaded``) spills to the next one.
+        Only the FIRST pick carries the trace: one routing decision per
+        attempt, the spill walk is not N decisions."""
         out: List[ReplicaStatus] = []
         pool = list(replicas)
         while True:
-            best = self.pick(pool, deadline, age_s=age_s)
+            best = self.pick(pool, deadline, age_s=age_s,
+                             trace_id=trace_id if not out else None)
             if best is None:
                 return out
             out.append(best)

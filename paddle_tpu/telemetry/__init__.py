@@ -40,7 +40,14 @@ from .collectives import (ICI_GBPS_ONEWAY, PEAK_HBM_GBPS,  # noqa: F401
                           traced_programs)
 from .memory import hbm_peak_gb, hbm_stats, hbm_watermarks  # noqa: F401
 from .stepmeter import StepMeter  # noqa: F401
-from .prometheus import prometheus_text  # noqa: F401
+from .prometheus import prometheus_text, render_histogram  # noqa: F401
+from .tracing import (TRACE_KEY, chrome_trace_events, mint,  # noqa: F401
+                      trace_coverage, trace_ids)
+from .tracing import spans as trace_spans  # noqa: F401
+from .aggregator import (Histogram, MemoryDepot, MetricsPusher,  # noqa: F401
+                         local_snapshot, prometheus_rollup_text, rollup,
+                         start_metrics_pusher)
+from . import blackbox  # noqa: F401
 
 __all__ = [
     "enable", "disable", "enabled", "reset", "bump", "set_gauge", "counters",
@@ -51,5 +58,10 @@ __all__ = [
     "register_traced_program", "traced_programs",
     "PEAK_TFLOPS", "ICI_GBPS_ONEWAY", "PEAK_HBM_GBPS", "chip_lookup",
     "hbm_stats", "hbm_watermarks", "hbm_peak_gb",
-    "StepMeter", "prometheus_text",
+    "StepMeter", "prometheus_text", "render_histogram",
+    "TRACE_KEY", "mint", "trace_spans", "trace_ids", "trace_coverage",
+    "chrome_trace_events",
+    "Histogram", "MemoryDepot", "MetricsPusher", "local_snapshot",
+    "rollup", "prometheus_rollup_text", "start_metrics_pusher",
+    "blackbox",
 ]

@@ -5,18 +5,28 @@
 current HBM watermarks — scrape-ready for a node exporter sidecar, or just
 diff-able in logs. No HTTP server here: serving one line of text is the
 deployment's job; producing it is ours.
+
+Fleet-awareness: pass ``labels={"replica": ..., "rank": ...}`` (or let it
+default from the launch env — ``PADDLE_TPU_SERVE_REPLICA`` /
+``PADDLE_TRAINER_ID``) and every sample is stamped with them, so N
+processes' scrapes aggregate instead of colliding names.  Serving TTFT /
+TPOT / latency export as REAL histograms (``_bucket``/``_sum``/``_count``
+with ``le`` labels, observations bumped by :class:`SLOMeter` into runtime
+counters under ``<base>_hist.*``) — aggregate p99s come from summing
+buckets across scrapes, never from averaging per-process percentiles.
 """
 
 from __future__ import annotations
 
-from typing import List
+import os
+from typing import Dict, List, Optional
 
 from . import runtime
 from .collectives import collective_stats
 from .memory import hbm_stats
 from .recorder import get_flight_recorder
 
-__all__ = ["prometheus_text"]
+__all__ = ["prometheus_text", "render_histogram"]
 
 _PREFIX = "paddle_tpu"
 
@@ -25,23 +35,101 @@ def _esc(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"')
 
 
+def _labels_str(labels: Optional[dict]) -> str:
+    if not labels:
+        return ""
+    return "{" + ",".join(f'{k}="{_esc(str(v))}"'
+                          for k, v in labels.items()) + "}"
+
+
 def _metric(lines: List[str], name: str, mtype: str, help_: str,
-            samples: List[tuple]) -> None:
+            samples: List[tuple], base_labels: Optional[dict] = None) -> None:
     """samples: [(labels_dict_or_None, value), ...]"""
     full = f"{_PREFIX}_{name}"
     lines.append(f"# HELP {full} {help_}")
     lines.append(f"# TYPE {full} {mtype}")
     for labels, value in samples:
+        merged = dict(base_labels or {})
         if labels:
-            lab = ",".join(f'{k}="{_esc(str(v))}"' for k, v in labels.items())
-            lines.append(f"{full}{{{lab}}} {value}")
-        else:
-            lines.append(f"{full} {value}")
+            merged.update(labels)
+        lines.append(f"{full}{_labels_str(merged)} {value}")
 
 
-def prometheus_text() -> str:
+def render_histogram(lines: List[str], name: str, help_: str, doc: dict,
+                     labels: Optional[dict] = None) -> None:
+    """Append one real Prometheus histogram: cumulative ``_bucket`` lines
+    with ``le`` labels (``+Inf`` included), then ``_sum`` and ``_count``.
+    ``doc`` is a :class:`telemetry.aggregator.Histogram` doc
+    (``{"buckets", "counts", "inf", "sum", "count"}``)."""
+    full = f"{_PREFIX}_{name}"
+    lines.append(f"# HELP {full} {help_}")
+    lines.append(f"# TYPE {full} histogram")
+    base = dict(labels or {})
+    cum = 0
+    for ub, c in zip(doc.get("buckets", ()), doc.get("counts", ())):
+        cum += int(c)
+        lab = _labels_str(dict(base, le=repr(float(ub))))
+        lines.append(f"{full}_bucket{lab} {cum}")
+    lab = _labels_str(dict(base, le="+Inf"))
+    lines.append(f"{full}_bucket{lab} {int(doc.get('count', 0))}")
+    lines.append(f"{full}_sum{_labels_str(base)} {doc.get('sum', 0.0)}")
+    lines.append(f"{full}_count{_labels_str(base)} "
+                 f"{int(doc.get('count', 0))}")
+
+
+def _env_labels() -> Dict[str, str]:
+    """Default sample labels from the launch env: a fleet child scrapes
+    self-identified; a bare process (tests, notebooks) stays unlabeled."""
+    out: Dict[str, str] = {}
+    replica = os.environ.get("PADDLE_TPU_SERVE_REPLICA")
+    if replica:
+        out["replica"] = replica
+    rank = os.environ.get("PADDLE_TRAINER_ID")
+    if rank:
+        out["rank"] = rank
+    return out
+
+
+def _hist_docs(ctr: Dict[str, float]) -> Dict[str, dict]:
+    """Reassemble histogram docs from the ``<base>_hist.*`` counters
+    :class:`SLOMeter` bumps (``.bucket.<le>`` / ``.sum`` / ``.count``)."""
+    out: Dict[str, dict] = {}
+    for key, v in ctr.items():
+        if "_hist." not in key:
+            continue
+        base, _, field = key.partition("_hist.")
+        doc = out.setdefault(base, {"buckets": [], "counts": {},
+                                    "inf": 0, "sum": 0.0, "count": 0})
+        if field.startswith("bucket."):
+            try:
+                le = float(field.split(".", 1)[1])
+            except ValueError:
+                continue
+            doc["counts"][le] = doc["counts"].get(le, 0) + int(v)
+        elif field == "bucket_inf":
+            doc["inf"] = int(v)
+        elif field == "sum":
+            doc["sum"] = float(v)
+        elif field == "count":
+            doc["count"] = int(v)
+    for doc in out.values():
+        les = sorted(doc["counts"])
+        doc["buckets"] = les
+        doc["counts"] = [doc["counts"][le] for le in les]
+    return out
+
+
+def prometheus_text(labels: Optional[dict] = None) -> str:
+    base = _env_labels() if labels is None else dict(labels)
     lines: List[str] = []
     ctr = runtime.counters()
+
+    # every emission below goes through the module-level _metric with the
+    # process's base labels stamped on (shadowing keeps the body readable)
+    mod_metric = globals()["_metric"]
+
+    def _metric(lines_, name, mtype, help_, samples):
+        mod_metric(lines_, name, mtype, help_, samples, base_labels=base)
 
     _metric(lines, "steps_total", "counter", "Training steps metered",
             [(None, int(ctr.get("steps_total", 0)))])
@@ -169,4 +257,13 @@ def prometheus_text() -> str:
                 [({"kernel": parts[1], "reason": parts[2]}, int(v))
                  for parts, v in fb]
                 or [(None, int(ctr["kernel_fallback.total"]))])
+
+    # serving SLO histograms (real _bucket/_sum/_count series): SLOMeter
+    # bumps observations into `serving.<kind>_hist.*` counters; reassemble
+    # and render them so a fleet scrape can merge buckets, not percentiles
+    for base_key, doc in sorted(_hist_docs(ctr).items()):
+        name = base_key.replace(".", "_") + "_seconds"
+        render_histogram(lines, name,
+                         f"Observed {base_key.split('.')[-1]} distribution",
+                         doc, labels=base)
     return "\n".join(lines) + "\n"

@@ -116,17 +116,32 @@ class FlightRecorder:
             return ""
         try:
             if path is None:
-                d = os.environ.get("PADDLE_TPU_FLIGHT_RECORDER_DIR",
-                                   "flight_recorder")
+                # default dir preference: explicit recorder dir > the
+                # launcher's epoch dir (PADDLE_TPU_EPOCH_DIR, where
+                # blackbox.merge folds all per-rank dumps) > ./flight_recorder
+                d = os.environ.get("PADDLE_TPU_FLIGHT_RECORDER_DIR") \
+                    or os.environ.get("PADDLE_TPU_EPOCH_DIR") \
+                    or "flight_recorder"
                 os.makedirs(d, exist_ok=True)
                 stamp = time.strftime("%Y%m%d_%H%M%S")
+                # rank/replica-qualify the name: N ranks dumping into one
+                # epoch dir must never collide (host+pid alone recycles
+                # across relaunches)
+                ident = runtime.identity()
+                tag = ""
+                if ident.get("replica"):
+                    tag = f"_{ident['replica']}"
+                elif ident.get("rank") is not None:
+                    tag = f"_rank{ident['rank']}"
                 path = os.path.join(
-                    d, f"flight_{socket.gethostname()}_pid{os.getpid()}"
+                    d, f"flight_{socket.gethostname()}{tag}"
+                       f"_pid{os.getpid()}"
                        f"_{reason}_{stamp}_{time.time_ns() % 1_000_000}.json")
             doc = {
                 "reason": reason,
                 "host": socket.gethostname(),
                 "pid": os.getpid(),
+                "identity": runtime.identity(),
                 "dumped_at": time.time(),
                 "dropped_events": self._dropped,
                 "counters": runtime.counters(),

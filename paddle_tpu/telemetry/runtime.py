@@ -69,6 +69,24 @@ def now() -> dict:
     return {"ts": time.time(), "mono_ns": time.perf_counter_ns()}
 
 
+def identity() -> Dict[str, object]:
+    """Who this process is, from the launch env: the self-identification
+    stamp pushed metric snapshots and flight-recorder dumps carry so the
+    launcher-side rollup / black-box merge can attribute them without
+    guessing from filenames.  Keys appear only when known."""
+    out: Dict[str, object] = {"pid": os.getpid()}
+    rank = os.environ.get("PADDLE_TRAINER_ID")
+    if rank is not None:
+        try:
+            out["rank"] = int(rank)
+        except ValueError:
+            pass
+    replica = os.environ.get("PADDLE_TPU_SERVE_REPLICA")
+    if replica:
+        out["replica"] = replica
+    return out
+
+
 def reset() -> None:
     """Clear counters (tests). The flight recorder and collective registry
     register their own reset hooks here."""

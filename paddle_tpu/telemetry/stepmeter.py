@@ -160,6 +160,15 @@ class StepMeter:
             "meter": self.name, "step": self.step_num,
             "ts": time.time(), "dt_s": round(dt, 6),
         }
+        # self-identification (schema-additive): a row pushed to the
+        # launcher's metrics depot names its own rank/replica, so the
+        # job rollup never has to guess attribution from filenames
+        rec["wall_time"] = rec["ts"]
+        ident = runtime.identity()
+        if ident.get("rank") is not None:
+            rec["rank"] = ident["rank"]
+        if ident.get("replica"):
+            rec["replica"] = ident["replica"]
         safe_dt = dt if dt > 0 else 0.0
         rec["tokens_per_s"] = round(tokens / safe_dt, 3) if tokens and safe_dt \
             else 0.0
@@ -247,7 +256,13 @@ class StepMeter:
         if self.step_num == 0:
             return {"meter": self.name, "steps": 0}
         out: Dict[str, Any] = {"meter": self.name, "steps": self.step_num,
-                               "total_s": round(self._total_dt, 4)}
+                               "total_s": round(self._total_dt, 4),
+                               "wall_time": time.time()}
+        ident = runtime.identity()
+        if ident.get("rank") is not None:
+            out["rank"] = ident["rank"]
+        if ident.get("replica"):
+            out["replica"] = ident["replica"]
         if self._total_dt > 0:
             if self.tokens_per_step:
                 out["tokens_per_s"] = round(

@@ -83,7 +83,11 @@ for _k, _v in (("PADDLE_TPU_SP", "1"),
                # replayed within ~1-2s on the CPU lane
                ("PADDLE_TPU_SERVE_FLEET_TTL", "1.0"),
                ("PADDLE_TPU_SERVE_FLEET_SCAN", "0.2"),
-               ("PADDLE_TPU_SERVE_FLEET_STATUS", "0.1")):
+               ("PADDLE_TPU_SERVE_FLEET_STATUS", "0.1"),
+               # observability plane: the production 10s metrics push
+               # cadence would leave the trace chaos e2e waiting on the
+               # victim's first black-box spill — push every 0.2s
+               ("PADDLE_TPU_METRICS_PUSH_S", "0.2")):
     os.environ.setdefault(_k, _v)
 
 import jax  # noqa: E402

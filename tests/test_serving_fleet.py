@@ -88,7 +88,8 @@ class FakeReplica:
         self.submits = []
 
     def submit(self, prompt, max_new_tokens=64, eos_token_id=None, *,
-               deadline=None, rid=None, delivered_tokens=None, age_s=0.0):
+               deadline=None, rid=None, delivered_tokens=None, age_s=0.0,
+               trace_id=None):
         if self.fail == "oserror":
             raise ConnectionRefusedError("fake transport down")
         if self.fail == "overloaded":
@@ -97,7 +98,7 @@ class FakeReplica:
                              "max_new_tokens": max_new_tokens,
                              "deadline": deadline,
                              "delivered": list(delivered_tokens or []),
-                             "age_s": age_s})
+                             "age_s": age_s, "trace_id": trace_id})
         return rid
 
     def status(self):
