@@ -1820,6 +1820,180 @@ def bench_serving(on_accel: bool, peak: float):
         depot_store.close()
         shutil.rmtree(fleet_root, ignore_errors=True)
 
+    # --- elastic autoscaling leg (ISSUE 17): the same wave trace offered
+    # twice.  First against FIXED capacity (one replica, tight queue) to
+    # record the baseline shed rate; then against the Autoscaler-driven
+    # fleet (max 2) where the first wave's pressure scales out and the
+    # later waves land on doubled capacity — the ramp must scale out AND
+    # back in at least once, shed strictly less than the fixed baseline,
+    # and deliver every accepted token exactly once.
+    from paddle_tpu.serving.autoscaler import Autoscaler, AutoscalePolicy
+
+    def _ramp_waves(n_waves: int, wave: int):
+        rngr = np.random.default_rng(23)
+        return [[(rngr.integers(1, cfg.vocab_size,
+                                int(prompt_lens[j % len(prompt_lens)])
+                                ).astype(np.int32), max_new_lo)
+                 for j in range(wave)] for _ in range(n_waves)]
+
+    ramp_ekw = dict(max_batch=max_batch, page_tokens=page_tokens,
+                    num_pages=num_pages, max_pages_per_seq=mp, max_queue=2)
+    waves = _ramp_waves(4, 8)
+    ramp_root = tempfile.mkdtemp(prefix="paddle_tpu_serve_ramp_")
+    ramp_store = SnapshotStore(host="127.0.0.1")
+    ramp_depot = SnapshotClient("127.0.0.1", ramp_store.port)
+    try:
+        # baseline: fixed capacity, no scaler
+        kv_b = LocalKV()
+        base_delivered: dict = {}
+
+        def base_sink(rid, idx, tok):
+            toks = base_delivered.setdefault(rid, [])
+            if idx == len(toks):
+                toks.append(int(tok))
+
+        fe_b = ServingFrontend(kv_b, ramp_depot, sink=base_sink, ttl=1.0,
+                               auto_attach=False)
+        rb = EngineReplica("base0", model, store=kv_b, depot=ramp_depot,
+                           journal_root=os.path.join(ramp_root, "jb"),
+                           on_token=fe_b.emit, ttl=1.0,
+                           engine_kw=ramp_ekw).start()
+        fe_b.attach(rb)
+        base_offered = base_rejected = 0
+        base_rids: dict = {}
+        for w in waves:
+            for prompt, mn in w:
+                base_offered += 1
+                try:
+                    base_rids[fe_b.submit(prompt, max_new_tokens=mn)] = mn
+                except Overloaded:
+                    base_rejected += 1
+            if not fe_b.wait_all(list(base_rids), timeout=300):
+                raise RuntimeError(
+                    f"autoscale baseline wave stalled: {fe_b.summary()}")
+        base_shed = sum(1 for r in base_rids if r in fe_b.shed)
+        baseline_shed_rate = (base_rejected + base_shed) \
+            / max(base_offered, 1)
+        rb.stop()
+        fe_b.stop()
+        if baseline_shed_rate <= 0:
+            raise RuntimeError(
+                "autoscale baseline leg shed nothing — the wave trace no "
+                "longer exceeds fixed capacity, the ramp comparison is "
+                "vacuous")
+
+        # ramp: same waves, Autoscaler spawning in-process replicas
+        kv_r = LocalKV()
+        ramp_delivered: dict = {}
+
+        def ramp_sink(rid, idx, tok):
+            toks = ramp_delivered.setdefault(rid, [])
+            if idx == len(toks):
+                toks.append(int(tok))
+
+        fe_r = ServingFrontend(kv_r, ramp_depot, sink=ramp_sink, ttl=1.0,
+                               auto_attach=False)
+        ramp_replicas: dict = {}
+        spawn_n = [0]
+
+        class _InprocPool:
+            def live_names(self):
+                return sorted(ramp_replicas)
+
+            def note_retiring(self, name):
+                pass
+
+            def scale_to(self, n, victims=()):
+                spawned = []
+                while len(ramp_replicas) < n:
+                    name = f"as{spawn_n[0]}"
+                    spawn_n[0] += 1
+                    rep = EngineReplica(
+                        name, model, store=kv_r, depot=ramp_depot,
+                        journal_root=os.path.join(ramp_root, "jr"),
+                        on_token=fe_r.emit, ttl=1.0,
+                        engine_kw=ramp_ekw).start()
+                    ramp_replicas[name] = rep
+                    fe_r.attach(rep)
+                    spawned.append(name)
+                return {"spawned": spawned, "retiring": list(victims),
+                        "live": self.live_names()}
+
+        def _retirer(victim, statuses):
+            rep = ramp_replicas.get(victim.name)
+            if rep is None:
+                return False
+            fe_r.drain(victim.name)   # stop routing, re-home queued work
+            rep.retire()              # DRAINING onto the lease; actives
+            return True               # decode to completion in place
+
+        scaler = Autoscaler(kv_r, None,
+                            policy=AutoscalePolicy(min_replicas=1,
+                                                   max_replicas=2,
+                                                   up_thresh=0.8,
+                                                   down_thresh=0.3,
+                                                   cooldown_s=0.2),
+                            pool=_InprocPool(), retirer=_retirer)
+        scaler.pool.scale_to(1)
+        ramp_offered = ramp_rejected = 0
+        ramp_rids: dict = {}
+        for wi, w in enumerate(waves):
+            for prompt, mn in w:
+                ramp_offered += 1
+                try:
+                    ramp_rids[fe_r.submit(prompt, max_new_tokens=mn)] = mn
+                except Overloaded:
+                    ramp_rejected += 1
+            t_wave = time.perf_counter() + 60
+            while time.perf_counter() < t_wave:
+                scaler.tick()
+                if fe_r.wait_all(list(ramp_rids), timeout=0.2):
+                    if wi > 0 or scaler.scale_outs >= 1:
+                        break
+        if scaler.scale_outs < 1:
+            raise RuntimeError(
+                "autoscale ramp leg never scaled out under the wave "
+                f"pressure: {scaler.summary()}")
+        if not any(fe_r.assignments.get(r) == "as1" for r in ramp_rids):
+            raise RuntimeError(
+                "autoscale ramp leg scaled out but the warm replica "
+                "took no traffic")
+        # waves done, fleet idle: the scaler must give the capacity back
+        t_in = time.perf_counter() + 60
+        while scaler.scale_ins < 1 and time.perf_counter() < t_in:
+            scaler.tick()
+            time.sleep(0.05)
+        if scaler.scale_ins < 1:
+            raise RuntimeError(
+                "autoscale ramp leg never scaled back in after the step "
+                f"was removed: {scaler.summary()}")
+        if not fe_r.wait_all(list(ramp_rids), timeout=300):
+            raise RuntimeError(
+                f"autoscale ramp leg stalled: {fe_r.summary()}")
+        ramp_shed = sum(1 for r in ramp_rids if r in fe_r.shed)
+        ramp_shed_rate = (ramp_rejected + ramp_shed) / max(ramp_offered, 1)
+        if ramp_shed_rate >= baseline_shed_rate:
+            raise RuntimeError(
+                f"autoscale ramp shed {ramp_shed_rate:.2%} — not below "
+                f"the fixed-capacity baseline {baseline_shed_rate:.2%}; "
+                "scale-out is not absorbing the step")
+        for rid, mn in ramp_rids.items():
+            if rid in fe_r.shed:
+                continue
+            got = len(ramp_delivered.get(rid, []))
+            if got != mn:
+                raise RuntimeError(
+                    f"autoscale ramp rid {rid}: {got} tokens delivered, "
+                    f"wanted {mn} — drain hand-back broke exactly-once")
+        scaled_out, scaled_in = scaler.scale_outs, scaler.scale_ins
+        for rep in ramp_replicas.values():
+            rep.stop()
+        fe_r.stop()
+    finally:
+        ramp_depot.close()
+        ramp_store.close()
+        shutil.rmtree(ramp_root, ignore_errors=True)
+
     # --- speculative decoding leg (ISSUE 13): same engine class with the
     # draft/verify scheduler on (k=3, n-gram self-drafting). Token-exactness
     # vs serial is tier-1's job (tests/test_speculative.py -m spec); the
@@ -1912,6 +2086,10 @@ def bench_serving(on_accel: bool, peak: float):
             "fleet_replicas": 2,
             "failovers": fleet_failovers,
             "replayed_requests": fleet_replayed,
+            "scaled_out": scaled_out,
+            "scaled_in": scaled_in,
+            "ramp_shed_rate": round(ramp_shed_rate, 4),
+            "baseline_shed_rate": round(baseline_shed_rate, 4),
             "trace_coverage": s["trace_coverage"],
             "fleet_trace_coverage": fleet_trace_cov,
             "fleet_agg_req_s": fleet_agg_req_s,
@@ -1935,6 +2113,9 @@ def bench_serving(on_accel: bool, peak: float):
                     "finished request keeps one trace_id end to end); "
                     "fleet_agg_req_s/ttft_p99_agg from the job rollup "
                     "(merged histograms, not averaged percentiles); "
+                    "scaled_out/scaled_in gated >=1 on the load-ramp leg "
+                    "with ramp_shed_rate below the fixed-capacity "
+                    "baseline and accepted tokens exactly-once; "
                     "spec_acceptance/effective_tokens_per_step gated "
                     ">0 / >1 on the speculative leg; int8 leg gated at "
                     "exactly half the bf16 pool bytes/page",
@@ -1961,6 +2142,7 @@ _COMPACT_KEYS = (
     "shed_rate", "overload_shed_rate", "deadline_miss_rate",
     "resume_replayed",
     "fleet_replicas", "failovers", "replayed_requests",
+    "scaled_out", "scaled_in", "ramp_shed_rate", "baseline_shed_rate",
     "spec_acceptance", "effective_tokens_per_step", "kv_dtype",
     "norm_ceiling_mfu",
 )

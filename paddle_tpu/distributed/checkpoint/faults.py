@@ -43,6 +43,19 @@ majority answer, matching real sticky-ALU behavior. Same scope / seed /
 grad through ``fire("sdc", f"grad_rank{rank}", data=grad)`` on the rank
 under test.
 
+The ``net`` op family covers the replicator's framed-TCP *client* path
+(:class:`~.replicator.SnapshotClient` — journal shipping, metrics push,
+fleet RPC): ``"net_connect"`` fires before a (re)connect,
+``"net_write"`` before a request frame is sent, ``"net_read"`` before a
+response is awaited; ``op="net"`` matches the whole family and
+``pattern`` globs the peer address (``"127.0.0.1:9999"``).  Modes
+``delay`` (slow link), ``error`` (refused/reset — note the client
+transparently reconnects ONCE per call, so ``times=2`` is the smallest
+spec that surfaces an ``OSError`` to the caller) and ``drop`` (the
+connection dies mid-exchange: raises ``ConnectionResetError``, which the
+same single-reconnect absorbs) let autoscale/drain chaos tests inject
+flaky depot links instead of only process kills.
+
 The ``serve`` op family covers the serving engine's hot path:
 ``"serve_prefill"`` / ``"serve_decode"`` fire before the compiled
 prefill/decode programs run (state untouched — the engine's step loop
@@ -77,10 +90,11 @@ from typing import List, Optional
 __all__ = ["FaultSpec", "InjectedIOError", "InjectedCrash", "inject",
            "scope", "fire", "active", "reset"]
 
-_MODES = ("error", "crash", "truncate", "delay", "sigterm", "bitflip")
+_MODES = ("error", "crash", "truncate", "delay", "sigterm", "bitflip",
+          "drop")
 _OPS = ("write", "read", "rename", "commit", "snap", "serve",
         "serve_prefill", "serve_decode", "serve_pool", "serve_journal",
-        "sdc", "any")
+        "sdc", "net", "net_connect", "net_read", "net_write", "any")
 
 
 class InjectedIOError(OSError):
@@ -123,6 +137,9 @@ class FaultSpec:
         if self.op == "serve":          # family spec: any serve_* step
             if not op.startswith("serve"):
                 return False
+        elif self.op == "net":          # family spec: any net_* step
+            if not op.startswith("net"):
+                return False
         elif self.op != "any" and op != self.op:
             return False
         return fnmatch.fnmatch(os.path.basename(path), self.pattern) or \
@@ -164,6 +181,13 @@ class FaultSpec:
             raise InjectedCrash(
                 f"{self.message}: crashed mid-write of {path} "
                 f"(truncated to {self.truncate_frac:.0%})")
+        if self.mode == "drop":
+            # a dropped connection, not a refused one: the peer (or a
+            # middlebox) killed the socket mid-exchange.  ConnectionError
+            # so transparent-reconnect paths treat it as they would a
+            # real RST
+            raise ConnectionResetError(
+                f"{self.message}: connection dropped at {op} {path}")
         if self.mode == "crash":
             raise InjectedCrash(f"{self.message}: crashed at {op} {path}")
         raise InjectedIOError(f"{self.message}: {op} {path} failed "

@@ -126,6 +126,26 @@ def _recv(sock: socket.socket) -> Tuple[dict, bytes]:
     return head, payload
 
 
+# chaos seam for the ``net`` fault family: resolved lazily and cached so
+# this module stays stdlib-only loadable standalone (no package context —
+# then the seam is simply inert)
+_FAULTS = None
+_FAULTS_TRIED = False
+
+
+def _fire_net(op: str, addr: str) -> None:
+    global _FAULTS, _FAULTS_TRIED
+    if not _FAULTS_TRIED:
+        _FAULTS_TRIED = True
+        try:
+            from . import faults as _mod
+            _FAULTS = _mod
+        except ImportError:
+            _FAULTS = None
+    if _FAULTS is not None:
+        _FAULTS.fire(op, addr)
+
+
 # -- the launcher-hosted daemon ----------------------------------------------
 
 class SnapshotStore(threading.Thread):
@@ -410,24 +430,29 @@ class SnapshotClient:
 
     def _conn(self) -> socket.socket:
         if self._sock is None:
+            _fire_net("net_connect", f"{self.host}:{self.port}")
             self._sock = socket.create_connection(
                 (self.host, self.port), timeout=self.timeout)
             self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return self._sock
 
+    def _exchange(self, head: dict, payload: bytes) -> Tuple[dict, bytes]:
+        addr = f"{self.host}:{self.port}"
+        sock = self._conn()
+        _fire_net("net_write", addr)
+        _send(sock, head, payload)
+        _fire_net("net_read", addr)
+        return _recv(sock)
+
     def _call(self, head: dict, payload: bytes = b"") -> Tuple[dict, bytes]:
         with self._lock:
             try:
-                sock = self._conn()
-                _send(sock, head, payload)
-                resp, out = _recv(sock)
+                resp, out = self._exchange(head, payload)
             except (OSError, ConnectionError):
                 # one transparent reconnect: every command here is
                 # idempotent (put overwrites the same (src,holder,gen) cell)
                 self.close()
-                sock = self._conn()
-                _send(sock, head, payload)
-                resp, out = _recv(sock)
+                resp, out = self._exchange(head, payload)
         if "error" in resp:
             raise OSError(f"snapshot store error: {resp['error']}")
         return resp, out

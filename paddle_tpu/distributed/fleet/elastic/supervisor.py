@@ -391,6 +391,10 @@ class ReplicaPool:
         self.exit_codes: dict = {}     # name -> [codes]
         self.given_up: set = set()
         self.done: set = set()         # exited 0 (asked to stop)
+        self.retiring: set = set()     # scale-in victims: ANY exit is
+        # intentional — never relaunched, zero restart budget burned,
+        # even when a SIGKILL lands mid-drain
+        self._template: Optional[tuple] = None
         self._stopping = False
 
     def add(self, name: str, argv: Sequence[str],
@@ -406,6 +410,83 @@ class ReplicaPool:
         for name in self._argv:
             if name not in self._procs:
                 self._spawn(name)
+
+    # -- elastic autoscaling surface ---------------------------------------
+    def set_template(self, argv: Sequence[str],
+                     env: Optional[dict] = None,
+                     log_dir: Optional[str] = None,
+                     name_prefix: str = "replica") -> None:
+        """Arm :meth:`scale_to` with the argv/env a scale-out replica
+        spawns with.  Fresh replicas get monotonically increasing
+        ``<name_prefix><idx>`` names — a name is never reused, so a new
+        replica can never be mistaken for (or inherit restart budget
+        from) a retired incarnation; its fencing epoch comes from
+        ``adopt_epoch`` at replica start as for any launch."""
+        self._template = (list(argv), dict(env or {}),
+                          None if log_dir is None else str(log_dir),
+                          str(name_prefix))
+
+    def _next_name(self) -> str:
+        prefix = self._template[3]
+        idx = 0
+        for name in self._argv:
+            if name.startswith(prefix):
+                try:
+                    idx = max(idx, int(name[len(prefix):]) + 1)
+                except ValueError:
+                    continue
+        return f"{prefix}{idx}"
+
+    def live_names(self) -> List[str]:
+        """Replicas this pool still owes traffic capacity for: added and
+        neither retired, done, nor given up (a crashed-but-relaunching
+        replica counts — its backoff is capacity in flight)."""
+        return sorted(n for n in self._argv
+                      if n not in self.done and n not in self.given_up
+                      and n not in self.retiring)
+
+    def note_retiring(self, name: str) -> None:
+        """Mark ``name`` as a scale-in victim BEFORE it is asked to drain:
+        from here on any exit — the clean exit 0 of a finished drain or a
+        SIGKILL landing mid-drain — retires it without burning restart
+        budget, and it is never relaunched (the fleet frontend's fence +
+        fold + replay failover owns whatever work the kill interrupted)."""
+        name = str(name)
+        self.retiring.add(name)
+        self._backoff_until.pop(name, None)
+        self._event("replica_retiring", replica=name)
+
+    def scale_to(self, n: int, victims: Sequence[str] = ()) -> dict:
+        """Grow or shrink toward ``n`` live replicas.  Growth spawns
+        fresh-named replicas from :meth:`set_template`; shrink only marks
+        caller-chosen ``victims`` as retiring (the caller owns the drain
+        protocol — this pool only guarantees their exits are intentional).
+        Returns ``{"spawned": [...], "retiring": [...], "live": [...]}``."""
+        n = max(0, int(n))
+        spawned: List[str] = []
+        retiring: List[str] = []
+        live = self.live_names()
+        while len(live) + len(spawned) < n:
+            if self._template is None:
+                raise RuntimeError("scale_to growth needs set_template()")
+            name = self._next_name()
+            argv, env, log_dir, _prefix = self._template
+            log_path = None if log_dir is None else \
+                os.path.join(log_dir, f"{name}.log")
+            self.add(name, argv, env=env, log_path=log_path)
+            self._spawn(name)
+            spawned.append(name)
+        excess = len(live) - n
+        for name in victims:
+            if excess <= 0:
+                break
+            name = str(name)
+            if name in live and name not in self.retiring:
+                self.note_retiring(name)
+                retiring.append(name)
+                excess -= 1
+        return {"spawned": spawned, "retiring": retiring,
+                "live": self.live_names()}
 
     def _spawn(self, name: str) -> None:
         env = dict(self.env) if self.env is not None else dict(os.environ)
@@ -438,9 +519,14 @@ class ReplicaPool:
             self.exit_codes[name].append(rc)
             if self._stopping:
                 continue
-            if rc == 0:
+            if rc == 0 or name in self.retiring:
+                # exit 0 = asked to stop; a RETIRING name is done whatever
+                # its exit code (SIGKILL mid-drain included): intentional
+                # stops are distinguishable from crashes and burn zero
+                # restart budget
                 self.done.add(name)
-                self._event("replica_done", replica=name)
+                self._event("replica_done", replica=name, exit_code=rc,
+                            retired=name in self.retiring)
             elif rc in self.restart_codes and \
                     self.restarts[name] < self.policy.max_restarts:
                 self.restarts[name] += 1

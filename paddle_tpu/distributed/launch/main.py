@@ -547,27 +547,56 @@ def _serve_pod(args, node_rank: int, fleet_store_addr: Optional[str],
     ``PADDLE_TPU_SERVE_REPLICA`` for its stable name) and is expected to
     call :func:`paddle_tpu.serving.fleet.run_replica`.  A SIGKILL'd or
     101-exiting replica relaunches alone with backoff and adopts a fresh
-    fencing epoch; exit 0 (frontend said stop) retires it."""
+    fencing epoch; exit 0 (frontend said stop) retires it.
+
+    With ``PADDLE_TPU_AS_ENABLE=1`` (and a fleet store to scan) the pod
+    also hosts the :class:`~paddle_tpu.serving.autoscaler.Autoscaler`
+    next to this loop: fleet occupancy / shed pressure grows the pool
+    through ``scale_to`` (fresh names, fresh fencing epochs, warm starts
+    through the shared AOT cache) and shrinks it through the lossless
+    retire → re-home → stop drain protocol — drained stops exit 0 and
+    burn no restart budget."""
     from ..fleet.elastic.supervisor import ReplicaPool, RestartPolicy
 
     pool = ReplicaPool(
         policy=RestartPolicy(max_restarts=args.max_replica_restarts),
         restart_codes=(101, -signal.SIGKILL, -signal.SIGTERM))
+    argv = [sys.executable, "-u", args.script, *args.script_args]
+    base_env = {
+        "PADDLE_JOB_ID": args.job_id,
+        **({"PADDLE_TPU_FLEET_STORE": fleet_store_addr}
+           if fleet_store_addr else {}),
+        **({"PADDLE_TPU_SNAP_STORE": snap.addr} if snap else {}),
+    }
     for local in range(args.nproc_per_node):
         name = f"replica{node_rank * args.nproc_per_node + local}"
-        env = {
-            "PADDLE_JOB_ID": args.job_id,
-            "PADDLE_LOCAL_RANK": str(local),
-            **({"PADDLE_TPU_FLEET_STORE": fleet_store_addr}
-               if fleet_store_addr else {}),
-            **({"PADDLE_TPU_SNAP_STORE": snap.addr} if snap else {}),
-        }
-        pool.add(name,
-                 [sys.executable, "-u", args.script, *args.script_args],
-                 env=env,
+        pool.add(name, argv,
+                 env={**base_env, "PADDLE_LOCAL_RANK": str(local)},
                  log_path=os.path.join(args.log_dir, f"{name}.log"))
+    # scale-outs reuse the same child contract; their names continue the
+    # pod's replica index sequence so they can never collide with (or
+    # inherit budget from) an existing or retired replica
+    pool.set_template(argv, env={**base_env, "PADDLE_LOCAL_RANK": "0"},
+                      log_dir=args.log_dir, name_prefix="replica")
+    scaler = None
+    if os.environ.get("PADDLE_TPU_AS_ENABLE", "0") == "1" \
+            and fleet_store_addr and node_rank == 0:
+        try:
+            from ...serving.autoscaler import Autoscaler
+            from ..checkpoint.replicator import SnapshotClient
+            from ..store import TCPStore
+
+            h, p = fleet_store_addr.rsplit(":", 1)
+            as_store = TCPStore(h, int(p), is_master=False)
+            as_depot = SnapshotClient.from_address(snap.addr) \
+                if snap is not None and getattr(snap, "addr", None) else None
+            scaler = Autoscaler(as_store, as_depot, pool=pool)
+            scaler.start()
+        except Exception:
+            scaler = None   # autoscaling is additive: never block serving
     _record_event("serve_pod_start", replicas=args.nproc_per_node,
-                  node_rank=node_rank)
+                  node_rank=node_rank,
+                  autoscale=scaler is not None)
     rc = 0
     try:
         pool.start()
@@ -579,9 +608,14 @@ def _serve_pod(args, node_rank: int, fleet_store_addr: Optional[str],
     except KeyboardInterrupt:
         rc = 130
     finally:
+        if scaler is not None:
+            scaler.stop()
         pool.stop()
         _record_event("serve_pod_done", given_up=sorted(pool.given_up),
-                      restarts=dict(pool.restarts), rc=rc)
+                      restarts=dict(pool.restarts),
+                      scale_outs=0 if scaler is None else scaler.scale_outs,
+                      scale_ins=0 if scaler is None else scaler.scale_ins,
+                      rc=rc)
     return rc
 
 

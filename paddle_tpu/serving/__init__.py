@@ -45,8 +45,10 @@ from .journal import JournalState, ServingJournal, TokenSink  # noqa: F401
 from .engine import Request, ServingEngine, check_decode_donation  # noqa: F401
 from .router import ReplicaStatus, Router  # noqa: F401
 from .fleet import (EngineReplica, LocalKV, RemoteReplica,  # noqa: F401
-                    ReplicaServer, ServingFrontend, TokenCollector,
-                    fold_depot_journal, run_replica)
+                    ReplicaFlags, ReplicaServer, ServingFrontend,
+                    TokenCollector, fold_depot_journal, run_replica)
+from .autoscaler import (Autoscaler, AutoscalePolicy,  # noqa: F401
+                         FleetSignals)
 
 __all__ = [
     "PagedKVPool", "PoolExhausted", "TRASH_PAGE", "default_page_tokens",
@@ -57,7 +59,8 @@ __all__ = [
     "JournalState", "ServingJournal", "TokenSink",
     "Request", "ServingEngine", "check_decode_donation",
     "ReplicaStatus", "Router",
-    "EngineReplica", "LocalKV", "RemoteReplica", "ReplicaServer",
-    "ServingFrontend", "TokenCollector", "fold_depot_journal",
-    "run_replica",
+    "EngineReplica", "LocalKV", "RemoteReplica", "ReplicaFlags",
+    "ReplicaServer", "ServingFrontend", "TokenCollector",
+    "fold_depot_journal", "run_replica",
+    "Autoscaler", "AutoscalePolicy", "FleetSignals",
 ]

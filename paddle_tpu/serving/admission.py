@@ -45,7 +45,26 @@ from ..distributed.checkpoint.replicator import env_int as _env_int
 from ..distributed.fleet.fault_domain import _env_float
 from ..telemetry import record_event
 
-__all__ = ["Overloaded", "Deadline", "CircuitBreaker", "AdmissionController"]
+__all__ = ["Overloaded", "Deadline", "CircuitBreaker", "AdmissionController",
+           "warming_retry_hint"]
+
+
+def warming_retry_hint(retry_after_s: Optional[float], warming: int,
+                       eta_s: Optional[float] = None) -> Optional[float]:
+    """Cap an :class:`Overloaded` retry hint by capacity that is already
+    warming up: with ``warming`` scale-out replicas in flight, a client
+    should retry when the new replica starts taking traffic
+    (``PADDLE_TPU_AS_WARMUP_ETA_S``, default 5s — roughly AOT-cache
+    checkpoint-load time, not a compile), not after the CURRENT fleet's
+    drain-rate-only estimate.  With nothing warming the hint passes
+    through unchanged."""
+    if warming <= 0:
+        return retry_after_s
+    if eta_s is None:
+        eta_s = _env_float("PADDLE_TPU_AS_WARMUP_ETA_S", 5.0)
+    if retry_after_s is None:
+        return round(float(eta_s), 3)
+    return round(min(float(retry_after_s), float(eta_s)), 3)
 
 
 class Overloaded(RuntimeError):

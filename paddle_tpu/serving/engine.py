@@ -89,6 +89,15 @@ __all__ = ["Request", "ServingEngine", "check_decode_donation"]
 
 QUEUED, RUNNING, FINISHED, SHED = "queued", "running", "finished", "shed"
 
+# Tracing a program swaps tracers into the model's param Tensors
+# (``_StateSwap`` in ``_forward``), so two engines sharing one model object
+# — an in-process fleet scaling out while the incumbent serves — must never
+# overlap a trace with a ``_param_arrays`` read: the reader would capture a
+# tracer and feed it to its already-compiled executable.  One process-wide
+# lock serialises swap-reads against trace/compile; compiled calls take
+# materialised arrays and run outside it.
+_SWAP_LOCK = threading.Lock()
+
 
 class Request:
     """One generation request riding the engine."""
@@ -297,6 +306,10 @@ class ServingEngine:
         self.last_decode_logits = None   # host copy of the latest verify
         # logits [R, S, V] — the int8-vs-bf16 tolerance harness reads it
         self.steps_total = 0
+        self.first_step_wall: Optional[float] = None   # WARMING until set:
+        # a replica advertises warming=True on its lease until its first
+        # completed work step, so the fleet router never spills a
+        # deadline-bound request onto a cold (uncompiled/unloaded) engine
         self._pending_delivery: List[tuple] = []       # (rid, idx, token)
         self._work = threading.Event()
         self._stop_flag = False
@@ -524,6 +537,8 @@ class ServingEngine:
         if did_work:
             self._step_failures = 0
             self.admission.breaker.note_success()
+            if self.first_step_wall is None:
+                self.first_step_wall = time.time()
 
     def _undelivered(self) -> bool:
         """Tokens or journal records still awaiting a successful flush."""
@@ -1122,8 +1137,9 @@ class ServingEngine:
         return jnp.take(logits[0], take_idx, axis=0), arenas
 
     def _param_arrays(self):
-        return ([p._value for p in self._params],
-                [b._value for b in self._buffers])
+        with _SWAP_LOCK:
+            return ([p._value for p in self._params],
+                    [b._value for b in self._buffers])
 
     def _run_decode(self, tokens, positions, tables, n_tok):
         import jax
@@ -1133,7 +1149,8 @@ class ServingEngine:
         if self._decode_exec is None:
             self._decode_compiles += 1
             jitted = jax.jit(self._decode_fn, donate_argnums=(2,))
-            self._decode_exec = jitted.lower(*args).compile()
+            with _SWAP_LOCK:
+                self._decode_exec = jitted.lower(*args).compile()
             if self._lint:
                 self.lint_report = check_decode_donation(
                     self._decode_exec, self._arena_bytes,
@@ -1149,6 +1166,7 @@ class ServingEngine:
                 take_idx)
         if self._prefill_exec is None:
             jitted = jax.jit(self._prefill_fn, donate_argnums=(2,))
-            self._prefill_exec = jitted.lower(*args).compile()
+            with _SWAP_LOCK:
+                self._prefill_exec = jitted.lower(*args).compile()
         logits, self._arenas = self._prefill_exec(*args)
         return logits

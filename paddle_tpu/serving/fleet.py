@@ -71,7 +71,7 @@ from ..distributed.fleet.fault_domain import (HeartbeatLease, _adapt_kv,
 from ..telemetry import record_event as _event
 from ..telemetry import tracing
 from ..telemetry.aggregator import start_metrics_pusher
-from .admission import Deadline, Overloaded
+from .admission import Deadline, Overloaded, warming_retry_hint
 from .engine import ServingEngine
 from .journal import JournalState, ServingJournal
 from .metrics import FleetMeter
@@ -79,8 +79,8 @@ from .router import ReplicaStatus, Router
 
 __all__ = [
     "FLEET_HB_PREFIX", "LocalKV", "JournalShipper", "fold_depot_journal",
-    "adopt_epoch", "EngineReplica", "ReplicaServer", "RemoteReplica",
-    "TokenCollector", "ServingFrontend", "run_replica",
+    "adopt_epoch", "EngineReplica", "ReplicaFlags", "ReplicaServer",
+    "RemoteReplica", "TokenCollector", "ServingFrontend", "run_replica",
 ]
 
 FLEET_HB_PREFIX = "serve/hb/"
@@ -318,24 +318,43 @@ def _engine_status(engine: ServingEngine) -> dict:
             "summary": engine.meter.summary()}
 
 
+class ReplicaFlags:
+    """Replica-local lifecycle flags shared between the command server
+    (which flips them: ``retire`` sets :attr:`draining`) and the status
+    loop (which publishes them onto the lease) — the lease payload is how
+    EVERY frontend learns to route-exclude a draining replica, not just
+    the one that asked for the drain."""
+
+    def __init__(self):
+        self.draining = False
+
+
 class _StatusLoop(threading.Thread):
-    """Republish live load onto the replica's lease payload every
-    ``PADDLE_TPU_SERVE_FLEET_STATUS`` seconds — the router reads these
-    numbers, so staleness here is routing error, not correctness error."""
+    """Republish live load + lifecycle state onto the replica's lease
+    payload every ``PADDLE_TPU_SERVE_FLEET_STATUS`` seconds — the router
+    reads these numbers, so staleness here is routing error, not
+    correctness error.  ``warming`` flips false on the engine's first
+    completed step; ``draining`` mirrors :class:`ReplicaFlags`."""
 
     def __init__(self, lease: HeartbeatLease, engine: ServingEngine,
-                 interval: float):
+                 interval: float, flags: Optional[ReplicaFlags] = None):
         super().__init__(daemon=True, name="paddle-tpu-serve-status")
         self._lease, self._engine = lease, engine
         self._interval = interval
+        self._flags = flags
         self._stop = threading.Event()
+
+    def publish_once(self) -> None:
+        st = _engine_status(self._engine)
+        self._lease.update_payload(
+            queue_depth=st["queue_depth"], active=st["active"],
+            est_first_token_s=st["est_first_token_s"],
+            warming=self._engine.first_step_wall is None,
+            draining=bool(self._flags.draining) if self._flags else False)
 
     def run(self) -> None:
         while not self._stop.wait(self._interval):
-            st = _engine_status(self._engine)
-            self._lease.update_payload(
-                queue_depth=st["queue_depth"], active=st["active"],
-                est_first_token_s=st["est_first_token_s"])
+            self.publish_once()
 
     def stop(self) -> None:
         self._stop.set()
@@ -361,13 +380,16 @@ class EngineReplica:
             journal_ship=JournalShipper(depot, self.name, self.epoch),
             on_token=on_token, **(engine_kw or {}))
         self._start_lease = start_lease
+        self.flags = ReplicaFlags()
         self.lease = HeartbeatLease(
             store, FLEET_HB_PREFIX + self.name, ttl=self.ttl,
             payload={"name": self.name, "address": "inproc",
                      "capacity": self.engine.admission.max_queue,
-                     "epoch": self.epoch, "pid": os.getpid()})
+                     "epoch": self.epoch, "pid": os.getpid(),
+                     "warming": True, "draining": False})
         self._status = _StatusLoop(self.lease, self.engine,
-                                   _status_interval(self.ttl))
+                                   _status_interval(self.ttl),
+                                   flags=self.flags)
         self._thread: Optional[threading.Thread] = None
         self.outputs: Dict[int, Any] = {}
         self.error: Optional[BaseException] = None
@@ -423,6 +445,17 @@ class EngineReplica:
     def drain(self) -> List[dict]:
         return self.engine.handback_queued()
 
+    def retire(self) -> List[dict]:
+        """Autoscale scale-in hook: mark DRAINING on the lease (the next
+        status beat publishes it fleet-wide) and hand back queued work."""
+        self.flags.draining = True
+        if self._start_lease:
+            self._status.publish_once()
+        return self.engine.handback_queued()
+
+    def unretire(self) -> None:
+        self.flags.draining = False
+
     def close(self) -> None:
         pass
 
@@ -434,9 +467,13 @@ class ReplicaServer(_FramedServer):
     tell an ``Overloaded`` spill from a broken replica."""
 
     def __init__(self, engine: ServingEngine, name: str,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 flags: Optional[ReplicaFlags] = None,
+                 on_retire: Optional[Callable[[], None]] = None):
         self.engine = engine
         self.replica_name = name
+        self.flags = flags if flags is not None else ReplicaFlags()
+        self._on_retire = on_retire
         super().__init__(f"paddle-tpu-replica-{name}", host, port)
 
     def _cmd_submit(self, head, payload):
@@ -458,10 +495,29 @@ class ReplicaServer(_FramedServer):
         return {"ok": True, "rid": rid}, b""
 
     def _cmd_status(self, head, payload):
-        return dict(_engine_status(self.engine), ok=True), b""
+        return dict(_engine_status(self.engine), ok=True,
+                    warming=self.engine.first_step_wall is None,
+                    draining=bool(self.flags.draining)), b""
 
     def _cmd_drain(self, head, payload):
         return {"ok": True, "handback": self.engine.handback_queued()}, b""
+
+    def _cmd_retire(self, head, payload):
+        # scale-in step 1: flip DRAINING (published fleet-wide on the next
+        # status beat, so every frontend route-excludes us) and hand back
+        # queued-but-unstarted work for the caller to re-home.  The
+        # replica keeps serving its ACTIVE requests until ``stop``.
+        self.flags.draining = True
+        if self._on_retire is not None:
+            self._on_retire()
+        return {"ok": True, "name": self.replica_name,
+                "handback": self.engine.handback_queued()}, b""
+
+    def _cmd_unretire(self, head, payload):
+        # aborted scale-in (the handed-back work found no other home):
+        # the replica goes back to taking traffic
+        self.flags.draining = False
+        return {"ok": True}, b""
 
     def _cmd_stop(self, head, payload):
         self.engine.stop()
@@ -514,6 +570,13 @@ class RemoteReplica:
     def drain(self) -> List[dict]:
         resp, _ = self._client._call({"cmd": "drain"})
         return list(resp.get("handback", []))
+
+    def retire(self) -> List[dict]:
+        resp, _ = self._client._call({"cmd": "retire"})
+        return list(resp.get("handback", []))
+
+    def unretire(self) -> None:
+        self._client._call({"cmd": "unretire"})
 
     def stop_replica(self) -> None:
         self._client._call({"cmd": "stop"})
@@ -569,14 +632,20 @@ def run_replica(model, name: Optional[str] = None, *,
     engine = ServingEngine(model, journal=jroot,
                            journal_ship=JournalShipper(depot, name, epoch),
                            on_token=pusher, **(engine_kw or {}))
-    server = ReplicaServer(engine, name, host=host)
+    flags = ReplicaFlags()
+    server = ReplicaServer(engine, name, host=host, flags=flags)
     t = fleet_ttl(ttl)
     lease = HeartbeatLease(
         store, FLEET_HB_PREFIX + name, ttl=t,
         payload={"name": name, "address": server.address,
                  "capacity": engine.admission.max_queue,
-                 "epoch": epoch, "pid": os.getpid()})
-    status = _StatusLoop(lease, engine, _status_interval(t))
+                 "epoch": epoch, "pid": os.getpid(),
+                 "warming": True, "draining": False})
+    status = _StatusLoop(lease, engine, _status_interval(t), flags=flags)
+    # a retire must hit the lease NOW, not a status beat later: the
+    # faster every frontend sees DRAINING, the smaller the window in
+    # which new work lands on a replica that is about to stop
+    server._on_retire = status.publish_once
     lease.start()
     status.start()
     # push StepMeter/SLOMeter snapshots to the launcher's depot and spill
@@ -774,8 +843,16 @@ class ServingFrontend:
             _event("serve_route", st.name, rid=int(rid), trace=trace_id,
                    replay=delivered is not None)
             return st.name
-        raise last if last is not None else \
+        err = last if last is not None else \
             Overloaded("all replicas refused", reason="queue_full")
+        # capacity already warming up (a scale-out in flight) caps the
+        # retry hint: clients should retry into the new replica, not wait
+        # out the current fleet's drain-rate-only estimate
+        warming = sum(1 for st in order if st.warming)
+        if warming:
+            err.retry_after_s = warming_retry_hint(err.retry_after_s,
+                                                   warming)
+        raise err
 
     # -- death detection / failover ----------------------------------------
     def scan_once(self) -> List[str]:

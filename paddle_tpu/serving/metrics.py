@@ -110,6 +110,7 @@ class SLOMeter:
         self.finished_total = 0
         self.evictions_total = 0
         self.shed_total = 0
+        self.shed_reasons: Dict[str, int] = {}
         self.rejected_total = 0
         self.deadline_misses_total = 0
         # speculative decoding + quantized-KV gauges (ISSUE 13)
@@ -232,6 +233,10 @@ class SLOMeter:
         journaled shed): it will never run — fold its clock away."""
         c = self._clocks.pop(rid, None)
         self.shed_total += 1
+        # by-reason split: the autoscaler's overload-pressure signal must
+        # exclude "drained" (its OWN scale-in hand-backs), or every
+        # scale-in would read as overload and oscillate straight back out
+        self.shed_reasons[reason] = self.shed_reasons.get(reason, 0) + 1
         record_event("serve_shed", str(rid), reason=reason,
                      trace=None if c is None else c.trace_id,
                      queued_s=(None if c is None else
@@ -381,6 +386,7 @@ class SLOMeter:
             else 1.0,
             "requests_finished": n,
             "requests_shed": self.shed_total,
+            "shed_reasons": dict(self.shed_reasons),
             "requests_rejected": self.rejected_total,
             "requests_per_sec": round(n / span, 3) if span else None,
             "ttft_ms_p50": _r(_pct(ttft, 50)),
@@ -418,6 +424,12 @@ class FleetMeter:
         self.replayed_requests_total = 0
         self.handbacks_total = 0
         self.live_replicas = 0
+        self.scale_out_total = 0
+        self.scale_in_total = 0
+        self.serving_replicas = 0
+        self.warming_replicas = 0
+        self.draining_replicas = 0
+        self.last_autoscale: Optional[Dict[str, object]] = None
 
     def set_live_replicas(self, n: int) -> None:
         self.live_replicas = int(n)
@@ -425,6 +437,34 @@ class FleetMeter:
 
     def set_replica_queue_depth(self, name: str, depth: int) -> None:
         set_gauge(f"serving.fleet_queue_depth.{name}", float(depth))
+
+    def set_fleet_states(self, serving: int, warming: int,
+                         draining: int) -> None:
+        """Per-state replica gauges (SERVING / WARMING / DRAINING), as the
+        autoscaler's lease scan counts them."""
+        self.serving_replicas = int(serving)
+        self.warming_replicas = int(warming)
+        self.draining_replicas = int(draining)
+        set_gauge("serving.fleet_serving_replicas", float(serving))
+        set_gauge("serving.fleet_warming_replicas", float(warming))
+        set_gauge("serving.fleet_draining_replicas", float(draining))
+
+    def autoscale(self, direction: str, *, target: int,
+                  reason: str) -> None:
+        """One autoscale decision acted on (``direction`` is ``out`` or
+        ``in``); stamps the flight recorder so the merged black box shows
+        WHY capacity moved."""
+        if direction == "out":
+            self.scale_out_total += 1
+            bump("serving.fleet_scale_out_total")
+        else:
+            self.scale_in_total += 1
+            bump("serving.fleet_scale_in_total")
+        self.last_autoscale = {"direction": str(direction),
+                               "target": int(target),
+                               "reason": str(reason)}
+        record_event("autoscale_decision", str(direction),
+                     target=int(target), reason=str(reason))
 
     def failover(self, name: str, replayed: int = 0) -> None:
         self.failovers_total += 1
@@ -445,4 +485,10 @@ class FleetMeter:
         return {"live_replicas": self.live_replicas,
                 "failovers": self.failovers_total,
                 "replayed_requests": self.replayed_requests_total,
-                "handbacks": self.handbacks_total}
+                "handbacks": self.handbacks_total,
+                "scale_out": self.scale_out_total,
+                "scale_in": self.scale_in_total,
+                "serving_replicas": self.serving_replicas,
+                "warming_replicas": self.warming_replicas,
+                "draining_replicas": self.draining_replicas,
+                "last_autoscale": self.last_autoscale}

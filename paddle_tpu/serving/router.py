@@ -17,6 +17,12 @@ deadline-aware spill:
   is accounted, shedding here silently would not be.
 - **draining replicas** are never picked (see
   :meth:`fleet.ServingFrontend.drain`).
+- **warming replicas** (scale-outs that have not completed a first
+  step — their ``est_first_token_s`` is unmeasured and includes a cold
+  checkpoint load) are excluded from deadline-bound spill the same way
+  an over-budget estimate is, but stay routable for traffic without a
+  TTFT budget; when EVERY routable replica is warming the pick falls
+  back rather than refusing (same rationale as the all-spilled case).
 """
 
 from __future__ import annotations
@@ -42,6 +48,7 @@ class ReplicaStatus:
     est_first_token_s: Optional[float] = None
     epoch: int = 0                   # fencing incarnation
     draining: bool = False
+    warming: bool = False            # no completed step yet (cold start)
     extra: dict = field(default_factory=dict)
 
     @property
@@ -57,7 +64,8 @@ class ReplicaStatus:
                    active=int(doc.get("active", 0)),
                    est_first_token_s=doc.get("est_first_token_s"),
                    epoch=int(doc.get("epoch", 0)),
-                   draining=bool(doc.get("draining", False)))
+                   draining=bool(doc.get("draining", False)),
+                   warming=bool(doc.get("warming", False)))
 
 
 class Router:
@@ -80,9 +88,13 @@ class Router:
             budget = deadline.ttft_s - age_s
         spilled = False
         if budget is not None:
+            # a WARMING replica's first token costs an unmeasured cold
+            # start on top of any estimate: deadline-bound traffic never
+            # spills onto it while a warmed replica exists
             fits = [r for r in cands
-                    if r.est_first_token_s is None
-                    or r.est_first_token_s <= budget]
+                    if not r.warming
+                    and (r.est_first_token_s is None
+                         or r.est_first_token_s <= budget)]
             if fits:
                 spilled = len(fits) < len(cands)
                 cands = fits   # spill toward replicas that can make TTFT

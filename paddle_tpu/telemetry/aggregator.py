@@ -334,6 +334,9 @@ def rollup(snapshots: Dict[str, Dict[str, Any]],
       the LeaseMonitor's ``fleet_straggler`` scan agrees (cross-check, so
       a skew blip and a wedged rank are distinguishable).
     - ``mfu_min/max/spread`` over pushing ranks.
+    - ``autoscale``: the newest autoscaler self-report (replica states,
+      occupancy, last decision) — latest ``wall_time`` wins, so a stale
+      doc from a dead controller never shadows the live one.
     """
     out: Dict[str, Any] = {"wall_time": time.time(),
                            "sources": sorted(snapshots),
@@ -343,7 +346,13 @@ def rollup(snapshots: Dict[str, Dict[str, Any]],
     merged: Dict[str, Optional[Histogram]] = {k: None for k in _HIST_KINDS}
     step_dt: Dict[str, float] = {}
     mfu: Dict[str, float] = {}
+    autoscale_wall = float("-inf")
     for src, doc in sorted(snapshots.items()):
+        if doc.get("autoscale"):
+            wall = float(doc.get("wall_time") or 0.0)
+            if wall >= autoscale_wall:
+                autoscale_wall = wall
+                out["autoscale"] = dict(doc["autoscale"])
         slo = doc.get("slo") or {}
         if slo:
             out["replicas"].append(src)

@@ -50,6 +50,26 @@ def dashboard_text(snapshots: Dict[str, Dict[str, Any]],
             f"ttft={_fmt(agg.get('ttft_p99_agg_ms'))}ms "
             f"tpot={_fmt(agg.get('tpot_p99_agg_ms'))}ms "
             f"latency={_fmt(agg.get('latency_p99_agg_ms'))}ms")
+    auto = agg.get("autoscale")
+    if auto:
+        serving = int(auto.get("serving") or 0)
+        warming = int(auto.get("warming") or 0)
+        draining = int(auto.get("draining") or 0)
+        lines.append(
+            f"autoscale: replicas={serving + warming} "
+            f"(SERVING={serving} WARMING={warming} DRAINING={draining}) "
+            f"occupancy={_fmt(auto.get('occupancy'))} "
+            f"out={auto.get('scale_out_total', 0)} "
+            f"in={auto.get('scale_in_total', 0)}")
+        last = auto.get("last_decision")
+        if last:
+            lines.append(
+                f"  last decision: {last.get('direction')} -> "
+                f"target={last.get('target')} ({last.get('reason')})")
+        states = auto.get("states") or {}
+        if states:
+            lines.append("  states: " + " ".join(
+                f"{n}={s}" for n, s in sorted(states.items())))
     if agg["ranks"]:
         straggler = agg.get("straggler")
         conf = agg.get("straggler_confirmed")
@@ -97,6 +117,13 @@ def _smoke_snapshots() -> Dict[str, Dict[str, Any]]:
                          "ttft_ms_p99": 4.0 + i, "latency_ms_p99": 40.0},
             hists={"ttft_s": h},
             extra={"replica": name}))
+    depot.metrics_push("autoscaler", local_snapshot(extra={
+        "autoscale": {"serving": 1, "warming": 1, "draining": 0,
+                      "occupancy": 0.62, "queue_depth": 5,
+                      "scale_out_total": 1, "scale_in_total": 0,
+                      "last_decision": {"direction": "out", "target": 2,
+                                        "reason": "occupancy_high"},
+                      "states": {"r0": "SERVING", "r1": "WARMING"}}}))
     depot.metrics_push("rank0", local_snapshot(
         step_summary={"steps": 8, "total_s": 4.0, "mfu": 0.41},
         extra={"rank": 0}))

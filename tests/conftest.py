@@ -87,7 +87,14 @@ for _k, _v in (("PADDLE_TPU_SP", "1"),
                # observability plane: the production 10s metrics push
                # cadence would leave the trace chaos e2e waiting on the
                # victim's first black-box spill — push every 0.2s
-               ("PADDLE_TPU_METRICS_PUSH_S", "0.2")):
+               ("PADDLE_TPU_METRICS_PUSH_S", "0.2"),
+               # elastic autoscaling: the production 30s cooldown and 5s
+               # control-loop cadence would leave the load-ramp chaos e2e
+               # idle on a clock — decide every 0.1s, cool down 0.3s, and
+               # assume cold replicas warm within ~0.5s on the CPU lane
+               ("PADDLE_TPU_AS_COOLDOWN_S", "0.3"),
+               ("PADDLE_TPU_AS_INTERVAL_S", "0.1"),
+               ("PADDLE_TPU_AS_WARMUP_ETA_S", "0.5")):
     os.environ.setdefault(_k, _v)
 
 import jax  # noqa: E402

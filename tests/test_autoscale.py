@@ -1,0 +1,805 @@
+"""Elastic fleet autoscaling (ISSUE 17): hysteresis/cooldown policy,
+signal scan, ReplicaPool scale_to + retiring contract, churn-proof
+routing (WARMING / DRAINING), net-fault injection on the depot client,
+warming-aware retry hints, the report CLI autoscale rows, and the
+load-ramp chaos e2e with a SIGKILL landing mid-drain.
+
+Tier-1 ``autoscale``/``serving`` lanes; conftest pins
+``PADDLE_TPU_AS_*`` (cooldown 0.3s, tick 0.1s, warm-up ETA 0.5s) plus
+the ``PADDLE_TPU_SERVE_FLEET_*`` cadences so scale decisions and lease
+churn resolve in ~1-2s on CPU.
+"""
+
+import os
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.checkpoint import faults
+from paddle_tpu.distributed.checkpoint.replicator import (SnapshotClient,
+                                                          SnapshotStore)
+from paddle_tpu.distributed.fleet.elastic.supervisor import (ReplicaPool,
+                                                             RestartPolicy)
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.serving import Deadline, Overloaded, TokenSink
+from paddle_tpu.serving.admission import warming_retry_hint
+from paddle_tpu.serving.autoscaler import (Autoscaler, AutoscalePolicy,
+                                           FleetSignals)
+from paddle_tpu.serving.fleet import (FLEET_HB_PREFIX, LocalKV,
+                                      RemoteReplica, ServingFrontend,
+                                      TokenCollector)
+from paddle_tpu.serving.metrics import FleetMeter, SLOMeter
+from paddle_tpu.serving.router import ReplicaStatus, Router
+from paddle_tpu.telemetry.aggregator import MemoryDepot, rollup
+
+pytestmark = [pytest.mark.autoscale, pytest.mark.serving]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(3)
+    cfg = llama_tiny(num_hidden_layers=2, vocab_size=96,
+                     max_position_embeddings=128)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture
+def depot():
+    store = SnapshotStore(host="127.0.0.1")
+    client = SnapshotClient("127.0.0.1", store.port)
+    yield client
+    client.close()
+    store.close()
+
+
+def _solo(model, prompt, max_new, eos=None):
+    ids, _ = model.generate(paddle.to_tensor(np.asarray(prompt)[None]),
+                            max_new_tokens=max_new, eos_token_id=eos,
+                            pad_token_id=0 if eos is not None else None)
+    return ids.numpy()[0]
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += float(dt)
+
+
+class FakePool:
+    """ReplicaPool duck-type for control-loop units: records scale calls
+    and mimics fresh-name growth."""
+
+    def __init__(self, live=()):
+        self.live = list(live)
+        self.calls = []
+        self.retired = []
+
+    def live_names(self):
+        return sorted(self.live)
+
+    def note_retiring(self, name):
+        self.retired.append(name)
+        self.live.remove(name)
+
+    def scale_to(self, n, victims=()):
+        self.calls.append((int(n), tuple(victims)))
+        spawned = []
+        i = 0
+        while len(self.live) < n:
+            name = f"replica{i}"
+            i += 1
+            if name in self.live:
+                continue
+            self.live.append(name)
+            spawned.append(name)
+        retiring = []
+        for v in victims:
+            if v in self.live and len(self.live) > n:
+                self.note_retiring(v)
+                retiring.append(v)
+        return {"spawned": spawned, "retiring": retiring,
+                "live": self.live_names()}
+
+
+class FakeReplica:
+    def __init__(self, name, fail=None):
+        self.name = name
+        self.fail = fail
+        self.submits = []
+
+    def submit(self, prompt, max_new_tokens=64, eos_token_id=None, *,
+               deadline=None, rid=None, delivered_tokens=None, age_s=0.0,
+               trace_id=None):
+        if self.fail == "overloaded":
+            raise Overloaded("fake queue full", reason="queue_full")
+        self.submits.append({"rid": rid, "prompt": list(prompt)})
+        return rid
+
+    def status(self):
+        return {"queue_depth": 0, "active": 0, "finished": [], "shed": {}}
+
+    def drain(self):
+        return []
+
+    def close(self):
+        pass
+
+
+def _lease(kv, name, *, qd=0, active=0, cap=4, warming=False,
+           draining=False, epoch=1, address="inproc", ttl=30.0):
+    kv.put(FLEET_HB_PREFIX + name,
+           {"name": name, "address": address, "capacity": cap,
+            "queue_depth": qd, "active": active, "est_first_token_s": 0.05,
+            "epoch": epoch, "ttl": ttl, "warming": warming,
+            "draining": draining})
+
+
+# ---------------------------------------------------------------------------
+class TestAutoscalePolicy:
+    def test_ctor_validation(self):
+        with pytest.raises(ValueError):
+            AutoscalePolicy(min_replicas=0)
+        with pytest.raises(ValueError):
+            AutoscalePolicy(min_replicas=3, max_replicas=2)
+        with pytest.raises(ValueError):
+            AutoscalePolicy(up_thresh=0.3, down_thresh=0.3)
+
+    def _sig(self, **kw):
+        d = dict(serving=1, warming=0, draining=0, queue_depth=0,
+                 active=0, capacity=4)
+        d.update(kw)
+        return FleetSignals(**d)
+
+    def test_occupancy_high_scales_out(self):
+        p = AutoscalePolicy()
+        sig = self._sig(queue_depth=3, active=1)     # occupancy 1.0
+        assert p.decide(sig) == ("out", "occupancy_high")
+
+    def test_hysteresis_band_is_steady(self):
+        p = AutoscalePolicy(min_replicas=1, max_replicas=4)
+        sig = self._sig(serving=2, capacity=8, queue_depth=2, active=2)
+        assert 0.25 < sig.occupancy < 0.8
+        assert p.decide(sig) == (None, "steady")
+
+    def test_occupancy_low_scales_in(self):
+        p = AutoscalePolicy()
+        sig = self._sig(serving=2, capacity=8, active=1)  # occupancy 0.125
+        assert p.decide(sig) == ("in", "occupancy_low")
+
+    def test_pressure_forces_out_and_vetoes_in(self):
+        p = AutoscalePolicy()
+        sig = self._sig(serving=2, capacity=8, active=1)
+        assert p.decide(sig, pressure=True) == ("out", "overload_shed")
+        # at max the pressure cannot scale out, but still vetoes the
+        # scale-in the low occupancy would otherwise allow
+        p2 = AutoscalePolicy(max_replicas=2)
+        assert p2.decide(sig, pressure=True) == (None, "steady")
+
+    def test_no_scale_in_while_warming_or_draining(self):
+        p = AutoscalePolicy()
+        low = dict(capacity=8, active=1)
+        assert p.decide(self._sig(serving=2, warming=1, **low)) \
+            == (None, "steady")
+        assert p.decide(self._sig(serving=2, draining=1, **low)) \
+            == (None, "steady")
+
+    def test_min_max_clamps(self):
+        p = AutoscalePolicy(min_replicas=1, max_replicas=2)
+        # at max: overload cannot grow further
+        sig = self._sig(serving=2, capacity=8, queue_depth=8)
+        assert p.decide(sig) == (None, "steady")
+        # at min: idleness cannot shrink further
+        assert p.decide(self._sig(serving=1)) == (None, "steady")
+
+    def test_below_min_scales_out_but_zero_live_does_not(self):
+        p = AutoscalePolicy(min_replicas=2, max_replicas=4)
+        assert p.decide(self._sig(serving=1)) == ("out", "below_min")
+        # live == 0 is an intentional stop (or all-crashed, which the
+        # pool's restart budget owns) — never respawn the fleet
+        assert p.decide(self._sig(serving=0)) == (None, "steady")
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_AS_MIN", "2")
+        monkeypatch.setenv("PADDLE_TPU_AS_MAX", "6")
+        monkeypatch.setenv("PADDLE_TPU_AS_UP_THRESH", "0.9")
+        monkeypatch.setenv("PADDLE_TPU_AS_DOWN_THRESH", "0.1")
+        monkeypatch.setenv("PADDLE_TPU_AS_COOLDOWN_S", "7.5")
+        p = AutoscalePolicy.from_env()
+        assert (p.min_replicas, p.max_replicas) == (2, 6)
+        assert (p.up_thresh, p.down_thresh) == (0.9, 0.1)
+        assert p.cooldown_s == 7.5
+
+
+# ---------------------------------------------------------------------------
+class TestAutoscalerLoop:
+    """Control-loop units over LocalKV leases + MemoryDepot metrics —
+    no engines, no subprocesses, fake clock for the cooldown."""
+
+    def _scaler(self, kv, depot=None, *, pool=None, retirer=None,
+                clock=None, **pkw):
+        clock = clock or FakeClock()
+        pkw.setdefault("min_replicas", 1)
+        pkw.setdefault("max_replicas", 4)
+        pkw.setdefault("cooldown_s", 10.0)
+        return Autoscaler(kv, depot, policy=AutoscalePolicy(**pkw),
+                          pool=pool, retirer=retirer, now=clock), clock
+
+    def test_signals_counts_states_and_excludes_draining_capacity(self):
+        kv = LocalKV()
+        _lease(kv, "r0", qd=2)
+        _lease(kv, "r1", qd=2, draining=True)
+        _lease(kv, "r2", warming=True)
+        scaler, _ = self._scaler(kv)
+        sig = scaler.signals()
+        assert (sig.serving, sig.warming, sig.draining) == (1, 1, 1)
+        # the draining replica's queue/capacity is leaving, not load;
+        # the warming one has no measured capacity yet either
+        assert sig.queue_depth == 2 and sig.capacity == 8
+
+    def test_pool_spawn_without_lease_counts_as_warming(self):
+        kv = LocalKV()
+        _lease(kv, "r0", qd=4)       # occupancy 1.0: wants out
+        pool = FakePool(live=["r0", "replica9"])   # replica9 not leased yet
+        scaler, _ = self._scaler(kv, pool=pool)
+        sig = scaler.signals()
+        assert sig.warming == 1      # capacity in flight, not missing
+        # the repeat tick cannot double-spawn: target 3 <= live 2 + spawn 1
+        assert scaler.tick() == "out"
+        assert pool.calls[-1] == (3, ())
+
+    def test_scale_out_then_cooldown_blocks(self):
+        kv = LocalKV()
+        _lease(kv, "r0", qd=3, active=1)          # occupancy 1.0
+        pool = FakePool(live=["r0"])
+        scaler, clock = self._scaler(kv, pool=pool)
+        assert scaler.tick() == "out"
+        assert pool.calls == [(2, ())]
+        assert scaler.scale_outs == 1
+        assert scaler.last_decision["reason"] == "occupancy_high"
+        assert scaler.tick() is None              # cooling down
+        assert len(pool.calls) == 1
+        clock.advance(10.1)
+        assert scaler.tick() == "out"             # cooldown elapsed
+
+    def test_drained_sheds_are_not_pressure(self):
+        kv = LocalKV()
+        _lease(kv, "r0")                          # occupancy 0, at min
+        depot = MemoryDepot()
+        depot.metrics_push("r0", {"slo": {
+            "requests_shed": 5, "shed_reasons": {"drained": 5}}})
+        pool = FakePool(live=["r0"])
+        scaler, _ = self._scaler(kv, depot, pool=pool)
+        assert scaler.tick() is None              # first tick only seeds
+        depot.metrics_push("r0", {"slo": {
+            "requests_shed": 7, "shed_reasons": {"drained": 7}}})
+        # the scaler's OWN hand-backs must not read as overload, or every
+        # scale-in would oscillate straight back out
+        assert scaler.tick() is None
+        depot.metrics_push("r0", {"slo": {
+            "requests_shed": 9, "shed_reasons": {"drained": 7}}})
+        assert scaler.tick() == "out"             # real overload sheds
+        assert scaler.last_decision["reason"] == "overload_shed"
+
+    def test_scale_in_picks_least_loaded_and_marks_retiring_first(self):
+        kv = LocalKV()
+        _lease(kv, "r0", qd=0)
+        _lease(kv, "r1", qd=1)
+        seen = []
+
+        def retirer(victim, statuses):
+            # the pool mark must land BEFORE the drain protocol runs, so
+            # a SIGKILL anywhere mid-drain is already an intentional stop
+            seen.append((victim.name, tuple(pool.retired)))
+            return True
+        pool = FakePool(live=["r0", "r1"])
+        scaler, _ = self._scaler(kv, pool=pool, retirer=retirer)
+        assert scaler.tick() == "in"
+        assert seen == [("r0", ("r0",))]
+        assert pool.calls == [(1, ("r0",))]
+        assert scaler.scale_ins == 1
+        assert scaler.last_decision["victim"] == "r0"
+
+    def test_failed_retire_sets_no_cooldown(self):
+        kv = LocalKV()
+        _lease(kv, "r0")
+        _lease(kv, "r1", qd=1)
+        calls = []
+
+        def retirer(victim, statuses):
+            calls.append(victim.name)
+            return False            # victim died under us: failover owns it
+        scaler, _ = self._scaler(kv, pool=FakePool(live=["r0", "r1"]),
+                                 retirer=retirer)
+        assert scaler.tick() is None
+        assert scaler.scale_ins == 0 and scaler.last_decision is None
+        assert scaler.tick() is None       # no cooldown: retried at once
+        assert calls == ["r0", "r0"]
+
+    def test_tick_publishes_autoscale_doc_for_rollup(self):
+        kv = LocalKV()
+        _lease(kv, "r0", qd=6, active=1)   # 7/8 occupancy: wants out
+        _lease(kv, "r1", warming=True)
+        depot = MemoryDepot()
+        pool = FakePool(live=["r0", "r1"])
+        scaler, _ = self._scaler(kv, depot, pool=pool, max_replicas=4)
+        scaler.tick()
+        agg = rollup(depot.metrics_pull())
+        auto = agg["autoscale"]
+        assert auto["states"] == {"r0": "SERVING", "r1": "WARMING"}
+        assert auto["scale_out_total"] == 1
+        assert auto["last_decision"]["direction"] == "out"
+        from paddle_tpu.telemetry.report import dashboard_text
+        text = dashboard_text(depot.metrics_pull())
+        assert "autoscale: replicas=2" in text
+        assert "SERVING=1 WARMING=1 DRAINING=0" in text
+        assert "last decision: out" in text
+
+
+# ---------------------------------------------------------------------------
+class TestReplicaPoolScaleTo:
+    def _pool(self):
+        return ReplicaPool(policy=RestartPolicy(max_restarts=2,
+                                                backoff_base=0.01,
+                                                backoff_cap=0.02,
+                                                jitter=0.0))
+
+    def test_growth_needs_template(self):
+        with pytest.raises(RuntimeError):
+            self._pool().scale_to(1)
+
+    def test_fresh_monotonic_names_never_reused(self, tmp_path):
+        pool = self._pool()
+        pool.set_template([sys.executable, "-c",
+                           "import time; time.sleep(60)"],
+                          log_dir=str(tmp_path))
+        try:
+            assert pool.scale_to(2)["spawned"] == ["replica0", "replica1"]
+            assert pool.live_names() == ["replica0", "replica1"]
+            res = pool.scale_to(1, victims=["replica0"])
+            assert res["retiring"] == ["replica0"]
+            assert pool.live_names() == ["replica1"]
+            # a retired name is never minted again: the next scale-out
+            # cannot inherit replica0's history or restart budget
+            assert pool.scale_to(2)["spawned"] == ["replica2"]
+            assert os.path.exists(str(tmp_path / "replica2.log"))
+        finally:
+            pool.stop()
+
+    def test_retiring_sigkill_burns_zero_budget_crash_still_relaunches(
+            self):
+        pool = self._pool()
+        pool.set_template([sys.executable, "-c",
+                           "import time; time.sleep(60)"])
+        try:
+            pool.scale_to(2)
+            pool.scale_to(1, victims=["replica0"])
+            # SIGKILL lands mid-drain: -9 IS a restart code, but a
+            # retiring victim's exit is intentional whatever the code
+            pool._procs["replica0"].kill()
+            deadline = time.monotonic() + 30
+            while "replica0" not in pool.done and \
+                    time.monotonic() < deadline:
+                pool.poll_once()
+                time.sleep(0.02)
+            assert "replica0" in pool.done
+            assert pool.restarts["replica0"] == 0
+            assert "replica0" not in pool.given_up
+            assert pool.exit_codes["replica0"] == [-9]
+            # the SAME kill on a non-retiring replica relaunches it
+            pool._procs["replica1"].kill()
+            deadline = time.monotonic() + 30
+            while not (pool.restarts.get("replica1") == 1
+                       and "replica1" in pool.alive()) and \
+                    time.monotonic() < deadline:
+                pool.poll_once()
+                time.sleep(0.02)
+            assert pool.restarts["replica1"] == 1
+            assert "replica1" in pool.alive()
+            assert pool.live_names() == ["replica1"]
+        finally:
+            pool.stop()
+
+
+# ---------------------------------------------------------------------------
+class TestRouterChurn:
+    def _st(self, name, **kw):
+        d = dict(address="inproc", capacity=4, queue_depth=0, active=0,
+                 est_first_token_s=0.1, epoch=1, draining=False,
+                 warming=False)
+        d.update(kw)
+        return ReplicaStatus(name=name, **d)
+
+    def test_warming_excluded_from_deadline_spill(self):
+        r = Router()
+        # the warm replica is busier, but deadline-bound traffic must not
+        # gamble its TTFT on an unmeasured cold start
+        picked = r.pick([self._st("cold", warming=True,
+                                  est_first_token_s=None),
+                         self._st("warm", queue_depth=3)],
+                        Deadline(ttft_s=1.0))
+        assert picked.name == "warm"
+
+    def test_warming_routable_without_deadline(self):
+        r = Router()
+        picked = r.pick([self._st("cold", warming=True),
+                         self._st("warm", queue_depth=3)])
+        assert picked.name == "cold"   # plain least-loaded applies
+
+    def test_all_warming_falls_back_instead_of_refusing(self):
+        r = Router()
+        picked = r.pick([self._st("a", warming=True, queue_depth=1),
+                         self._st("b", warming=True)],
+                        Deadline(ttft_s=0.5))
+        assert picked.name == "b"
+
+    def test_all_draining_is_unroutable(self):
+        r = Router()
+        assert r.pick([self._st("a", draining=True),
+                       self._st("b", draining=True, warming=True)]) is None
+
+    def test_tie_break_stable_across_scan_order(self):
+        r = Router()
+        a, b = self._st("a"), self._st("b")
+        # two scans listing the same fleet in different orders must agree,
+        # or every rescan would reshuffle traffic across equal replicas
+        assert r.pick([a, b]).name == "a"
+        assert r.pick([b, a]).name == "a"
+        assert [s.name for s in r.order([b, a], Deadline(ttft_s=1.0))] \
+            == ["a", "b"]
+
+
+# ---------------------------------------------------------------------------
+class TestNetFaults:
+    """Satellite 1: the ``net`` fault family fires in the depot client's
+    framed-TCP path; the client's single transparent reconnect absorbs a
+    one-shot fault, ``times=2`` surfaces an OSError."""
+
+    def test_single_connect_fault_absorbed_by_reconnect(self, depot):
+        # fresh client: the very first dial dies, the transparent retry
+        # dials again with the spec exhausted — the caller never notices
+        with faults.inject(op="net_connect", mode="error",
+                           times=1) as spec:
+            depot.metrics_push("t", {"x": 1})
+        assert spec.fired == 1
+        assert depot.metrics_pull()["t"] == {"x": 1}
+
+    def test_times_one_is_invisible_to_the_caller(self, depot):
+        depot.metrics_push("warm", {})     # connection established
+        with faults.inject(op="net_write", mode="error", times=1) as spec:
+            depot.metrics_push("t", {"x": 1})
+        assert spec.fired == 1
+        assert depot.metrics_pull()["t"] == {"x": 1}
+
+    def test_times_two_surfaces_oserror(self, depot):
+        depot.metrics_push("warm", {})
+        with faults.inject(op="net_write", mode="error", times=2) as spec:
+            with pytest.raises(OSError):
+                depot.metrics_push("t2", {"x": 2})
+        assert spec.fired == 2
+        # the link heals once the spec is exhausted
+        depot.metrics_push("t2", {"x": 2})
+        assert depot.metrics_pull()["t2"] == {"x": 2}
+
+    def test_connect_faults_fire_on_reconnect_too(self, depot):
+        depot.close()                      # next call must dial fresh
+        with faults.inject(op="net_connect", mode="error",
+                           times=2) as spec:
+            with pytest.raises(OSError):
+                depot.metrics_pull()
+        assert spec.fired == 2
+        assert depot.metrics_pull() == {} or depot.metrics_pull()
+
+    def test_drop_mode_is_a_reset_absorbed_once(self, depot):
+        depot.metrics_push("warm", {})
+        with faults.inject(op="net_read", mode="drop", times=1) as spec:
+            depot.metrics_push("d", {"ok": True})
+        assert spec.fired == 1
+        assert depot.metrics_pull()["d"] == {"ok": True}
+
+    def test_family_spec_and_address_pattern(self, depot):
+        addr_pat = f"*:{depot.port}"
+        with faults.inject(op="net", pattern=addr_pat, mode="delay",
+                           delay_s=0.15, times=1) as spec:
+            t0 = time.monotonic()
+            depot.metrics_push("slow", {})
+            assert time.monotonic() - t0 >= 0.15
+        assert spec.fired == 1
+        # a pattern for some OTHER peer never fires
+        with faults.inject(op="net", pattern="10.0.0.1:*",
+                           mode="error", times=-1) as spec:
+            depot.metrics_push("other", {})
+        assert spec.fired == 0
+
+
+# ---------------------------------------------------------------------------
+class TestWarmingRetryHint:
+    def test_passthrough_and_cap(self):
+        assert warming_retry_hint(None, 0) is None
+        assert warming_retry_hint(3.0, 0) == 3.0
+        assert warming_retry_hint(None, 2, eta_s=5.0) == 5.0
+        assert warming_retry_hint(10.0, 1, eta_s=5.0) == 5.0
+        assert warming_retry_hint(0.2, 1, eta_s=5.0) == 0.2
+
+    def test_env_eta_default(self):
+        # conftest pins PADDLE_TPU_AS_WARMUP_ETA_S=0.5 for the CPU lane
+        assert warming_retry_hint(None, 1) == 0.5
+
+    def test_overloaded_fleet_with_warming_capacity_hints_eta(self, depot):
+        kv = LocalKV()
+        fe = ServingFrontend(kv, depot, auto_attach=False)
+        _lease(kv, "a", warming=True)
+        fe.attach(FakeReplica("a", fail="overloaded"))
+        with pytest.raises(Overloaded) as ei:
+            fe.submit([1, 2, 3], max_new_tokens=2)
+        # a client told "retry in 0.5s" lands when the warming replica is
+        # taking traffic, not after the full fleet's drain-rate estimate
+        assert ei.value.retry_after_s == pytest.approx(0.5)
+        fe.stop()
+
+
+# ---------------------------------------------------------------------------
+class TestMetersAndReport:
+    def test_slo_meter_shed_reasons_split(self):
+        m = SLOMeter()
+        m.shed(1, reason="deadline")
+        m.shed(2, reason="drained")
+        m.shed(3, reason="drained")
+        s = m.summary()
+        assert s["requests_shed"] == 3
+        assert s["shed_reasons"] == {"deadline": 1, "drained": 2}
+
+    def test_fleet_meter_autoscale_counters(self):
+        fm = FleetMeter()
+        fm.autoscale("out", target=2, reason="occupancy_high")
+        fm.autoscale("in", target=1, reason="occupancy_low")
+        fm.set_fleet_states(2, 1, 0)
+        s = fm.summary()
+        assert s["scale_out"] == 1 and s["scale_in"] == 1
+        assert (s["serving_replicas"], s["warming_replicas"],
+                s["draining_replicas"]) == (2, 1, 0)
+        assert s["last_autoscale"]["direction"] == "in"
+
+    def test_rollup_latest_autoscale_doc_wins(self):
+        newer = {"wall_time": 2.0, "autoscale": {"serving": 5}}
+        older = {"wall_time": 1.0, "autoscale": {"serving": 1}}
+        assert rollup({"a": older, "b": newer})["autoscale"]["serving"] == 5
+        assert rollup({"a": newer, "z": older})["autoscale"]["serving"] == 5
+
+    def test_report_smoke_renders_autoscale_rows(self, capsys):
+        from paddle_tpu.telemetry import report
+        assert report.main(["--smoke"]) == 0
+        out = capsys.readouterr().out
+        assert "autoscale: replicas=2" in out
+        assert "SERVING=1 WARMING=1 DRAINING=0" in out
+        assert "last decision: out -> target=2 (occupancy_high)" in out
+        assert "r1=WARMING" in out
+
+
+# ---------------------------------------------------------------------------
+CHILD = textwrap.dedent("""
+    import os, sys
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.serving.fleet import run_replica
+
+    work, collector = sys.argv[1], sys.argv[2]
+    paddle.seed(3)
+    cfg = llama_tiny(num_hidden_layers=2, vocab_size=96,
+                     max_position_embeddings=128)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    run_replica(model, collector_addr=collector,
+                journal_root=os.path.join(work, "journals"),
+                engine_kw=dict(max_batch=2, page_tokens=8, num_pages=48,
+                               max_pages_per_seq=16, max_queue=4))
+""")
+
+
+@pytest.mark.chaos
+class TestLoadRampChaosE2E:
+    """Acceptance: a traffic step against a 1-replica fleet scales out
+    (warm start takes traffic), the step's removal drains + scales in,
+    and a SIGKILL landing mid-drain degrades to fence + fold + replay —
+    every accepted token exactly once, zero restart budget burned."""
+
+    def test_ramp_out_drain_in_sigkill_mid_drain(self, model, tmp_path):
+        from paddle_tpu.distributed.store import TCPStore
+
+        store = TCPStore("127.0.0.1", 0, is_master=True)
+        snapstore = SnapshotStore(host="127.0.0.1")
+        client = SnapshotClient("127.0.0.1", snapstore.port)
+        sink = TokenSink(str(tmp_path / "tokens.jsonl"))
+        fe = ServingFrontend(store, client, sink=sink)
+        coll = TokenCollector(fe)
+        pool = ReplicaPool(policy=RestartPolicy(max_restarts=2,
+                                                backoff_base=0.05,
+                                                backoff_cap=0.1,
+                                                jitter=0.0))
+        pool.set_template(
+            [sys.executable, "-c", CHILD, str(tmp_path), coll.address],
+            env={"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+                 "PADDLE_TPU_FLEET_STORE": f"127.0.0.1:{store.port}",
+                 "PADDLE_TPU_SNAP_STORE": f"127.0.0.1:{snapstore.port}"},
+            log_dir=str(tmp_path), name_prefix="replica")
+        scaler = Autoscaler(store, client,
+                            policy=AutoscalePolicy(min_replicas=1,
+                                                   max_replicas=2,
+                                                   up_thresh=0.8,
+                                                   down_thresh=0.25,
+                                                   cooldown_s=0.3),
+                            pool=pool)
+        pool.scale_to(1)
+        try:
+            deadline = time.monotonic() + 300
+            while time.monotonic() < deadline:
+                pool.poll_once()
+                fe.scan_once()
+                if fe.live_replicas() == ["replica0"]:
+                    break
+                time.sleep(0.25)
+            assert fe.live_replicas() == ["replica0"], \
+                f"fleet never formed: {fe.live_replicas()}"
+
+            # -- traffic step: one long streamer + an over-capacity burst
+            rng = np.random.default_rng(23)
+            dl = Deadline(ttft_s=240.0, total_s=600.0)
+            reqs = {}
+            long_p = rng.integers(1, 96, 6).astype(np.int32)
+            rid_long = fe.submit(long_p, max_new_tokens=40, deadline=dl)
+            reqs[rid_long] = (long_p, 40)
+            for _ in range(6):
+                p = rng.integers(1, 96,
+                                 int(rng.integers(4, 9))).astype(np.int32)
+                mn = int(rng.integers(3, 6))
+                try:
+                    rid = fe.submit(p, max_new_tokens=mn, deadline=dl)
+                    reqs[rid] = (p, mn)
+                except Overloaded:
+                    pass               # over-capacity: pressure signal
+            assert len(reqs) >= 3
+
+            # -- the scaler sees the step and scales out
+            deadline = time.monotonic() + 120
+            while scaler.scale_outs == 0 and time.monotonic() < deadline:
+                scaler.tick()
+                pool.poll_once()
+                time.sleep(0.1)
+            assert scaler.scale_outs >= 1, scaler.summary()
+            assert "replica1" in pool.live_names()
+
+            # -- warm start: the newcomer advertises WARMING until its
+            # first completed step.  Deadline traffic must never spill
+            # there, but no-deadline traffic may — and that is exactly
+            # what warms it.  Keep offering shorts until one routes to
+            # replica1 (replica0 is still streaming the long request, so
+            # least-loaded prefers the idle newcomer; bursts of 3 cover
+            # the idle-tie-break case by filling replica0 first).
+            deadline = time.monotonic() + 300
+            r1 = None
+            warm_rids = []
+            while time.monotonic() < deadline:
+                pool.poll_once()
+                fe.scan_once()
+                sts = {st.name: st for st in scaler.signals().statuses}
+                r1 = sts.get("replica1")
+                if r1 is not None and not r1.warming:
+                    break
+                if r1 is not None and not any(
+                        fe.assignments.get(w) == "replica1"
+                        for w in warm_rids):
+                    for _ in range(3):
+                        p = rng.integers(1, 96, 4).astype(np.int32)
+                        try:
+                            rid = fe.submit(p, max_new_tokens=3)
+                        except Overloaded:
+                            continue
+                        reqs[rid] = (p, 3)
+                        warm_rids.append(rid)
+                time.sleep(0.2)
+            assert r1 is not None and not r1.warming, \
+                "scale-out replica never finished warming"
+            assert any(fe.assignments.get(w) == "replica1"
+                       for w in warm_rids)   # warm capacity took traffic
+
+            # -- step removed: the ramp's work completes on both replicas
+            assert fe.wait_all(list(reqs), timeout=420), fe.summary()
+
+            # -- two fresh long streams, one per replica (tie-break puts
+            # the first on replica0), so the scale-in victim is mid-work
+            pc = rng.integers(1, 96, 6).astype(np.int32)
+            pd = rng.integers(1, 96, 7).astype(np.int32)
+            fe.scan_once()
+            rid_c = fe.submit(pc, max_new_tokens=120, deadline=dl)
+            rid_d = fe.submit(pd, max_new_tokens=120, deadline=dl)
+            reqs[rid_c] = (pc, 120)
+            reqs[rid_d] = (pd, 120)
+            assert fe.assignments[rid_c] == "replica0"
+            # both streams must be ACTIVE (prefilled, decoding) before the
+            # drain fires, so the victim's open work is mid-stream state,
+            # not a queued hand-back
+            deadline = time.monotonic() + 300
+            while (sink.delivered(rid_c) < 1 or sink.delivered(rid_d) < 1) \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert sink.delivered(rid_c) >= 1 and sink.delivered(rid_d) >= 1
+
+            # -- occupancy fell under the band: drain + scale-in fires,
+            # victim = least-loaded tie-break = replica0 (actively
+            # streaming rid_c: exactly the mid-drain case)
+            deadline = time.monotonic() + 120
+            while scaler.scale_ins == 0 and time.monotonic() < deadline:
+                scaler.tick()
+                time.sleep(0.05)
+            assert scaler.scale_ins >= 1, scaler.summary()
+            assert scaler.last_decision["victim"] == "replica0"
+            assert "replica0" in pool.retiring
+            vepoch = fe._epochs["replica0"]
+
+            # -- SIGKILL mid-drain: the victim dies while finishing its
+            # active stream; retiring-at-the-pool makes the exit
+            # intentional, the frontend's failover owns the open work
+            assert rid_c not in fe.finished_rids()
+            pool._procs["replica0"].kill()
+            deadline = time.monotonic() + 60
+            while "replica0" not in pool.done and \
+                    time.monotonic() < deadline:
+                pool.poll_once()
+                time.sleep(0.05)
+            assert "replica0" in pool.done
+            assert pool.restarts["replica0"] == 0       # zero budget burned
+            assert "replica0" not in pool.given_up
+            assert pool.exit_codes["replica0"][-1] == -9
+
+            # -- fence + fold + replay on the survivor; exactly-once holds
+            assert fe.wait_all([rid_c, rid_d], timeout=420), fe.summary()
+            assert client.fence_epoch("replica0") >= vepoch + 1
+            assert not (set(reqs) & set(fe.shed)), fe.shed
+            streams = TokenSink.collect(sink.path)
+            for r, (p, mn) in sorted(reqs.items()):
+                assert streams.get(r) == list(_solo(model, p, mn)), r
+            assert set(streams) == set(reqs)
+            ttfts = [fe.first_token_wall[r] - fe.requests[r]["submit_wall"]
+                     for r in reqs if r in fe.first_token_wall]
+            assert len(ttfts) == len(reqs)
+            assert float(np.percentile(ttfts, 99)) <= dl.ttft_s
+
+            # -- the depot rollup carries the autoscale row
+            agg = rollup(client.metrics_pull())
+            assert agg["autoscale"]["scale_out_total"] >= 1
+            assert agg["autoscale"]["scale_in_total"] >= 1
+        finally:
+            for h in list(fe.handles.values()):
+                if isinstance(h, RemoteReplica):
+                    try:
+                        h.stop_replica()
+                    except OSError:
+                        pass
+            deadline = time.monotonic() + 60
+            while not pool.all_exited() and time.monotonic() < deadline:
+                pool.poll_once()
+                time.sleep(0.1)
+            pool.stop()
+            fe.stop()
+            coll.close()
+            sink.close()
+            client.close()
+            snapstore.close()
+            store.close()
+        # the entire ramp — out, in, and the kill — burned no restarts
+        assert sum(pool.restarts.values()) == 0
