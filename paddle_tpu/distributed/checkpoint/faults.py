@@ -19,7 +19,10 @@ operations fail in a controlled, reproducible way:
       destination, then raise :class:`InjectedCrash` (a kill mid-``write``:
       a torn file at the final path);
     - ``"delay"``     sleep ``delay_s`` then continue (storage flake /
-      slow NFS; pairs with the comm watchdog);
+      slow NFS; pairs with the comm watchdog).  ``delay_s`` may be a
+      ``(lo, hi)`` pair: each fire then draws its sleep uniformly from
+      the range, seeded per-fire (``seed``, ``fired``) — soak campaigns
+      don't phase-lock, yet replay identically;
     - ``"sigterm"``   deliver a real ``SIGTERM`` to this process and
       continue (synthetic preemption notice; pairs with
       :class:`~paddle_tpu.distributed.fleet.elastic.PreemptionGuard`).
@@ -55,6 +58,20 @@ spec that surfaces an ``OSError`` to the caller) and ``drop`` (the
 connection dies mid-exchange: raises ``ConnectionResetError``, which the
 same single-reconnect absorbs) let autoscale/drain chaos tests inject
 flaky depot links instead of only process kills.
+
+The ``slow`` op family models *degraded hardware* — a chip or link that
+is alive but slow, the failure class the straggler ladder in
+:mod:`..health.straggler` exists to catch: ``"slow_step"`` fires in the
+train-step hot path (the fleet step note in :mod:`paddle_tpu.jit`),
+``"slow_collective"`` in the ring/neighbor collective path (the
+straggler micro-probes announce their ppermute legs here, ``pattern``
+globbing the ``link<a>-<b>`` pair name), and ``"slow_serve"`` in the
+serving decode loop (per-token, so an armed delay inflates TPOT the way
+a degraded replica would).  ``op="slow"`` matches the whole family.
+Armed with ``mode="delay"``, this is the SIGSTOP-free way to make one
+rank N× slow — the process keeps heartbeating, keeps computing, and
+keeps being *late*, exactly the signature the detector must separate
+from dead/wedged.
 
 The ``serve`` op family covers the serving engine's hot path:
 ``"serve_prefill"`` / ``"serve_decode"`` fire before the compiled
@@ -94,7 +111,8 @@ _MODES = ("error", "crash", "truncate", "delay", "sigterm", "bitflip",
           "drop")
 _OPS = ("write", "read", "rename", "commit", "snap", "serve",
         "serve_prefill", "serve_decode", "serve_pool", "serve_journal",
-        "sdc", "net", "net_connect", "net_read", "net_write", "any")
+        "sdc", "net", "net_connect", "net_read", "net_write",
+        "slow", "slow_step", "slow_collective", "slow_serve", "any")
 
 
 class InjectedIOError(OSError):
@@ -118,7 +136,7 @@ class FaultSpec:
     after: int = 0            # skip the first `after` matching calls
     p: float = 1.0            # per-call fire probability
     seed: int = 0             # seeds the p-draws (reproducible campaigns)
-    delay_s: float = 0.05
+    delay_s: object = 0.05    # float, or (lo, hi) for seeded per-fire draw
     truncate_frac: float = 0.5
     message: str = "injected fault"
     matched: int = 0          # matching calls seen (diagnostic)
@@ -130,6 +148,12 @@ class FaultSpec:
             raise ValueError(f"mode must be one of {_MODES}, got {self.mode!r}")
         if self.op not in _OPS:
             raise ValueError(f"op must be one of {_OPS}, got {self.op!r}")
+        if isinstance(self.delay_s, (tuple, list)):
+            if len(self.delay_s) != 2 or \
+                    float(self.delay_s[0]) > float(self.delay_s[1]):
+                raise ValueError(
+                    f"delay_s range must be (lo, hi) with lo <= hi, "
+                    f"got {self.delay_s!r}")
         self._rng = random.Random(self.seed)
 
     # -- matching ----------------------------------------------------------
@@ -139,6 +163,9 @@ class FaultSpec:
                 return False
         elif self.op == "net":          # family spec: any net_* step
             if not op.startswith("net"):
+                return False
+        elif self.op == "slow":         # family spec: any slow_* seam
+            if not op.startswith("slow"):
                 return False
         elif self.op != "any" and op != self.op:
             return False
@@ -168,7 +195,7 @@ class FaultSpec:
         if self.mode == "bitflip":
             return self._bitflip(data)
         if self.mode == "delay":
-            time.sleep(self.delay_s)
+            time.sleep(self._delay())
             return data
         if self.mode == "sigterm":
             os.kill(os.getpid(), signal.SIGTERM)
@@ -192,6 +219,19 @@ class FaultSpec:
             raise InjectedCrash(f"{self.message}: crashed at {op} {path}")
         raise InjectedIOError(f"{self.message}: {op} {path} failed "
                               f"(fire {self.fired}/{self.times})")
+
+    def _delay(self) -> float:
+        """Resolved sleep for one ``delay`` fire: a scalar sleeps exactly
+        ``delay_s`` (legacy fixed-delay specs unchanged); a ``(lo, hi)``
+        pair draws uniformly from the range with a per-fire seed
+        (``seed``, ``fired`` — same discipline as ``bitflip``), so soak
+        runs don't phase-lock yet replay identically."""
+        d = self.delay_s
+        if isinstance(d, (tuple, list)):
+            lo, hi = float(d[0]), float(d[1])
+            return random.Random(
+                self.seed * 1_000_003 + self.fired).uniform(lo, hi)
+        return float(d)
 
     def _bitflip(self, data):
         """Flip one seeded bit in the payload and return the corrupted

@@ -53,6 +53,15 @@ import time
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
+# the client's reconnect-once loop routes through the shared backoff/retry
+# engine when the package context is available; a standalone importlib
+# load (jax-free chaos children) falls back to the inline equivalent so
+# this file stays stdlib-only loadable
+try:
+    from ..retry import retry_call as _retry_call
+except (ImportError, SystemError, ValueError):
+    _retry_call = None
+
 __all__ = [
     "SnapshotStore", "SnapshotClient", "KVTransport", "FencedEpoch",
     "ensure_host_store", "transport_from_env", "crc32", "env_int",
@@ -445,14 +454,25 @@ class SnapshotClient:
         return _recv(sock)
 
     def _call(self, head: dict, payload: bytes = b"") -> Tuple[dict, bytes]:
+        def _once() -> Tuple[dict, bytes]:
+            return self._exchange(head, payload)
+
+        def _reconnect(attempt: int, exc: BaseException, _d: float) -> None:
+            # one transparent reconnect: every command here is
+            # idempotent (put overwrites the same (src,holder,gen) cell)
+            self.close()
+
         with self._lock:
-            try:
-                resp, out = self._exchange(head, payload)
-            except (OSError, ConnectionError):
-                # one transparent reconnect: every command here is
-                # idempotent (put overwrites the same (src,holder,gen) cell)
-                self.close()
-                resp, out = self._exchange(head, payload)
+            if _retry_call is not None:
+                resp, out = _retry_call(
+                    _once, attempts=2, retry_on=(OSError, ConnectionError),
+                    on_retry=_reconnect)
+            else:  # standalone load: same reconnect-once semantics inline
+                try:
+                    resp, out = _once()
+                except (OSError, ConnectionError):
+                    self.close()
+                    resp, out = _once()
         if "error" in resp:
             raise OSError(f"snapshot store error: {resp['error']}")
         return resp, out

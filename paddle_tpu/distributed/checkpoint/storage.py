@@ -24,11 +24,11 @@ from __future__ import annotations
 
 import os
 import random
-import time
 import zlib
 from typing import Callable, Optional, TypeVar
 
 from . import faults
+from ..retry import BackoffPolicy, retry_call
 
 __all__ = ["retry_io", "write_bytes", "read_bytes", "crc32"]
 
@@ -49,32 +49,33 @@ def retry_io(fn: Callable[[], T], *, attempts: Optional[int] = None,
              base_delay: float = 0.05, max_delay: float = 2.0,
              jitter: float = 0.5, rng: Optional[random.Random] = None,
              describe: str = "checkpoint io") -> T:
-    """Run ``fn`` with exponential backoff + jitter on ``OSError``."""
-    attempts = _attempts() if attempts is None else max(1, attempts)
-    rng = rng or random
-    last: Optional[BaseException] = None
-    for attempt in range(attempts):
-        try:
-            return fn()
-        except FileNotFoundError:
-            raise  # a missing file is a protocol error, not storage flake
-        except OSError as e:
-            last = e
-            if attempt == attempts - 1:
-                break
-            delay = min(max_delay, base_delay * (2 ** attempt))
-            delay *= 1.0 + jitter * rng.random()
-            try:  # flight recorder: flakes that retries absorbed still show
-                from ... import telemetry
+    """Run ``fn`` with exponential backoff + jitter on ``OSError``.
 
-                telemetry.record_event("checkpoint_io_retry", describe,
-                                       attempt=attempt + 1,
-                                       error=repr(e)[:200],
-                                       backoff_s=round(delay, 4))
-            except Exception:
-                pass
-            time.sleep(delay)
-    raise last
+    A thin wrapper over the shared :mod:`..retry` engine: same delay
+    sequence as the historical inline loop (``base * 2**attempt`` capped,
+    jitter drawn from the caller's ``rng``), ``FileNotFoundError``
+    propagates immediately (a missing file is a protocol error, not
+    storage flake), and every absorbed flake still lands in the flight
+    recorder as a ``checkpoint_io_retry`` event.
+    """
+    attempts = _attempts() if attempts is None else max(1, attempts)
+
+    def _note(attempt: int, exc: BaseException, backoff_s: float) -> None:
+        try:  # flight recorder: flakes that retries absorbed still show
+            from ... import telemetry
+
+            telemetry.record_event("checkpoint_io_retry", describe,
+                                   attempt=attempt + 1,
+                                   error=repr(exc)[:200],
+                                   backoff_s=round(backoff_s, 4))
+        except Exception:
+            pass
+
+    return retry_call(
+        fn, attempts=attempts,
+        policy=BackoffPolicy(base=base_delay, cap=max_delay, jitter=jitter),
+        retry_on=(OSError,), raise_now=(FileNotFoundError,),
+        on_retry=_note, rng=rng or random)
 
 
 def write_bytes(path: str, data: bytes, *, op: str = "write",

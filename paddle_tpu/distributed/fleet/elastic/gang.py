@@ -24,14 +24,23 @@ above it must relaunch the whole gang.  That something is
   ride the checkpoint reshard-on-load path under the smaller mesh (the
   "resume under a different mesh" property PR 2's tests established);
 - an ``sdc_suspect`` poison (the SDC monitor confirmed a chip silently
-  computing wrong numbers) triggers an **exclude-list relaunch** instead
-  of a plain restart: the launcher dumps the poison doc to
-  ``<log_dir>/epoch_N/poison.json``, the supervisor maps the culprit rank
-  to its physical slot, adds it to ``excluded_slots`` (exported as
-  ``PADDLE_TPU_EXCLUDE_SLOTS``), and relaunches the SAME topology minus
-  the quarantined slot with a FRESH restart budget — distinct from
-  degrade, which shrinks the world because hosts keep dying, not because
-  one of them lies;
+  computing wrong numbers) or a ``straggler_suspect`` poison (the
+  straggler ladder confirmed a sticky chip-slow rank) triggers an
+  **exclude-list relaunch** instead of a plain restart: the launcher
+  dumps the poison doc to ``<log_dir>/epoch_N/poison.json``, the
+  supervisor maps the culprit rank to its physical slot, adds it to
+  ``excluded_slots`` (exported as ``PADDLE_TPU_EXCLUDE_SLOTS``), and
+  relaunches the SAME topology minus the quarantined slot with a FRESH
+  restart budget — distinct from degrade, which shrinks the world
+  because hosts keep dying, not because one of them lies (or limps);
+- a ``straggler_link`` poison (sticky link-slow: the chip is fine, the
+  ICI link between two ring neighbors is degraded) triggers a **mesh
+  re-order remap**: the supervisor records the pair in slot space,
+  computes a device-order permutation in which no degraded link is
+  ring-adjacent (:func:`ring_order_avoiding`), exports it as
+  ``PADDLE_TPU_DEVICE_ORDER`` and relaunches the FULL topology — no slot
+  is lost for a bad cable.  When no permutation avoids the pair
+  (world < 4), it falls back to excluding the culprit's slot;
 - relaunched ranks resume through the **in-memory snapshot ladder**
   (:func:`~....checkpoint.snapshot.resume`: own RAM → snapshot-store copy
   → peer replica → committed disk checkpoint).  The supervisor hosts the
@@ -61,7 +70,42 @@ from typing import Callable, Dict, List, Optional, Sequence
 from ...checkpoint.replicator import env_int as _env_int
 from .supervisor import RestartPolicy, worst_resume_source
 
-__all__ = ["GangPolicy", "FleetSupervisor"]
+__all__ = ["GangPolicy", "FleetSupervisor", "ring_order_avoiding"]
+
+
+def ring_order_avoiding(n: int, bad_pairs) -> Optional[List[int]]:
+    """Smallest (lexicographically, from rank 0) ring ordering of
+    ``range(n)`` in which no ``bad_pairs`` entry is ring-adjacent —
+    including the wraparound edge — or ``None`` when every ordering
+    crosses a bad pair (n < 4 with one pair: on a 3-ring every pair is
+    adjacent).  Backtracking over gang-sized n (tens), not a search
+    problem.  This is the link-slow remap: the returned order becomes
+    ``PADDLE_TPU_DEVICE_ORDER``, routing ring-neighbor traffic around a
+    degraded link without excluding any slot."""
+    bad = set()
+    for a, b in bad_pairs:
+        bad.add((int(a), int(b)))
+        bad.add((int(b), int(a)))
+    if not bad:
+        return list(range(n))
+    order = [0]
+    used = {0}
+
+    def _solve() -> bool:
+        if len(order) == n:
+            return (order[-1], order[0]) not in bad
+        for cand in range(1, n):
+            if cand in used or (order[-1], cand) in bad:
+                continue
+            order.append(cand)
+            used.add(cand)
+            if _solve():
+                return True
+            order.pop()
+            used.discard(cand)
+        return False
+
+    return list(order) if _solve() else None
 
 
 @dataclass
@@ -133,6 +177,8 @@ class FleetSupervisor:
         self.gang_restarts = 0          # relaunches at the CURRENT world
         self.degrades = 0
         self.excluded_slots: List[int] = []   # quarantined physical slots
+        self.bad_link_slots: List[List[int]] = []  # degraded pairs (slots)
+        self.device_order: Optional[List[int]] = None  # link-remap ring
         self.world_size = self.nnodes * self.nproc_per_node
         self.exit_codes: List[int] = []
         # in-memory snapshot depot: hosted HERE (this process outlives
@@ -177,6 +223,9 @@ class FleetSupervisor:
         if self.excluded_slots:
             env["PADDLE_TPU_EXCLUDE_SLOTS"] = ",".join(
                 str(s) for s in sorted(self.excluded_slots))
+        if self.device_order:
+            env["PADDLE_TPU_DEVICE_ORDER"] = ",".join(
+                str(r) for r in self.device_order)
         env.update(self.env)
         return env
 
@@ -233,14 +282,15 @@ class FleetSupervisor:
                 else:
                     os.environ[k] = v
 
-    # -- SDC quarantine ----------------------------------------------------
-    def _check_quarantine(self, epoch: int) -> Optional[int]:
+    # -- quarantine (SDC + straggler remediation) --------------------------
+    def _check_quarantine(self, epoch: int):
         """After a failed attempt, read the launcher's poison dump for
-        this epoch. An ``sdc_suspect`` poison quarantines the culprit's
-        physical slot: the relaunch keeps the SAME topology minus that
-        slot, with a FRESH restart budget — an exclude-list relaunch, not
-        a degrade (the host isn't dying; it's lying). Returns the newly
-        excluded slot, or None."""
+        this epoch and apply the matching remediation: ``sdc_suspect`` /
+        ``straggler_suspect`` → exclude-list relaunch minus the culprit's
+        slot; ``straggler_link`` → device-order remap around the degraded
+        pair (exclusion fallback when no order avoids it).  Returns a
+        truthy token when a remediation was applied (the relaunch burns
+        no restart budget), else None."""
         import json
 
         path = os.path.join(self.log_dir, f"epoch_{epoch}", "poison.json")
@@ -249,8 +299,18 @@ class FleetSupervisor:
                 doc = json.load(f)
         except (OSError, ValueError):
             return None
-        if doc.get("reason") != "sdc_suspect":
-            return None
+        reason = doc.get("reason")
+        if reason in ("sdc_suspect", "straggler_suspect"):
+            return self._quarantine_exclude(epoch, doc)
+        if reason == "straggler_link":
+            return self._quarantine_link(epoch, doc)
+        return None
+
+    def _quarantine_exclude(self, epoch: int, doc: dict) -> Optional[int]:
+        """Quarantine the culprit's physical slot: the relaunch keeps the
+        SAME topology minus that slot, with a FRESH restart budget — an
+        exclude-list relaunch, not a degrade (the host isn't dying; it's
+        lying, or limping). Returns the newly excluded slot, or None."""
         culprit = doc.get("culprit")
         if not isinstance(culprit, int):
             return None
@@ -274,11 +334,57 @@ class FleetSupervisor:
         self.world_size = self.nnodes * self.nproc_per_node \
             - len(self.excluded_slots)
         self.gang_restarts = 0   # fresh budget: the bad actor is gone
+        self._recompute_order()  # dense ranks moved under the new world
         self._event("gang_quarantine", epoch=epoch, slot=slot,
+                    reason=doc.get("reason"),
                     culprit_rank=culprit, step=doc.get("step"),
                     excluded_slots=sorted(self.excluded_slots),
                     world=self.world_size)
         return slot
+
+    def _quarantine_link(self, epoch: int, doc: dict):
+        """Mesh re-order remap for a degraded link: record the pair in
+        slot space, find a ring order in which it is never adjacent, and
+        relaunch the FULL topology under ``PADDLE_TPU_DEVICE_ORDER`` —
+        the fix costs a permutation, not a slot.  Falls back to excluding
+        the culprit's slot when no order avoids every recorded pair."""
+        link = doc.get("link")
+        if not (isinstance(link, (list, tuple)) and len(link) == 2):
+            return None
+        avail = [s for s in range(self.nnodes * self.nproc_per_node)
+                 if s not in self.excluded_slots]
+        try:
+            pair = sorted(avail[int(r)] for r in link)
+        except (TypeError, ValueError, IndexError):
+            return None
+        if pair not in self.bad_link_slots:
+            self.bad_link_slots.append(pair)
+        if self._recompute_order():
+            self.gang_restarts = 0  # fresh budget: the link is routed out
+            self._event("gang_link_remap", epoch=epoch,
+                        link_ranks=[int(r) for r in link], link_slots=pair,
+                        device_order=list(self.device_order or []),
+                        world=self.world_size)
+            return {"remap": list(self.device_order or [])}
+        self._event("gang_link_exclude_fallback", epoch=epoch,
+                    link_slots=pair, world=self.world_size)
+        return self._quarantine_exclude(epoch, doc)
+
+    def _recompute_order(self) -> bool:
+        """Re-derive ``device_order`` from the recorded degraded links
+        under the CURRENT exclusion set.  True when every still-live pair
+        can be kept off the ring adjacency (or none remain)."""
+        avail = [s for s in range(self.nnodes * self.nproc_per_node)
+                 if s not in self.excluded_slots]
+        aset = set(avail)
+        pairs = [(avail.index(a), avail.index(b))
+                 for a, b in self.bad_link_slots if a in aset and b in aset]
+        if not pairs:
+            self.device_order = None
+            return True
+        order = ring_order_avoiding(len(avail), pairs)
+        self.device_order = order
+        return order is not None
 
     # -- degrade -----------------------------------------------------------
     def _degrade(self) -> bool:

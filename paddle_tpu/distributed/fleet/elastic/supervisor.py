@@ -79,7 +79,6 @@ usage::
 from __future__ import annotations
 
 import os
-import random
 import shutil
 import subprocess
 import sys
@@ -89,6 +88,7 @@ from dataclasses import dataclass
 from typing import Callable, List, Optional, Sequence, Union
 
 from . import ELASTIC_EXIT_CODE
+from ...retry import BackoffPolicy
 
 __all__ = ["RestartPolicy", "Supervisor", "ReplicaPool",
            "emergency_handler", "RESUME_LADDER", "worst_resume_source"]
@@ -110,7 +110,12 @@ def worst_resume_source(sources) -> Optional[str]:
 
 @dataclass
 class RestartPolicy:
-    """Bounded restarts with seeded exponential backoff + jitter."""
+    """Bounded restarts with seeded exponential backoff + jitter.
+
+    The delay schedule is the shared :class:`..retry.BackoffPolicy`
+    (1-based ``restart_num`` maps onto its 0-based attempt index; the
+    per-restart RNG stream ``seed * 1_000_003 + restart_num`` is
+    unchanged, so historical delay sequences are preserved)."""
 
     max_restarts: int = 5
     backoff_base: float = 1.0
@@ -120,10 +125,9 @@ class RestartPolicy:
 
     def delay(self, restart_num: int) -> float:
         """Backoff before restart ``restart_num`` (1-based)."""
-        base = min(self.backoff_cap,
-                   self.backoff_base * (2 ** max(0, restart_num - 1)))
-        rng = random.Random(self.seed * 1_000_003 + restart_num)
-        return base * (1.0 + self.jitter * rng.random())
+        return BackoffPolicy(base=self.backoff_base, cap=self.backoff_cap,
+                             jitter=self.jitter,
+                             seed=self.seed).delay(restart_num - 1)
 
 
 class Supervisor:

@@ -62,6 +62,11 @@ FLEET_EXIT_CODE = 101
 
 _HB_PREFIX = "hb/"
 _POISON_PREFIX = "poison/"
+_STRAGGLER_PREFIX = "straggler/"
+# EMA weight for per-rank step wall time riding the heartbeat payload: new
+# samples get 1/4 so one GC pause doesn't flag a rank, yet a genuinely
+# degraded chip crosses the detection factor within a handful of steps
+_STEP_EMA_ALPHA = 0.25
 
 
 def _env_float(name: str, default: float) -> float:
@@ -263,12 +268,22 @@ class HeartbeatLease:
         self._failing_since: Optional[float] = None
 
     # -- payload -----------------------------------------------------------
-    def note_step(self, step: int) -> None:
+    def note_step(self, step: int, dt: Optional[float] = None) -> None:
         """Stamp training progress into the lease (fed by TrainStep): a
-        monitor can now tell alive-but-stuck-in-step from dead."""
+        monitor can now tell alive-but-stuck-in-step from dead.  ``dt``
+        (this step's wall time, seconds) additionally maintains a
+        ``step_dt_ema`` field in the payload — the per-rank signal the
+        :class:`LeaseMonitor` compares against the gang median to flag a
+        slow (alive, beating, but degraded) rank.  No extra writes: the
+        stamp rides the existing beat."""
         with self._lock:
             self._payload["step"] = int(step)
             self._payload["step_ts"] = time.time()
+            if dt is not None and dt >= 0:
+                prev = self._payload.get("step_dt_ema")
+                self._payload["step_dt_ema"] = float(dt) if prev is None \
+                    else (1.0 - _STEP_EMA_ALPHA) * float(prev) + \
+                    _STEP_EMA_ALPHA * float(dt)
             self._dirty = True
 
     def update_payload(self, **fields) -> None:
@@ -349,6 +364,12 @@ class LeaseMonitor:
       the gang's freshest step stamp → **straggler** →
       ``fleet_straggler`` event + gauge (observed, not poisoned — a wedged
       collective is the CommWatchdog's to escalate);
+    - a fresh lease whose ``step_dt_ema`` payload (per-step wall time fed
+      by :meth:`HeartbeatLease.note_step`) exceeds the gang *median* by
+      ``slow_factor`` for ``slow_scans`` consecutive scans → **slow rank**
+      → ``fleet_straggler_slow`` event + ``slow_fn(rank, ema, median)``
+      (the straggler ladder's detect stage; relative to the median, so a
+      uniformly slow gang — big model, cold caches — never flags anyone);
     - gauges: ``fleet_live_ranks``, ``fleet_max_step``.
     """
 
@@ -356,7 +377,10 @@ class LeaseMonitor:
                  ttl: Optional[float] = None,
                  interval: Optional[float] = None,
                  straggler_after: Optional[float] = None,
-                 poison_fn: Optional[Callable[..., Any]] = None):
+                 slow_factor: Optional[float] = None,
+                 slow_scans: Optional[int] = None,
+                 poison_fn: Optional[Callable[..., Any]] = None,
+                 slow_fn: Optional[Callable[..., Any]] = None):
         self._kv = _adapt_kv(kv)
         self.world_size = int(world_size)
         self.ttl = float(ttl if ttl is not None
@@ -365,13 +389,23 @@ class LeaseMonitor:
         self.straggler_after = float(
             straggler_after if straggler_after is not None
             else _env_float("PADDLE_TPU_STRAGGLER_AFTER", 5.0 * self.ttl))
+        self.slow_factor = float(
+            slow_factor if slow_factor is not None
+            else _env_float("PADDLE_TPU_STRAGGLER_FACTOR", 2.0))
+        self.slow_scans = max(1, int(
+            slow_scans if slow_scans is not None
+            else _env_float("PADDLE_TPU_STRAGGLER_SCANS", 3)))
         self.poison_fn = poison_fn
+        self.slow_fn = slow_fn
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._poisoned_ranks: set = set()
         self._straggler_flagged: set = set()
+        self._slow_streak: Dict[int, int] = {}
+        self._slow_flagged: set = set()
         self.dead_ranks: List[int] = []
         self.stragglers: List[int] = []
+        self.slow_ranks: List[int] = []
 
     def _leases(self) -> Dict[int, dict]:
         out = {}
@@ -389,12 +423,13 @@ class LeaseMonitor:
         return out
 
     def scan_once(self) -> Dict[str, List[int]]:
-        """One pass; returns {"dead": [...], "stragglers": [...]} and emits
-        the corresponding events / poison writes."""
+        """One pass; returns {"dead": [...], "stragglers": [...],
+        "slow": [...]} and emits the corresponding events / poison
+        writes."""
         try:
             leases = self._leases()
         except Exception:
-            return {"dead": [], "stragglers": []}
+            return {"dead": [], "stragglers": [], "slow": []}
         now = time.time()
         dead, stragglers = [], []
         step_stamps = [d.get("step_ts") for d in leases.values()
@@ -432,8 +467,10 @@ class LeaseMonitor:
                                   behind_s=round(freshest_step - step_ts, 3))
             else:
                 self._straggler_flagged.discard(rank)
+        slow = self._scan_slow(leases, dead)
         self.dead_ranks = dead
         self.stragglers = stragglers
+        self.slow_ranks = slow
         _set_gauge("fleet_live_ranks", len(leases) - len(dead))
         _set_gauge("fleet_dead_ranks", len(dead))
         # the job rollup cross-checks its step-skew straggler against
@@ -442,10 +479,61 @@ class LeaseMonitor:
         _set_gauge("fleet_straggler_count", len(stragglers))
         if stragglers:
             _set_gauge("fleet_straggler_rank", stragglers[0])
+        _set_gauge("fleet_slow_rank_count", len(slow))
+        if slow:
+            _set_gauge("fleet_slow_rank", slow[0])
         steps = [d.get("step") or 0 for d in leases.values()]
         if steps:
             _set_gauge("fleet_max_step", max(steps))
-        return {"dead": dead, "stragglers": stragglers}
+        return {"dead": dead, "stragglers": stragglers, "slow": slow}
+
+    def _scan_slow(self, leases: Dict[int, dict],
+                   dead: List[int]) -> List[int]:
+        """EMA-vs-gang-median slow-rank pass over fresh leases.  Flags a
+        rank only after ``slow_scans`` CONSECUTIVE over-factor scans (a
+        one-scan spike — host GC, page-cache miss — resets nothing but
+        its own streak), un-flags as soon as the rank drops back under
+        the factor, and never flags when fewer than 3 ranks publish an
+        EMA (no meaningful median)."""
+        emas = {r: d.get("step_dt_ema") for r, d in leases.items()
+                if r not in dead and isinstance(
+                    d.get("step_dt_ema"), (int, float))}
+        slow: List[int] = []
+        vals = sorted(float(v) for v in emas.values())
+        if len(vals) < 3:
+            self._slow_streak.clear()
+            return slow
+        mid = len(vals) // 2
+        median = vals[mid] if len(vals) % 2 else \
+            0.5 * (vals[mid - 1] + vals[mid])
+        for rank in sorted(emas):
+            ema = float(emas[rank])
+            if median > 0 and ema > self.slow_factor * median:
+                self._slow_streak[rank] = self._slow_streak.get(rank, 0) + 1
+            else:
+                self._slow_streak.pop(rank, None)
+                if rank in self._slow_flagged:
+                    self._slow_flagged.discard(rank)
+                    _record_event("fleet_straggler_recovered", f"rank{rank}",
+                                  rank=rank, ema_s=round(ema, 4),
+                                  median_s=round(median, 4))
+                continue
+            if self._slow_streak[rank] < self.slow_scans:
+                continue
+            slow.append(rank)
+            if rank not in self._slow_flagged:
+                self._slow_flagged.add(rank)
+                _record_event("fleet_straggler_slow", f"rank{rank}",
+                              rank=rank, ema_s=round(ema, 4),
+                              median_s=round(median, 4),
+                              factor=self.slow_factor,
+                              scans=self._slow_streak[rank])
+                if self.slow_fn is not None:
+                    try:
+                        self.slow_fn(rank, ema, median)
+                    except Exception:
+                        pass
+        return slow
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval):
@@ -534,7 +622,9 @@ class FaultDomain:
         if monitor:
             self.monitor = LeaseMonitor(
                 self._kv, world_size, ttl=self.hb_ttl,
-                straggler_after=straggler_after, poison_fn=self.poison)
+                straggler_after=straggler_after, poison_fn=self.poison,
+                slow_fn=self._note_slow_rank)
+        self._slow_seq = 0
         self._stop = threading.Event()
         self._poll_thread: Optional[threading.Thread] = None
         self._abort_lock = threading.Lock()
@@ -573,9 +663,34 @@ class FaultDomain:
             set_current(None)
 
     # -- step stamps -------------------------------------------------------
-    def note_step(self, step: int) -> None:
+    def note_step(self, step: int, dt: Optional[float] = None) -> None:
         if self.lease is not None:
-            self.lease.note_step(step)
+            self.lease.note_step(step, dt=dt)
+
+    # -- straggler flag (detect → confirm handoff) -------------------------
+    def _note_slow_rank(self, rank: int, ema: float, median: float) -> None:
+        """LeaseMonitor slow-rank callback: broadcast the flag through the
+        store so the FLAGGED rank (which does not run the monitor) learns
+        it must run the confirm/localize micro-probe at its next step
+        boundary (:mod:`...health.straggler` polls this key).  Last write
+        wins — re-flagging bumps ``seq`` so the probe protocol can tell a
+        new episode from a stale one."""
+        self._slow_seq += 1
+        doc = {"rank": int(rank), "ema_s": float(ema),
+               "median_s": float(median), "seq": self._slow_seq,
+               "epoch": self.epoch, "ts": time.time()}
+        try:
+            self._kv.put(f"{_STRAGGLER_PREFIX}flag/{self.epoch}", doc)
+        except Exception:
+            pass
+
+    def straggler_flag(self) -> Optional[dict]:
+        """The current epoch's slow-rank flag doc, or None."""
+        try:
+            doc = self._kv.get(f"{_STRAGGLER_PREFIX}flag/{self.epoch}")
+        except Exception:
+            return None
+        return doc if isinstance(doc, dict) else None
 
     def release_rank(self, rank: int) -> None:
         """Drop ``rank``'s heartbeat lease (launcher: a child that exited
@@ -591,13 +706,17 @@ class FaultDomain:
         return f"{_POISON_PREFIX}{self.epoch if epoch is None else epoch}"
 
     def poison(self, reason: str, culprit: Optional[int] = None,
-               detail: str = "") -> bool:
+               detail: str = "", **extra) -> bool:
         """Write this epoch's poison pill (first writer wins).  Returns True
         when OUR pill landed; either way the local abort path will fire on
-        the next poll."""
+        the next poll.  ``extra`` fields (JSON-serializable) ride along in
+        the pill — the link-slow path uses this to name the degraded
+        neighbor pair the relaunch must route around."""
         doc = {"reason": reason, "culprit": culprit, "detail": detail,
                "by": self.rank, "epoch": self.epoch, "ts": time.time(),
                "host": socket.gethostname(), "pid": os.getpid()}
+        if extra:
+            doc.update(extra)
         try:
             won = self._kv.put_if_absent(self._poison_key(), doc) \
                 if hasattr(self._kv, "put_if_absent") else (
@@ -740,12 +859,18 @@ def current() -> Optional[FaultDomain]:
     return _current
 
 
-def note_step_current(step: int) -> None:
-    """TrainStep hook: stamp step progress into this process's lease (no-op
+def note_step_current(step: int, dt: Optional[float] = None) -> None:
+    """TrainStep hook: stamp step progress (and optionally this step's wall
+    time, which feeds the slow-rank EMA) into this process's lease (no-op
     without an active domain — must stay cheap on the hot path)."""
     d = _current
     if d is not None:
-        d.note_step(step)
+        try:
+            d.note_step(step, dt=dt)
+        except TypeError:
+            # rolling upgrade: a domain (or test double) predating the
+            # step-time EMA takes only the step number
+            d.note_step(step)
 
 
 def poison_current(reason: str, culprit: Optional[int] = None,

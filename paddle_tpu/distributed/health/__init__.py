@@ -24,6 +24,13 @@ north-star 7B run needs the full detect → skip → rewind loop).
   transient-vs-sticky confirmation, ``sdc_suspect`` quarantine with a
   pre-corruption rewind window.
 
+- :class:`StragglerPolicy` / :class:`StragglerMonitor`
+  (:mod:`.straggler`) — the degraded-hardware ladder: per-rank step-time
+  EMA on the heartbeat payload, lease-monitor flag vs the gang median,
+  chip-vs-link micro-probe confirmation through the fleet store,
+  ``straggler_suspect`` exclude-relaunch or ``straggler_link``
+  device-order remap.
+
 Flight-recorder event kinds: ``health_skip`` (step withheld),
 ``health_anomaly`` (finite spike), ``health_rewind`` (escalation → dump →
 exit 101), ``health_fast_forward`` (restart skipped the poisoned window);
@@ -37,8 +44,13 @@ from .guard import REWIND_EXIT_CODE, HealthGuard, HealthPolicy  # noqa: F401
 from .ledger import LEDGER_NAME, HealthError, RewindLedger  # noqa: F401
 from .sdc import (SDC_POISON_REASON, SDCMonitor, SDCPolicy,  # noqa: F401
                   host_fingerprint, tree_fingerprints)
+from .straggler import (STRAGGLER_LINK_REASON,  # noqa: F401
+                        STRAGGLER_POISON_REASON, StragglerMonitor,
+                        StragglerPolicy)
 
 __all__ = ["SpikeDetector", "HealthGuard", "HealthPolicy", "HealthError",
            "RewindLedger", "LEDGER_NAME", "REWIND_EXIT_CODE",
            "SDCMonitor", "SDCPolicy", "SDC_POISON_REASON",
+           "StragglerMonitor", "StragglerPolicy",
+           "STRAGGLER_POISON_REASON", "STRAGGLER_LINK_REASON",
            "host_fingerprint", "tree_fingerprints"]

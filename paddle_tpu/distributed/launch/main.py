@@ -239,6 +239,23 @@ def launch(argv=None) -> int:
     if not live_slots:
         raise SystemExit("PADDLE_TPU_EXCLUDE_SLOTS excludes every slot")
     world = len(live_slots)
+    # link-slow remap (straggler ladder): the FleetSupervisor exports a
+    # device-order permutation of dense ranks so ring-neighbor traffic
+    # routes around a degraded ICI link — a launch-time remap, not a
+    # recompile (the ring programs take ring position as an input).  A
+    # permutation that does not match THIS epoch's world (stale after a
+    # later exclusion) is dropped loudly, never obeyed.
+    device_order: Optional[List[int]] = None
+    _ord = os.environ.get("PADDLE_TPU_DEVICE_ORDER", "").strip()
+    if _ord:
+        try:
+            device_order = [int(t) for t in _ord.split(",") if t.strip()]
+        except ValueError:
+            device_order = None
+        if device_order is not None and \
+                sorted(device_order) != list(range(world)):
+            _record_event("device_order_dropped", order=_ord, world=world)
+            device_order = None
     master = args.master
     node_rank = args.node_rank
     store = None
@@ -353,6 +370,10 @@ def launch(argv=None) -> int:
                     "PADDLE_TPU_FLEET_MONITOR": "launcher"}
                    if fleet_store_addr else {}),
                 **({"PADDLE_TPU_SNAP_STORE": snap.addr} if snap else {}),
+                # ring position under the (possibly remapped) device order:
+                # rank r sits at position order.index(r) of the ring
+                **({"PADDLE_TPU_RING_POS": str(device_order.index(rank))}
+                   if device_order else {}),
                 # multi-process-per-host (CPU fake cluster): keep each worker
                 # to its own slice of host devices
                 "PADDLE_NPROC_PER_NODE": str(nproc),
@@ -368,7 +389,9 @@ def launch(argv=None) -> int:
         _record_event("gang_start", world=world, node_rank=node_rank,
                       nproc=nproc,
                       epoch=int(os.environ.get("PADDLE_TPU_GANG_EPOCH", "0")),
-                      fault_domain=args.fault_domain)
+                      fault_domain=args.fault_domain,
+                      **({"device_order": device_order}
+                         if device_order else {}))
     except BaseException:
         # a failed spawn must not leave earlier workers blocked on a
         # rendezvous that will never complete

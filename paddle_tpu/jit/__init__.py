@@ -118,22 +118,35 @@ _MISSING = object()
 
 _stamped_paths: set = set()
 _fleet_fd_mod = None
+_last_fleet_step_t: Optional[float] = None
 
 
 def _note_fleet_step(step: int) -> None:
-    """Fleet fault domain probe: stamp per-step progress into this rank's
-    heartbeat lease, so the lease monitor can tell alive-but-stuck-in-step
-    (straggler) from dead. No-op (one global read) without an active
-    domain — must stay free on the hot path."""
-    global _fleet_fd_mod
+    """Fleet fault domain probe: stamp per-step progress AND inter-step
+    wall time into this rank's heartbeat lease, so the lease monitor can
+    tell alive-but-stuck-in-step (straggler) from dead and a chronically
+    slow rank from the gang median. No-op (one global read) without an
+    active domain — must stay free on the hot path; the wall-time delta
+    is two perf_counter reads, no device sync (async dispatch means the
+    inter-call gap reflects device pace once the pipeline saturates)."""
+    global _fleet_fd_mod, _last_fleet_step_t
     if _fleet_fd_mod is None:
         try:
             from ..distributed.fleet import fault_domain as _fleet_fd_mod
         except Exception:
             _fleet_fd_mod = False
     if _fleet_fd_mod:
+        now = time.perf_counter()
+        dt = None if _last_fleet_step_t is None \
+            else now - _last_fleet_step_t
+        _last_fleet_step_t = now
         try:
-            _fleet_fd_mod.note_step_current(step)
+            _fleet_fd_mod.note_step_current(step, dt=dt)
+        except TypeError:
+            try:
+                _fleet_fd_mod.note_step_current(step)
+            except Exception:
+                pass
         except Exception:
             pass
 

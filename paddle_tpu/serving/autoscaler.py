@@ -64,11 +64,14 @@ from .router import ReplicaStatus, Router
 __all__ = ["FleetSignals", "AutoscalePolicy", "Autoscaler"]
 
 SERVING, WARMING, DRAINING = "SERVING", "WARMING", "DRAINING"
+DEGRADED = "DEGRADED"
 
 
 def _state_of(st: ReplicaStatus) -> str:
     if st.draining:
         return DRAINING
+    if st.degraded:
+        return DEGRADED
     return WARMING if st.warming else SERVING
 
 
@@ -79,6 +82,7 @@ class FleetSignals:
     serving: int = 0
     warming: int = 0
     draining: int = 0
+    degraded: int = 0             # latency outliers, route-excluded
     queue_depth: int = 0          # summed over non-draining replicas
     active: int = 0
     capacity: int = 0
@@ -148,6 +152,7 @@ class AutoscalePolicy:
             return "out", ("overload_shed" if pressure else "occupancy_high")
         if sig.occupancy <= self.down_thresh and not pressure \
                 and sig.warming == 0 and sig.draining == 0 \
+                and sig.degraded == 0 \
                 and live > self.min_replicas:
             # never shrink while capacity is still arriving (warming) or
             # leaving (a drain in flight): one membership change at a time
@@ -226,6 +231,11 @@ class Autoscaler:
             if st.draining:
                 sig.draining += 1
                 continue
+            if st.degraded:
+                # route-excluded pending probe: its queue/capacity are not
+                # admit slots right now, so they stay out of occupancy
+                sig.degraded += 1
+                continue
             if st.warming:
                 sig.warming += 1
             else:
@@ -299,7 +309,8 @@ class Autoscaler:
 
     def _scale_in(self, sig: FleetSignals, reason: str) -> Optional[str]:
         victims = [st for st in sig.statuses
-                   if not st.draining and not st.warming]
+                   if not st.draining and not st.warming
+                   and not st.degraded]
         if len(victims) <= self.policy.min_replicas:
             return None
         victim = min(victims, key=lambda r: (r.load, r.name))
@@ -408,7 +419,8 @@ class Autoscaler:
 
     # -- observability -----------------------------------------------------
     def _publish(self, sig: FleetSignals) -> None:
-        self.meter.set_fleet_states(sig.serving, sig.warming, sig.draining)
+        self.meter.set_fleet_states(sig.serving, sig.warming, sig.draining,
+                                    sig.degraded)
         if self.depot is None:
             return
         doc = {"src": self.src, "wall_time": self._wall(),
@@ -420,7 +432,7 @@ class Autoscaler:
 
     def autoscale_doc(self, sig: FleetSignals) -> dict:
         return {"serving": sig.serving, "warming": sig.warming,
-                "draining": sig.draining,
+                "draining": sig.draining, "degraded": sig.degraded,
                 "occupancy": round(sig.occupancy, 4),
                 "queue_depth": sig.queue_depth,
                 "scale_out_total": self.scale_outs,

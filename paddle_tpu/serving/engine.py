@@ -235,6 +235,10 @@ class ServingEngine:
             ServingJournal(journal, ship=journal_ship) \
             if isinstance(journal, str) else journal
         self._on_token = on_token
+        # per-replica chaos scope for the "slow_serve" seam: the fleet
+        # layer stamps the replica name here so a degraded-hardware fault
+        # can target ONE replica even when several share the process
+        self.fault_scope = ""
         self._lint = (os.environ.get("PADDLE_TPU_SERVE_LINT", "1") != "0"
                       if lint is None else bool(lint))
 
@@ -809,6 +813,7 @@ class ServingEngine:
                 self._retire_if_done(r)
             return
         _faults.fire("serve_decode", f"step{self.steps_total}")
+        _faults.fire("slow_serve", f"{self.fault_scope}/decode")
         logits = self._run_decode(jnp.asarray(tokens),
                                   jnp.asarray(positions),
                                   jnp.asarray(tables),

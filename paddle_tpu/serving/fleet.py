@@ -64,6 +64,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
+from ..distributed.checkpoint import faults as _faults
 from ..distributed.checkpoint.replicator import (FencedEpoch, SnapshotClient,
                                                  _recv, _send)
 from ..distributed.fleet.fault_domain import (HeartbeatLease, _adapt_kv,
@@ -318,15 +319,36 @@ def _engine_status(engine: ServingEngine) -> dict:
             "summary": engine.meter.summary()}
 
 
+def _decode_probe(scope: str, iters: int = 3) -> float:
+    """Out-of-band decode-speed micro-probe: best-of-``iters`` timing of a
+    fixed-size memory touch, routed through the ``slow_serve`` chaos seam
+    at ``<scope>/probe`` so an injected replica slowdown shows up here the
+    same way it shows up in the token stream.  The frontend compares a
+    degraded replica's probe against a healthy reference to decide
+    re-admission — an absolute measurement would drown in host noise."""
+    buf = bytes(1 << 20)
+    best: Optional[float] = None
+    for _ in range(max(1, int(iters))):
+        t0 = time.perf_counter()
+        _faults.fire("slow_serve", f"{scope}/probe")
+        bytearray(buf)
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return float(best)
+
+
 class ReplicaFlags:
     """Replica-local lifecycle flags shared between the command server
     (which flips them: ``retire`` sets :attr:`draining`) and the status
     loop (which publishes them onto the lease) — the lease payload is how
     EVERY frontend learns to route-exclude a draining replica, not just
-    the one that asked for the drain."""
+    the one that asked for the drain.  ``degraded`` works the same way
+    for the latency-outlier ejection: the frontend that detected the
+    outlier flips it, the lease publishes it fleet-wide."""
 
     def __init__(self):
         self.draining = False
+        self.degraded = False
 
 
 class _StatusLoop(threading.Thread):
@@ -346,11 +368,14 @@ class _StatusLoop(threading.Thread):
 
     def publish_once(self) -> None:
         st = _engine_status(self._engine)
+        ema = self._engine.meter.tpot_ema_s
         self._lease.update_payload(
             queue_depth=st["queue_depth"], active=st["active"],
             est_first_token_s=st["est_first_token_s"],
+            tpot_ema_ms=None if ema is None else ema * 1e3,
             warming=self._engine.first_step_wall is None,
-            draining=bool(self._flags.draining) if self._flags else False)
+            draining=bool(self._flags.draining) if self._flags else False,
+            degraded=bool(self._flags.degraded) if self._flags else False)
 
     def run(self) -> None:
         while not self._stop.wait(self._interval):
@@ -379,6 +404,9 @@ class EngineReplica:
             model, journal=jroot,
             journal_ship=JournalShipper(depot, self.name, self.epoch),
             on_token=on_token, **(engine_kw or {}))
+        # per-replica chaos scope: in-process replicas share the global
+        # fault table, so a "slow_serve" spec targets ONE replica by path
+        self.engine.fault_scope = self.name
         self._start_lease = start_lease
         self.flags = ReplicaFlags()
         self.lease = HeartbeatLease(
@@ -456,6 +484,22 @@ class EngineReplica:
     def unretire(self) -> None:
         self.flags.draining = False
 
+    def probe(self) -> float:
+        return _decode_probe(self.name)
+
+    def degrade(self) -> None:
+        """Latency-outlier ejection: mark DEGRADED on the lease so every
+        frontend route-excludes this replica (active work keeps running;
+        queued work is the ejecting frontend's to re-home)."""
+        self.flags.degraded = True
+        if self._start_lease:
+            self._status.publish_once()
+
+    def undegrade(self) -> None:
+        self.flags.degraded = False
+        if self._start_lease:
+            self._status.publish_once()
+
     def close(self) -> None:
         pass
 
@@ -497,7 +541,8 @@ class ReplicaServer(_FramedServer):
     def _cmd_status(self, head, payload):
         return dict(_engine_status(self.engine), ok=True,
                     warming=self.engine.first_step_wall is None,
-                    draining=bool(self.flags.draining)), b""
+                    draining=bool(self.flags.draining),
+                    degraded=bool(self.flags.degraded)), b""
 
     def _cmd_drain(self, head, payload):
         return {"ok": True, "handback": self.engine.handback_queued()}, b""
@@ -517,6 +562,22 @@ class ReplicaServer(_FramedServer):
         # aborted scale-in (the handed-back work found no other home):
         # the replica goes back to taking traffic
         self.flags.draining = False
+        return {"ok": True}, b""
+
+    def _cmd_probe(self, head, payload):
+        return {"ok": True,
+                "probe_s": _decode_probe(self.replica_name)}, b""
+
+    def _cmd_degrade(self, head, payload):
+        self.flags.degraded = True
+        if self._on_retire is not None:   # same fast-publish hook: the
+            self._on_retire()             # lease must show DEGRADED now
+        return {"ok": True}, b""
+
+    def _cmd_undegrade(self, head, payload):
+        self.flags.degraded = False
+        if self._on_retire is not None:
+            self._on_retire()
         return {"ok": True}, b""
 
     def _cmd_stop(self, head, payload):
@@ -578,6 +639,16 @@ class RemoteReplica:
     def unretire(self) -> None:
         self._client._call({"cmd": "unretire"})
 
+    def probe(self) -> float:
+        resp, _ = self._client._call({"cmd": "probe"})
+        return float(resp.get("probe_s", 0.0))
+
+    def degrade(self) -> None:
+        self._client._call({"cmd": "degrade"})
+
+    def undegrade(self) -> None:
+        self._client._call({"cmd": "undegrade"})
+
     def stop_replica(self) -> None:
         self._client._call({"cmd": "stop"})
 
@@ -632,6 +703,7 @@ def run_replica(model, name: Optional[str] = None, *,
     engine = ServingEngine(model, journal=jroot,
                            journal_ship=JournalShipper(depot, name, epoch),
                            on_token=pusher, **(engine_kw or {}))
+    engine.fault_scope = name
     flags = ReplicaFlags()
     server = ReplicaServer(engine, name, host=host, flags=flags)
     t = fleet_ttl(ttl)
@@ -712,6 +784,16 @@ class ServingFrontend:
         self._epochs: Dict[str, int] = {}       # last epoch routed to
         self._fenced: Dict[str, int] = {}       # name -> last fenced epoch
         self._draining: Set[str] = set()
+        # latency-outlier ejection (degraded-hardware defense): a replica
+        # whose published EWMA TPOT exceeds the fleet median by the
+        # straggler factor for N consecutive scans is marked DEGRADED and
+        # route-excluded like DRAINING; re-admitted after a clean probe
+        self._degraded: Set[str] = set()
+        self._tpot_streak: Dict[str, int] = {}
+        self._degrade_factor = max(
+            1.0, _env_float("PADDLE_TPU_STRAGGLER_FACTOR", 2.0))
+        self._degrade_scans = max(
+            1, int(_env_float("PADDLE_TPU_STRAGGLER_SCANS", 3)))
         self._orphans: List[Tuple[int, dict, List[int]]] = []
         self.meter = FleetMeter()
         self._scan_thread: Optional[threading.Thread] = None
@@ -743,6 +825,7 @@ class ServingFrontend:
             doc = self._kv.get(key) or {}
             st = ReplicaStatus.from_doc(name, doc)
             st.draining = st.draining or name in self._draining
+            st.degraded = st.degraded or name in self._degraded
             out[name] = (st, age, doc)
         return out
 
@@ -861,7 +944,8 @@ class ServingFrontend:
         replicas, retry orphaned re-submissions.  Returns the replica
         names failed over in this pass."""
         failed: List[str] = []
-        for name, (st, age, doc) in sorted(self._scan().items()):
+        snap = self._scan()
+        for name, (st, age, doc) in sorted(snap.items()):
             expired = lease_expired(age, float(doc.get("ttl", self.ttl)))
             prev = self._epochs.get(name)
             if expired:
@@ -882,8 +966,113 @@ class ServingFrontend:
                     self.attach(RemoteReplica(name, st.address))
                 except (OSError, ValueError):
                     pass
+        self._check_degraded(snap)
         self._retry_orphans()
         return failed
+
+    # -- latency-outlier ejection (degraded-hardware defense) --------------
+    def _check_degraded(self, snap) -> None:
+        """One ejection/re-admission pass over the scan snapshot: compare
+        each live replica's published EWMA TPOT against the fleet median
+        (median-relative, so a uniformly slow fleet never ejects anyone),
+        eject after N consecutive outlier scans, and probe already-ejected
+        replicas for re-admission."""
+        live = {name for name, (st, age, doc) in snap.items()
+                if not lease_expired(age, float(doc.get("ttl", self.ttl)))}
+        for gone in list(self._degraded - live):
+            self._degraded.discard(gone)    # dead: failover owns it now
+        for gone in list(set(self._tpot_streak) - live):
+            self._tpot_streak.pop(gone, None)
+        emas: Dict[str, float] = {}
+        for name in live:
+            st, _age, _doc = snap[name]
+            if st.draining or name in self._degraded:
+                continue
+            if isinstance(st.tpot_ema_ms, (int, float)):
+                emas[name] = float(st.tpot_ema_ms)
+        for name in list(self._degraded & live):
+            self._try_readmit(name, emas)
+        if len(emas) < 3:
+            # no meaningful median from fewer than three measurements —
+            # never eject on a two-horse race
+            self._tpot_streak.clear()
+            return
+        vals = sorted(emas.values())
+        median = vals[len(vals) // 2]
+        for name, ema in sorted(emas.items()):
+            if median > 0 and ema > self._degrade_factor * median:
+                self._tpot_streak[name] = self._tpot_streak.get(name, 0) + 1
+                if self._tpot_streak[name] >= self._degrade_scans:
+                    self._tpot_streak.pop(name, None)
+                    self.eject_degraded(name, tpot_ema_ms=ema,
+                                        median_ms=median)
+            else:
+                self._tpot_streak.pop(name, None)
+
+    def eject_degraded(self, name: str, *,
+                       tpot_ema_ms: Optional[float] = None,
+                       median_ms: Optional[float] = None) -> int:
+        """Mark ``name`` DEGRADED (locally at once, on its lease via the
+        replica flag so every frontend sees it) and re-home its
+        queued-but-unstarted work exactly like a drain; active requests
+        keep running there.  Returns the number re-homed."""
+        with self._lock:
+            self._degraded.add(name)
+            h = self.handles.get(name)
+        if h is not None:
+            try:
+                h.degrade()
+            except (OSError, ConnectionError, AttributeError):
+                pass   # local route-exclusion still stands
+        moved = self._rehome_queued(name, h)
+        self.meter.degrade(name, tpot_ema_ms=tpot_ema_ms,
+                           median_ms=median_ms)
+        _event("serve_degraded", name, moved=moved,
+               tpot_ema_ms=tpot_ema_ms, median_ms=median_ms)
+        return moved
+
+    def _try_readmit(self, name: str,
+                     emas: Dict[str, float]) -> bool:
+        """Probe a degraded replica against a healthy reference; a clean
+        probe (within the straggler factor of the reference) re-admits
+        it to routing."""
+        with self._lock:
+            h = self.handles.get(name)
+            healthy = [n for n in emas if n in self.handles]
+        if h is None or not hasattr(h, "probe"):
+            return False
+        ref_s = None
+        for other in sorted(healthy):
+            oh = self.handles.get(other)
+            if oh is None or not hasattr(oh, "probe"):
+                continue
+            try:
+                ref_s = oh.probe()
+                break
+            except (OSError, ConnectionError):
+                continue
+        if ref_s is None:
+            return False
+        try:
+            probe_s = h.probe()
+        except (OSError, ConnectionError):
+            return False
+        # relative test with a floor: host noise on a microsecond probe
+        # must not read as degradation
+        if probe_s > self._degrade_factor * max(ref_s, 1e-3):
+            _event("serve_probe_dirty", name,
+                   probe_s=round(probe_s, 6), ref_s=round(ref_s, 6))
+            return False
+        with self._lock:
+            self._degraded.discard(name)
+        try:
+            h.undegrade()
+        except (OSError, ConnectionError, AttributeError):
+            pass
+        self.meter.readmit(name)
+        _event("serve_readmitted", name, probe_s=round(probe_s, 6),
+               ref_s=round(ref_s, 6))
+        return True
 
     def failover(self, name: str, epoch: int) -> int:
         """Fence ``name``'s incarnation ``epoch`` at the depot, fold its
@@ -983,10 +1172,23 @@ class ServingFrontend:
         with self._lock:
             self._draining.add(name)
             h = self.handles.get(name)
+        moved = self._rehome_queued(name, h)
+        self.meter.handback(name, moved)
+        _event("serve_drain", name, moved=moved)
+        return moved
+
+    def _rehome_queued(self, name: str, h) -> int:
+        """Hand back ``name``'s queued-but-unstarted work and re-route it
+        on the other replicas (the drain path; the degraded ejection
+        re-homes through the same seam)."""
         if h is None:
             return 0
+        try:
+            handback = h.drain()
+        except (OSError, ConnectionError):
+            return 0
         moved = 0
-        for d in h.drain():
+        for d in handback:
             rid = int(d["rid"])
             desc = {"prompt": d["prompt"],
                     "max_new_tokens": d["max_new_tokens"],
@@ -996,8 +1198,6 @@ class ServingFrontend:
                     "trace_id": d.get("trace_id")}
             if self._replay_one(rid, desc, [], exclude={name}):
                 moved += 1
-        self.meter.handback(name, moved)
-        _event("serve_drain", name, moved=moved)
         return moved
 
     def undrain(self, name: str) -> None:

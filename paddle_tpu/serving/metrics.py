@@ -84,6 +84,12 @@ class RequestClock:
         return self.finish_t - self.submit_t
 
 
+# EWMA smoothing for the per-replica TPOT trend the fleet frontend's
+# latency-outlier ejection reads; matches the straggler detector's
+# step-time alpha so both ladders react on the same horizon
+_TPOT_EMA_ALPHA = 0.25
+
+
 def _pct(xs: List[float], q: float) -> Optional[float]:
     if not xs:
         return None
@@ -120,6 +126,10 @@ class SLOMeter:
         self.spec_verify_steps = 0
         self.spec_rows_total = 0
         self.kv_bytes_per_token: Optional[float] = None
+        # per-replica decode-speed trend: EWMA of finished requests' TPOT.
+        # The fleet frontend compares this against the fleet median to
+        # eject a degraded (slow-chip) replica from routing.
+        self.tpot_ema_s: Optional[float] = None
         # TTFT/TPOT/latency histograms (telemetry.aggregator.Histogram):
         # mergeable bucket counts the MetricsPusher ships to the depot so
         # the fleet p99 is computed from summed buckets, never averaged
@@ -277,6 +287,10 @@ class SLOMeter:
                              miss))
         if c.tpot_s is not None:
             self._observe("tpot_s", c.tpot_s)
+            self.tpot_ema_s = c.tpot_s if self.tpot_ema_s is None else (
+                (1.0 - _TPOT_EMA_ALPHA) * self.tpot_ema_s
+                + _TPOT_EMA_ALPHA * c.tpot_s)
+            set_gauge("serving.tpot_ema_ms", self.tpot_ema_s * 1e3)
         if c.latency_s is not None:
             self._observe("latency_s", c.latency_s)
         # traced span chain complete?  (submit span always exists; admit +
@@ -404,6 +418,8 @@ class SLOMeter:
                 round(self.effective_tokens_per_step(), 4)
                 if self.spec_verify_steps else None),
             "kv_bytes_per_token": self.kv_bytes_per_token,
+            "tpot_ema_ms": _r(None if self.tpot_ema_s is None
+                              else self.tpot_ema_s * 1e3),
         }
 
 
@@ -429,6 +445,9 @@ class FleetMeter:
         self.serving_replicas = 0
         self.warming_replicas = 0
         self.draining_replicas = 0
+        self.degraded_replicas = 0
+        self.degraded_ejects_total = 0
+        self.degraded_readmits_total = 0
         self.last_autoscale: Optional[Dict[str, object]] = None
 
     def set_live_replicas(self, n: int) -> None:
@@ -439,15 +458,35 @@ class FleetMeter:
         set_gauge(f"serving.fleet_queue_depth.{name}", float(depth))
 
     def set_fleet_states(self, serving: int, warming: int,
-                         draining: int) -> None:
-        """Per-state replica gauges (SERVING / WARMING / DRAINING), as the
-        autoscaler's lease scan counts them."""
+                         draining: int, degraded: int = 0) -> None:
+        """Per-state replica gauges (SERVING / WARMING / DRAINING /
+        DEGRADED), as the autoscaler's lease scan counts them."""
         self.serving_replicas = int(serving)
         self.warming_replicas = int(warming)
         self.draining_replicas = int(draining)
+        self.degraded_replicas = int(degraded)
         set_gauge("serving.fleet_serving_replicas", float(serving))
         set_gauge("serving.fleet_warming_replicas", float(warming))
         set_gauge("serving.fleet_draining_replicas", float(draining))
+        set_gauge("serving.fleet_degraded_replicas", float(degraded))
+
+    def degrade(self, name: str, *, tpot_ema_ms: Optional[float],
+                median_ms: Optional[float]) -> None:
+        """One replica ejected from routing as a latency outlier (EWMA
+        TPOT over the fleet median by the straggler factor)."""
+        self.degraded_ejects_total += 1
+        bump("serving.fleet_degraded_ejects_total")
+        record_event("serve_fleet_degraded", str(name),
+                     tpot_ema_ms=tpot_ema_ms, median_ms=median_ms)
+
+    def readmit(self, name: str, *,
+                tpot_ema_ms: Optional[float] = None) -> None:
+        """A previously degraded replica whose probe came back clean
+        rejoins the routable pool."""
+        self.degraded_readmits_total += 1
+        bump("serving.fleet_degraded_readmits_total")
+        record_event("serve_fleet_readmit", str(name),
+                     tpot_ema_ms=tpot_ema_ms)
 
     def autoscale(self, direction: str, *, target: int,
                   reason: str) -> None:
@@ -491,4 +530,7 @@ class FleetMeter:
                 "serving_replicas": self.serving_replicas,
                 "warming_replicas": self.warming_replicas,
                 "draining_replicas": self.draining_replicas,
+                "degraded_replicas": self.degraded_replicas,
+                "degraded_ejects": self.degraded_ejects_total,
+                "degraded_readmits": self.degraded_readmits_total,
                 "last_autoscale": self.last_autoscale}

@@ -17,6 +17,10 @@ deadline-aware spill:
   is accounted, shedding here silently would not be.
 - **draining replicas** are never picked (see
   :meth:`fleet.ServingFrontend.drain`).
+- **degraded replicas** (latency outliers ejected by the frontend's
+  EWMA-TPOT-vs-fleet-median scan) are route-excluded exactly like
+  draining ones; they rejoin when the frontend re-admits them after a
+  clean probe.
 - **warming replicas** (scale-outs that have not completed a first
   step — their ``est_first_token_s`` is unmeasured and includes a cold
   checkpoint load) are excluded from deadline-bound spill the same way
@@ -49,6 +53,8 @@ class ReplicaStatus:
     epoch: int = 0                   # fencing incarnation
     draining: bool = False
     warming: bool = False            # no completed step yet (cold start)
+    degraded: bool = False           # latency outlier, route-excluded
+    tpot_ema_ms: Optional[float] = None   # decode-speed trend (EWMA)
     extra: dict = field(default_factory=dict)
 
     @property
@@ -65,7 +71,9 @@ class ReplicaStatus:
                    est_first_token_s=doc.get("est_first_token_s"),
                    epoch=int(doc.get("epoch", 0)),
                    draining=bool(doc.get("draining", False)),
-                   warming=bool(doc.get("warming", False)))
+                   warming=bool(doc.get("warming", False)),
+                   degraded=bool(doc.get("degraded", False)),
+                   tpot_ema_ms=doc.get("tpot_ema_ms"))
 
 
 class Router:
@@ -76,11 +84,12 @@ class Router:
              age_s: float = 0.0,
              trace_id: Optional[str] = None) -> Optional[ReplicaStatus]:
         """Best replica for one request, or ``None`` when no routable
-        replica exists at all (every one dead or draining).  With a
+        replica exists at all (every one dead, draining or degraded).
+        With a
         ``trace_id`` the decision is stamped into the flight recorder
         (``fleet_route``) so the merged black box shows WHY a request
         landed where it did."""
-        cands = [r for r in replicas if not r.draining]
+        cands = [r for r in replicas if not r.draining and not r.degraded]
         if not cands:
             return None
         budget = None

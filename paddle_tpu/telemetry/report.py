@@ -55,9 +55,11 @@ def dashboard_text(snapshots: Dict[str, Dict[str, Any]],
         serving = int(auto.get("serving") or 0)
         warming = int(auto.get("warming") or 0)
         draining = int(auto.get("draining") or 0)
+        degraded = int(auto.get("degraded") or 0)
         lines.append(
             f"autoscale: replicas={serving + warming} "
-            f"(SERVING={serving} WARMING={warming} DRAINING={draining}) "
+            f"(SERVING={serving} WARMING={warming} DRAINING={draining} "
+            f"DEGRADED={degraded}) "
             f"occupancy={_fmt(auto.get('occupancy'))} "
             f"out={auto.get('scale_out_total', 0)} "
             f"in={auto.get('scale_in_total', 0)}")
@@ -92,7 +94,8 @@ def dashboard_text(snapshots: Dict[str, Dict[str, Any]],
                 f"  {src}: req/s={_fmt(slo.get('requests_per_sec'))} "
                 f"finished={_fmt(slo.get('requests_finished'))} "
                 f"p99 ttft={_fmt(slo.get('ttft_ms_p99'))}ms "
-                f"latency={_fmt(slo.get('latency_ms_p99'))}ms")
+                f"latency={_fmt(slo.get('latency_ms_p99'))}ms "
+                f"tpot_ema={_fmt(slo.get('tpot_ema_ms'))}ms")
         if step:
             lines.append(
                 f"  {src}: steps={_fmt(step.get('steps'))} "
@@ -114,16 +117,19 @@ def _smoke_snapshots() -> Dict[str, Dict[str, Any]]:
             slo_summary={"requests_per_sec": 2.0 + i,
                          "requests_finished": 10 * (i + 1),
                          "requests_shed": 0, "requests_rejected": 0,
-                         "ttft_ms_p99": 4.0 + i, "latency_ms_p99": 40.0},
+                         "ttft_ms_p99": 4.0 + i, "latency_ms_p99": 40.0,
+                         "tpot_ema_ms": 5.0 + 10.0 * i},
             hists={"ttft_s": h},
             extra={"replica": name}))
     depot.metrics_push("autoscaler", local_snapshot(extra={
         "autoscale": {"serving": 1, "warming": 1, "draining": 0,
+                      "degraded": 1,
                       "occupancy": 0.62, "queue_depth": 5,
                       "scale_out_total": 1, "scale_in_total": 0,
                       "last_decision": {"direction": "out", "target": 2,
                                         "reason": "occupancy_high"},
-                      "states": {"r0": "SERVING", "r1": "WARMING"}}}))
+                      "states": {"r0": "SERVING", "r1": "WARMING",
+                                 "r2": "DEGRADED"}}}))
     depot.metrics_push("rank0", local_snapshot(
         step_summary={"steps": 8, "total_s": 4.0, "mfu": 0.41},
         extra={"rank": 0}))

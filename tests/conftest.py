@@ -60,6 +60,15 @@ for _k, _v in (("PADDLE_TPU_SP", "1"),
                ("PADDLE_TPU_SDC_EVERY", "2"),
                ("PADDLE_TPU_SDC_CONFIRM", "2"),
                ("PADDLE_TPU_SDC_VOTE_TIMEOUT", "5"),
+               # degraded-hardware defense: production cadence (flag after
+               # 3 monitor scans, poll the flag every 8 steps, 10s probe
+               # deadline) would leave the slow-rank chaos e2e waiting on
+               # clocks — flag after 2 scans, poll every 2 steps, and give
+               # up on an absent probe partner fast
+               ("PADDLE_TPU_STRAGGLER_FACTOR", "2.0"),
+               ("PADDLE_TPU_STRAGGLER_SCANS", "2"),
+               ("PADDLE_TPU_STRAGGLER_EVERY", "2"),
+               ("PADDLE_TPU_STRAGGLER_PROBE_TIMEOUT", "5"),
                # serving suite: production page/pool sizes (16-token pages,
                # 64-page arenas) allocate real HBM-scale buffers — pin the
                # paged-KV geometry down so the CPU tier-1 engines compile

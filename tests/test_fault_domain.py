@@ -126,7 +126,7 @@ class TestLeaseMonitor:
         assert _wait_for(  # both stamps visible in the store
             lambda: (json.loads(master.get("hb/0")).get("step") == 1
                      and json.loads(master.get("hb/1")).get("step") == 1))
-        assert mon.scan_once() == {"dead": [], "stragglers": []}
+        assert mon.scan_once() == {"dead": [], "stragglers": [], "slow": []}
         # rank 1 keeps heartbeating but stops stepping → straggler,
         # observed not poisoned; rank 0 keeps stepping.  Event-gated: step
         # h0 inside the poll until the monitor flags exactly rank 1.

@@ -1,0 +1,516 @@
+"""Serving half of the degraded-hardware defense (ISSUE 18): latency-
+outlier ejection on the lease-routed fleet.
+
+The training ladder's shape, mirrored onto serving: a replica whose
+published EWMA TPOT exceeds the fleet MEDIAN by the straggler factor for
+N consecutive frontend scans is marked DEGRADED on its lease (every
+frontend route-excludes it exactly like DRAINING), its queued-but-
+unstarted work is re-homed through the drain seam, and it is re-admitted
+only after an out-of-band decode micro-probe comes back clean against a
+healthy reference.  Median-relative means a uniformly slow fleet never
+ejects anyone, and fewer than three EWMA measurements never yield a
+median.
+
+The chaos e2e drives the real engine stack: an armed ``slow_serve``
+delay fault makes ONE in-process replica ~slow mid-stream, the frontend
+ejects it, the re-homed streams finish token-exact vs the serial oracle
+(exactly-once through the sink dedup), a dirty probe keeps the replica
+out while the fault is armed, and disarming it re-admits the replica.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.checkpoint import faults
+from paddle_tpu.distributed.checkpoint.replicator import (SnapshotClient,
+                                                          SnapshotStore)
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.serving import TokenSink
+from paddle_tpu.serving.autoscaler import (DEGRADED, AutoscalePolicy,
+                                           Autoscaler, FleetSignals,
+                                           _state_of)
+from paddle_tpu.serving.fleet import (FLEET_HB_PREFIX, EngineReplica,
+                                      ServingFrontend)
+from paddle_tpu.serving.metrics import FleetMeter
+from paddle_tpu.serving.router import ReplicaStatus, Router
+from paddle_tpu.telemetry import report
+
+pytestmark = [pytest.mark.straggler, pytest.mark.serving]
+
+ENGINE_KW = dict(max_batch=3, page_tokens=8, num_pages=24,
+                 max_pages_per_seq=6)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(3)
+    cfg = llama_tiny(num_hidden_layers=2, vocab_size=96,
+                     max_position_embeddings=128)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture
+def depot():
+    store = SnapshotStore(host="127.0.0.1")
+    client = SnapshotClient("127.0.0.1", store.port)
+    yield client
+    client.close()
+    store.close()
+
+
+def _solo(model, prompt, max_new, eos=None):
+    ids, _ = model.generate(paddle.to_tensor(np.asarray(prompt)[None]),
+                            max_new_tokens=max_new, eos_token_id=eos,
+                            pad_token_id=0 if eos is not None else None)
+    return ids.numpy()[0]
+
+
+class KV:
+    """Lease-table double with hand-set ages."""
+
+    def __init__(self):
+        self.data = {}
+        self.ages = {}
+
+    def put(self, k, v):
+        self.data[k] = v
+        self.ages[k] = 0.0
+
+    def get(self, k):
+        return self.data.get(k)
+
+    def touch(self, k):
+        self.ages[k] = 0.0
+
+    def delete(self, k):
+        self.data.pop(k, None)
+        self.ages.pop(k, None)
+
+    def keys(self, prefix=""):
+        return [k for k in self.data if k.startswith(prefix)]
+
+    def age(self, k):
+        return self.ages.get(k)
+
+
+class FakeHandle:
+    """Frontend-handle double with canned probe and drain payloads."""
+
+    def __init__(self, name, probe_s=0.01, handback=None):
+        self.name = name
+        self.probe_s = probe_s
+        self.handback = handback or []
+        self.submits = []
+        self.degrades = 0
+        self.undegrades = 0
+
+    def submit(self, prompt, max_new_tokens=64, eos_token_id=None, *,
+               deadline=None, rid=None, delivered_tokens=None, age_s=0.0,
+               trace_id=None):
+        self.submits.append(rid)
+        return rid
+
+    def status(self):
+        return {"queue_depth": 0, "active": 0, "finished": [], "shed": {}}
+
+    def drain(self):
+        out, self.handback = self.handback, []
+        return out
+
+    def probe(self):
+        return self.probe_s
+
+    def degrade(self):
+        self.degrades += 1
+
+    def undegrade(self):
+        self.undegrades += 1
+
+    def close(self):
+        pass
+
+
+def _lease(kv, name, *, tpot=None, draining=False, degraded=False,
+           age=0.0, ttl=1.0, qd=0, active=0, capacity=4, warming=False):
+    doc = {"name": name, "address": "inproc", "capacity": capacity,
+           "queue_depth": qd, "active": active, "est_first_token_s": 0.05,
+           "epoch": 1, "ttl": ttl, "draining": draining,
+           "degraded": degraded, "warming": warming}
+    if tpot is not None:
+        doc["tpot_ema_ms"] = tpot
+    kv.put(FLEET_HB_PREFIX + name, doc)
+    kv.ages[FLEET_HB_PREFIX + name] = age
+
+
+# ---------------------------------------------------------------------------
+class TestRouterDegradedExclusion:
+    def _st(self, name, **kw):
+        d = dict(address="inproc", capacity=4, queue_depth=0, active=0,
+                 est_first_token_s=0.1, epoch=1)
+        d.update(kw)
+        return ReplicaStatus(name=name, **d)
+
+    def test_degraded_never_picked(self):
+        r = Router()
+        picked = r.pick([self._st("a", degraded=True), self._st("b")])
+        assert picked.name == "b"
+        assert r.pick([self._st("a", degraded=True)]) is None
+
+    def test_order_skips_degraded(self):
+        r = Router()
+        sts = [self._st("a"), self._st("b", degraded=True),
+               self._st("c", draining=True)]
+        assert [s.name for s in r.order(sts, None)] == ["a"]
+
+    def test_status_doc_roundtrips_tpot_and_degraded(self):
+        st = ReplicaStatus.from_doc("x", {"tpot_ema_ms": 12.5,
+                                          "degraded": True})
+        assert st.tpot_ema_ms == 12.5 and st.degraded
+        assert ReplicaStatus.from_doc("y", {}).tpot_ema_ms is None
+
+
+# ---------------------------------------------------------------------------
+class TestDegradedDetection:
+    """Median-relative EWMA TPOT ejection, driven as pure scan passes."""
+
+    def _fe(self, kv):
+        return ServingFrontend(kv, object(), auto_attach=False)
+
+    def test_ejects_after_consecutive_outlier_scans(self, monkeypatch):
+        kv = KV()
+        fe = self._fe(kv)
+        hb = FakeHandle("b")
+        for n in ("a", "c", "d"):
+            fe.attach(FakeHandle(n))
+        fe.attach(hb)
+        for n, t in (("a", 20.0), ("b", 90.0), ("c", 22.0), ("d", 18.0)):
+            _lease(kv, n, tpot=t)
+        fe._check_degraded(fe._scan())          # streak 1: hysteresis
+        assert fe._degraded == set()
+        fe._check_degraded(fe._scan())          # streak 2 (conftest pin)
+        assert fe._degraded == {"b"}
+        assert hb.degrades == 1
+        assert fe.meter.degraded_ejects_total == 1
+        # already-degraded replicas leave the median pool: no double eject
+        # (the readmit probe would be tried, but b's probe is dirty here)
+        hb.probe_s = 1.0
+        fe._check_degraded(fe._scan())
+        assert fe.meter.degraded_ejects_total == 1
+
+    def test_single_scan_spike_resets_streak(self):
+        kv = KV()
+        fe = self._fe(kv)
+        for n in ("a", "b", "c"):
+            fe.attach(FakeHandle(n))
+        _lease(kv, "a", tpot=20.0)
+        _lease(kv, "b", tpot=90.0)
+        _lease(kv, "c", tpot=22.0)
+        fe._check_degraded(fe._scan())
+        _lease(kv, "b", tpot=21.0)              # back under the factor
+        fe._check_degraded(fe._scan())
+        _lease(kv, "b", tpot=90.0)
+        fe._check_degraded(fe._scan())          # streak restarts at 1
+        assert fe._degraded == set()
+        fe._check_degraded(fe._scan())
+        assert fe._degraded == {"b"}
+
+    def test_uniformly_slow_fleet_never_ejects(self):
+        kv = KV()
+        fe = self._fe(kv)
+        for n in ("a", "b", "c", "d"):
+            fe.attach(FakeHandle(n))
+            _lease(kv, n, tpot=400.0)           # big model: all equally slow
+        for _ in range(4):
+            fe._check_degraded(fe._scan())
+        assert fe._degraded == set()
+
+    def test_two_measurements_no_median_no_eject(self):
+        kv = KV()
+        fe = self._fe(kv)
+        fe.attach(FakeHandle("a"))
+        fe.attach(FakeHandle("b"))
+        _lease(kv, "a", tpot=10.0)
+        _lease(kv, "b", tpot=500.0)
+        for _ in range(4):
+            fe._check_degraded(fe._scan())
+        assert fe._degraded == set()
+
+    def test_draining_replica_exempt(self):
+        kv = KV()
+        fe = self._fe(kv)
+        for n in ("a", "b", "c", "d"):
+            fe.attach(FakeHandle(n))
+        # d is draining AND slow (it is busy finishing actives on the way
+        # out) — it must be neither ejected nor counted in the median
+        _lease(kv, "a", tpot=20.0)
+        _lease(kv, "b", tpot=21.0)
+        _lease(kv, "c", tpot=22.0)
+        _lease(kv, "d", tpot=900.0, draining=True)
+        for _ in range(3):
+            fe._check_degraded(fe._scan())
+        assert fe._degraded == set()
+
+    def test_dead_degraded_replica_forgotten(self):
+        kv = KV()
+        fe = self._fe(kv)
+        fe._degraded = {"b"}
+        fe._tpot_streak = {"b": 1, "zombie": 1}
+        _lease(kv, "a", tpot=20.0)
+        _lease(kv, "b", tpot=90.0, age=10.0)    # lease expired: failover's
+        fe._check_degraded(fe._scan())
+        assert fe._degraded == set()            # ...problem now, not ours
+        assert "zombie" not in fe._tpot_streak
+
+    def test_probe_readmits_only_when_clean(self):
+        kv = KV()
+        fe = self._fe(kv)
+        hb = FakeHandle("b", probe_s=0.05)      # dirty: 0.05 > 2 * 0.01
+        fe.attach(hb)
+        for n in ("a", "c", "d"):
+            fe.attach(FakeHandle(n, probe_s=0.01))
+            _lease(kv, n, tpot=20.0)
+        _lease(kv, "b", tpot=90.0, degraded=True)
+        fe._degraded = {"b"}
+        fe._check_degraded(fe._scan())
+        assert fe._degraded == {"b"}            # dirty probe: stays out
+        assert hb.undegrades == 0
+        hb.probe_s = 0.012                      # clean: within the factor
+        fe._check_degraded(fe._scan())
+        assert fe._degraded == set()
+        assert hb.undegrades == 1
+        assert fe.meter.degraded_readmits_total == 1
+
+    def test_eject_rehomes_queued_work_like_a_drain(self):
+        kv = KV()
+        fe = self._fe(kv)
+        handback = [{"rid": 7, "prompt": [1, 2], "max_new_tokens": 3,
+                     "eos_token_id": None, "deadline": None, "age_s": 0.0},
+                    {"rid": 8, "prompt": [3], "max_new_tokens": 2,
+                     "eos_token_id": None, "deadline": None, "age_s": 0.0}]
+        hb = FakeHandle("b", handback=handback)
+        ha = FakeHandle("a")
+        fe.attach(ha)
+        fe.attach(hb)
+        _lease(kv, "a")
+        _lease(kv, "b")
+        moved = fe.eject_degraded("b", tpot_ema_ms=90.0, median_ms=20.0)
+        assert moved == 2
+        # the ejected replica is excluded from its own re-route
+        assert ha.submits == [7, 8] and hb.submits == []
+        assert fe.assignments[7] == "a" and fe.assignments[8] == "a"
+        assert hb.degrades == 1
+
+
+# ---------------------------------------------------------------------------
+class TestFleetMeterDegraded:
+    def test_counters_and_summary(self):
+        m = FleetMeter()
+        m.set_fleet_states(2, 1, 0, degraded=1)
+        m.degrade("b", tpot_ema_ms=90.0, median_ms=20.0)
+        m.degrade("c", tpot_ema_ms=80.0, median_ms=20.0)
+        m.readmit("b")
+        s = m.summary()
+        assert s["degraded_replicas"] == 1
+        assert s["degraded_ejects"] == 2
+        assert s["degraded_readmits"] == 1
+        assert s["serving_replicas"] == 2 and s["warming_replicas"] == 1
+
+
+class TestAutoscalerDegraded:
+    def test_state_of_orders_draining_over_degraded(self):
+        st = ReplicaStatus(name="x", draining=True, degraded=True)
+        assert _state_of(st) == "DRAINING"
+        assert _state_of(ReplicaStatus(name="x", degraded=True,
+                                       warming=True)) == DEGRADED
+        assert _state_of(ReplicaStatus(name="x", warming=True)) == "WARMING"
+        assert _state_of(ReplicaStatus(name="x")) == "SERVING"
+
+    def test_degraded_vetoes_scale_in(self):
+        pol = AutoscalePolicy(min_replicas=1, max_replicas=4,
+                              up_thresh=0.8, down_thresh=0.25)
+        calm = FleetSignals(serving=3, queue_depth=0, active=0, capacity=12)
+        assert pol.decide(calm)[0] == "in"
+        # identical load, but one replica is route-excluded pending a
+        # probe: shrinking now could double-remove capacity
+        hurt = FleetSignals(serving=3, degraded=1, queue_depth=0,
+                            active=0, capacity=12)
+        assert pol.decide(hurt) == (None, "steady")
+
+    def test_signals_exclude_degraded_from_capacity(self):
+        kv = KV()
+        _lease(kv, "a", tpot=20.0, qd=2, active=1, capacity=4)
+        _lease(kv, "b", tpot=90.0, degraded=True, qd=3, active=2,
+               capacity=4)
+        _lease(kv, "c", tpot=21.0, qd=1, active=0, capacity=4)
+        sig = Autoscaler(kv).signals()
+        assert sig.serving == 2 and sig.degraded == 1
+        # the outlier's queue/active/capacity are not admit slots right
+        # now: they must not dilute (or inflate) occupancy
+        assert sig.capacity == 8
+        assert sig.queue_depth == 3 and sig.active == 1
+
+
+class TestReportDegraded:
+    def test_smoke_report_shows_degraded_and_tpot(self, capsys):
+        assert report.main(["--smoke"]) == 0
+        out = capsys.readouterr().out
+        assert "DEGRADED=1" in out
+        assert "tpot_ema=" in out
+
+
+# ---------------------------------------------------------------------------
+@pytest.mark.chaos
+class TestDegradedServingChaosE2E:
+    def _wait(self, fe, rids, timeout=90.0):
+        """Completion wait WITHOUT scan_once: scans are the test's to
+        place (an implicit scan could eject/readmit under our feet)."""
+        want = {int(r) for r in rids}
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if want <= fe.finished_rids():
+                return True
+            time.sleep(0.03)
+        return want <= fe.finished_rids()
+
+    def _seed_ema(self, fe, name, others, prompts, max_new=3):
+        """Serve a couple of requests on ONE replica (the rest marked
+        draining) so its lease publishes a numeric EWMA TPOT."""
+        fe._draining = set(others)
+        rids = [fe.submit(p, max_new_tokens=max_new) for p in prompts]
+        assert all(fe.assignments[r] == name for r in rids)
+        assert self._wait(fe, rids)
+        fe._draining = set()
+        return rids
+
+    def test_slow_replica_ejected_rehomed_readmitted(self, model, depot,
+                                                     tmp_path):
+        from paddle_tpu.serving.fleet import LocalKV
+
+        kv = LocalKV()
+        sink = TokenSink(str(tmp_path / "out.jsonl"))
+        fe = ServingFrontend(kv, depot, sink=sink, auto_attach=False)
+        reps = {}
+        for n in ("a", "b", "c"):
+            reps[n] = EngineReplica(n, model, store=kv, depot=depot,
+                                    journal_root=str(tmp_path / "j"),
+                                    on_token=fe.emit,
+                                    engine_kw=ENGINE_KW).start()
+            fe.attach(reps[n])
+        rng = np.random.default_rng(11)
+        P = lambda k: rng.integers(1, 96, k).astype(np.int32)
+
+        def submit_to(name, prompt, max_new):
+            fe._draining = {"a", "b", "c"} - {name}
+            rid = fe.submit(prompt, max_new_tokens=max_new)
+            fe._draining = set()
+            assert fe.assignments[rid] == name
+            return rid
+
+        # 1. seed every replica's EWMA with healthy traffic so the scan
+        #    has three numeric measurements (and nobody is warming).
+        #    First a warmup round: the first request's jit compile lands
+        #    in its TPOT (hundreds of ms vs ~2ms steady-state) and the
+        #    EWMA would carry that spike for dozens of requests — reset
+        #    the trend after warmup so the seeds measure steady decode.
+        warm = [submit_to(n, P(5), 3) for n in ("a", "b", "c")]
+        assert self._wait(fe, warm)
+        for n in ("a", "b", "c"):
+            reps[n].engine.meter.tpot_ema_s = None
+        done = []
+        for n in ("a", "b", "c"):
+            for _ in range(2):
+                done.append(submit_to(n, P(5), 3))
+        assert self._wait(fe, done)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            docs = [kv.get(FLEET_HB_PREFIX + n) or {} for n in "abc"]
+            if all(isinstance(d.get("tpot_ema_ms"), (int, float))
+                   for d in docs):
+                break
+            time.sleep(0.05)    # status beats every 0.1s publish the EMA
+        else:
+            pytest.fail("EWMA TPOT never published on the leases")
+
+        # 2. replica b's chip goes slow mid-stream: every decode step
+        #    (and its probe — same armed path family) eats a delay
+        spec = faults.FaultSpec(op="slow_serve", pattern="b/*",
+                                mode="delay", delay_s=0.15, times=-1)
+        with faults.scope(spec):
+            slow = [submit_to("b", P(6), 3) for _ in range(2)]
+            assert self._wait(fe, slow)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                doc = kv.get(FLEET_HB_PREFIX + "b") or {}
+                a_doc = kv.get(FLEET_HB_PREFIX + "a") or {}
+                if doc.get("tpot_ema_ms", 0) > \
+                        2.0 * max(a_doc.get("tpot_ema_ms", 1.0), 1.0):
+                    break
+                time.sleep(0.05)
+            else:
+                pytest.fail("slow replica's EWMA never cleared the factor")
+
+            # 3. pile queued work onto b, then let the scan catch it:
+            #    2 consecutive outlier scans (conftest pins SCANS=2) eject
+            backlog = []
+            fe._draining = {"a", "c"}
+            for _ in range(5):
+                backlog.append(fe.submit(P(4), max_new_tokens=4))
+            fe._draining = set()
+            for r in backlog:
+                assert fe.assignments[r] == "b"
+            fe.scan_once()
+            assert "b" not in fe._degraded      # hysteresis: one scan
+            fe.scan_once()
+            assert "b" in fe._degraded          # ejected
+            assert reps["b"].flags.degraded
+            assert fe.meter.degraded_ejects_total == 1
+            # queued-but-unstarted work left b through the drain seam
+            # (b's actives keep running there); anything moved runs on
+            # the survivors
+            moved = [r for r in backlog if fe.assignments[r] != "b"]
+            assert len(moved) >= 2
+            assert all(fe.assignments[r] in ("a", "c") for r in moved)
+            # route exclusion: new work cannot land on b
+            rid_new = fe.submit(P(5), max_new_tokens=3)
+            assert fe.assignments[rid_new] in ("a", "c")
+
+            assert self._wait(fe, backlog + [rid_new])
+
+            # 4. while the fault is armed the probe is dirty: b stays out
+            fe.scan_once()
+            assert "b" in fe._degraded
+
+        # 5. fault gone (repair/transient): the next probe is clean and b
+        #    is re-admitted to routing
+        fe.scan_once()
+        assert "b" not in fe._degraded
+        assert not reps["b"].flags.degraded
+        assert fe.meter.degraded_readmits_total == 1
+        # the un-degrade rides the lease: wait for the beat that clears
+        # the flag fleet-wide before routing to b again
+        deadline = time.monotonic() + 10
+        while (kv.get(FLEET_HB_PREFIX + "b") or {}).get("degraded"):
+            assert time.monotonic() < deadline, \
+                "lease never published the readmission"
+            time.sleep(0.05)
+        rid_back = submit_to("b", P(5), 3)
+        assert self._wait(fe, [rid_back])
+
+        for n in ("a", "b", "c"):
+            reps[n].stop()
+        fe.stop()
+        sink.close()
+
+        # exactly-once, token-exact across eject + re-home + readmit:
+        # the oracle runs AFTER the engines stop (model.generate traces
+        # are not safe to interleave with the serve threads' jits)
+        streams = TokenSink.collect(sink.path)
+        for rid, desc in fe.requests.items():
+            want = list(_solo(model, desc["prompt"],
+                              desc["max_new_tokens"]))
+            assert streams[rid] == want, rid
