@@ -1103,6 +1103,131 @@ def _measure_engine_kappa_silicon(cfg, micro: int, reps: int = 2) -> dict:
             "plain fwd+bwd on the real chip; pallas off both sides"}
 
 
+def _disagg_main(tp: int) -> None:
+    """--disagg mode (run under JAX_PLATFORMS=cpu with ``tp`` virtual
+    devices): the ISSUE-19 disaggregated-serving leg — a TP-sharded
+    decode engine with the prefix cache on, a separate prefill tier
+    streaming KV pages through a real framed-TCP depot, mixed traffic
+    sharing a system prompt, and a fault injected mid-KV-stream (the
+    in-process stand-in for SIGKILLing the prefill worker).  Gates:
+    prefix-cache hit rate > 0 with every output token-exact vs the
+    re-prefill oracle, exactly-once tokens across the worker death
+    (fence -> fold -> replay as a decode-local prefill), and p99 TTFT
+    inside the deadline.  Prints one JSON line."""
+    import time as _time
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.checkpoint import faults
+    from paddle_tpu.distributed.checkpoint.replicator import (SnapshotClient,
+                                                              SnapshotStore)
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.serving.disagg import DisaggCoordinator, PrefillWorker
+
+    cfg = llama_tiny(num_hidden_layers=2, vocab_size=96,
+                     max_position_embeddings=128)
+    kw = dict(max_batch=3, page_tokens=8, num_pages=32, max_pages_per_seq=6)
+
+    def fresh_model():
+        # shard_llama_params commits shardings onto the params IN PLACE,
+        # so the TP engine, the prefill engine and the oracle each get
+        # their own instance (same seed -> identical weights)
+        paddle.seed(3)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        return m
+
+    oracle = fresh_model()
+
+    def expect(prompt, mn):
+        ids, _ = oracle.generate(
+            paddle.to_tensor(np.asarray(prompt)[None]), max_new_tokens=mn)
+        return ids.numpy()[0]
+
+    dec = ServingEngine(fresh_model(), tp=tp, prefix_cache=True, **kw)
+    pre = ServingEngine(fresh_model(), **kw)
+    store = SnapshotStore(host="127.0.0.1")
+    depot = SnapshotClient("127.0.0.1", store.port)
+    try:
+        w = PrefillWorker(pre, depot, name="bench_pw0")
+        coord = DisaggCoordinator(dec, [w], depot, min_prompt=32)
+        rng = np.random.default_rng(11)
+        sys_prompt = list(rng.integers(1, cfg.vocab_size, 17))
+        t0 = _time.perf_counter()
+        # wave 1: decode-direct, seeds the prefix trie with the shared
+        # system prompt's full pages (import-path admissions skip the
+        # trie by design — only locally-prefilled pages are cacheable)
+        p0 = np.asarray(sys_prompt + list(rng.integers(1, 96, 6)),
+                        np.int32)
+        want = {coord.submit(p0, max_new_tokens=6): (p0, 6)}
+        outs = dict(dec.run())
+        # wave 2: two sharing short requests (prefix hits), one long
+        # request through the prefill tier, and one long request whose
+        # KV stream is killed mid-flight -> fence + decode-local replay
+        for n in (9, 4):
+            p = np.asarray(sys_prompt + list(rng.integers(1, 96, n)),
+                           np.int32)
+            want[coord.submit(p, max_new_tokens=6)] = (p, 6)
+        p_long = np.asarray(sys_prompt + list(rng.integers(1, 96, 20)),
+                            np.int32)
+        want[coord.submit(p_long, max_new_tokens=6)] = (p_long, 6)
+        p_kill = np.asarray(list(rng.integers(1, 96, 37)), np.int32)
+        with faults.inject(op="disagg_stream", pattern="*frame2*",
+                           mode="error", times=1):
+            want[coord.submit(p_kill, max_new_tokens=6)] = (p_kill, 6)
+        outs.update(dec.run())
+        wall = max(_time.perf_counter() - t0, 1e-9)
+
+        for rid, (p, mn) in want.items():
+            got, oracle_out = np.asarray(outs[rid]), expect(p, mn)
+            if got.shape != oracle_out.shape or (got != oracle_out).any():
+                raise RuntimeError(
+                    f"disagg leg rid {rid}: tokens diverge from the "
+                    f"re-prefill oracle ({got} vs {oracle_out})")
+        ps = dec.prefix.summary()
+        if not ps["hits"] or ps["hit_rate"] <= 0:
+            raise RuntimeError(
+                f"disagg leg prefix cache never hit on a shared-prefix "
+                f"trace: {ps}")
+        if coord.prefill_routed < 1:
+            raise RuntimeError(
+                "disagg leg routed nothing through the prefill tier")
+        if coord.fallbacks != 1:
+            raise RuntimeError(
+                f"disagg leg expected exactly 1 chaos fallback, got "
+                f"{coord.fallbacks} — the fence->fold->replay ladder "
+                "did not engage (or fired twice: not exactly-once)")
+        s = dec.meter.summary()
+        ttft_budget_s = 30.0
+        if s["ttft_ms_p99"] is not None and \
+                s["ttft_ms_p99"] > ttft_budget_s * 1e3:
+            raise RuntimeError(
+                f"disagg leg p99 TTFT {s['ttft_ms_p99']}ms blew the "
+                f"{ttft_budget_s}s deadline")
+        if dec.lint_report is not None and not dec.lint_report.ok:
+            raise RuntimeError("disagg leg TP decode donation lint FAIL")
+        dec.pool.check_leaks(allow_shared=True)
+        pre.pool.check_leaks()
+        print(json.dumps({
+            "requests": len(want), "wall_s": round(wall, 3),
+            "prefix_hit_rate": round(ps["hit_rate"], 4),
+            "prefix_tokens_saved": ps["tokens_saved"],
+            "tp_decode": dec.tp, "prefill_tier": 1,
+            "prefill_routed": coord.prefill_routed,
+            "decode_direct": coord.decode_direct,
+            "disagg_fallbacks": coord.fallbacks,
+            "ttft_ms_p99": s["ttft_ms_p99"],
+            "decode_compiles": dec._decode_compiles,
+            "donation_lint": "pass"}))
+    finally:
+        depot.close()
+        store.close()
+
+
 def bench_gpt_tp_pp(on_accel: bool, peak: float):
     """BASELINE.md config #3: GPT-1.3B under TP2xPP4 — time the per-chip
     slice on the real chip, derate by schedule tables / silicon-measured
@@ -2056,6 +2181,13 @@ def bench_serving(on_accel: bool, peak: float):
         raise RuntimeError("int8 serving leg generated nothing through "
                            "the dequant-fused decode path")
 
+    # --- disaggregated serving leg (ISSUE 19): TP=2 decode + separate
+    # prefill tier + prefix cache on a 2-virtual-device CPU subprocess
+    # (the in-process platform may be a single chip); the subprocess
+    # gates hit-rate > 0, token-exactness vs the re-prefill oracle,
+    # exactly-once across a mid-stream worker death, and p99 TTFT
+    disagg = _virtual_mesh_subprocess("--disagg", 2, 2)
+
     import jax
 
     from paddle_tpu.telemetry import PEAK_HBM_GBPS
@@ -2108,6 +2240,13 @@ def bench_serving(on_accel: bool, peak: float):
             "effective_tokens_per_step": spec_eff,
             "int8_bytes_per_page": eng_i8.pool.bytes_per_page,
             "bf16_bytes_per_page": eng.pool.bytes_per_page,
+            "prefix_hit_rate": disagg["prefix_hit_rate"],
+            "prefix_tokens_saved": disagg["prefix_tokens_saved"],
+            "tp_decode": disagg["tp_decode"],
+            "prefill_tier": disagg["prefill_tier"],
+            "prefill_routed": disagg["prefill_routed"],
+            "disagg_fallbacks": disagg["disagg_fallbacks"],
+            "disagg_ttft_ms_p99": disagg["ttft_ms_p99"],
             "note": "mixed-length trace through the paged continuous-"
                     "batching engine; p99s from per-request SLO clocks; "
                     "MBU prices params + gathered page view per step; "
@@ -2126,7 +2265,12 @@ def bench_serving(on_accel: bool, peak: float):
                     "baseline and accepted tokens exactly-once; "
                     "spec_acceptance/effective_tokens_per_step gated "
                     ">0 / >1 on the speculative leg; int8 leg gated at "
-                    "exactly half the bf16 pool bytes/page",
+                    "exactly half the bf16 pool bytes/page; disagg leg "
+                    "(2-virtual-device subprocess) gated on "
+                    "prefix_hit_rate > 0, token-exact TP=2 decode vs the "
+                    "re-prefill oracle, exactly-once across a prefill-"
+                    "worker death mid-KV-stream, and p99 TTFT inside "
+                    "the deadline",
         },
     }
 
@@ -2153,6 +2297,7 @@ _COMPACT_KEYS = (
     "fleet_replicas", "failovers", "replayed_requests",
     "scaled_out", "scaled_in", "ramp_shed_rate", "baseline_shed_rate",
     "spec_acceptance", "effective_tokens_per_step", "kv_dtype",
+    "prefix_hit_rate", "tp_decode", "prefill_tier",
     "norm_ceiling_mfu",
 )
 
@@ -2435,6 +2580,9 @@ def main() -> None:
     if len(sys.argv) >= 2 and sys.argv[1] == "--sp-parity":
         _sp_parity_main(int(sys.argv[2]), int(sys.argv[3]),
                         int(sys.argv[4]))
+        return
+    if len(sys.argv) >= 2 and sys.argv[1] == "--disagg":
+        _disagg_main(int(sys.argv[2]))
         return
 
     import jax

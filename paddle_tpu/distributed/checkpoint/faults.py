@@ -112,7 +112,8 @@ _MODES = ("error", "crash", "truncate", "delay", "sigterm", "bitflip",
 _OPS = ("write", "read", "rename", "commit", "snap", "serve",
         "serve_prefill", "serve_decode", "serve_pool", "serve_journal",
         "sdc", "net", "net_connect", "net_read", "net_write",
-        "slow", "slow_step", "slow_collective", "slow_serve", "any")
+        "slow", "slow_step", "slow_collective", "slow_serve",
+        "disagg_stream", "any")
 
 
 class InjectedIOError(OSError):

@@ -188,6 +188,19 @@ class SnapshotStore(threading.Thread):
         # telemetry snapshots: src name -> latest pushed metrics doc
         # (last-write-wins; the launcher's rollup pulls the whole map)
         self._metrics: Dict[str, Dict[str, Any]] = {}
+        # disaggregated-serving KV streams (ISSUE 19): a prefill worker
+        # streams one finished prompt's KV pages as framed puts keyed
+        # (replica, epoch, rid, frame_idx), then COMMITS a meta doc keyed
+        # (replica, epoch, rid).  The commit is the visibility AND the
+        # exactly-once gate: uncommitted frames can never be taken (a
+        # worker dying mid-stream leaves nothing claimable), and kv_take
+        # flips a one-shot "taken" flag so two decode workers can never
+        # both import the same rid.  Puts/commits honor _fence like the
+        # journal does — same namespace, so one fence call kills a dead
+        # incarnation's journal flushes AND its in-flight KV streams.
+        self._kv_frames: Dict[Tuple[str, int, int, int],
+                              Dict[str, Any]] = {}
+        self._kv_meta: Dict[Tuple[str, int, int], Dict[str, Any]] = {}
         self._stop = threading.Event()
         self.start()
 
@@ -408,6 +421,87 @@ class SnapshotStore(threading.Thread):
             return {"fence_epoch":
                     self._fence.get(str(head["replica"]), 0)}, b""
 
+    # -- disaggregated-serving KV streams (ISSUE 19) -----------------------
+
+    def _cmd_kv_put(self, head, payload):
+        replica, epoch = str(head["replica"]), int(head["epoch"])
+        rid, idx, want = int(head["rid"]), int(head["idx"]), int(head["crc"])
+        if crc32(payload) != want:
+            return {"ok": False, "reason": "crc mismatch on ingest"}, b""
+        with self._lock:
+            fence = self._fence.get(replica, 0)
+            if epoch < fence:
+                return {"ok": False, "fenced": True,
+                        "fence_epoch": fence}, b""
+            self._kv_frames[(replica, epoch, rid, idx)] = {
+                "crc": want, "ts": time.time(), "payload": payload}
+            # retention mirrors the journal: whole STALE EPOCHS per
+            # replica (a partial frame set is useless — import needs
+            # every frame of a committed rid)
+            epochs = sorted({e for (r, e, _rid, _i) in self._kv_frames
+                             if r == replica})
+            for e in epochs[:-_KEEP_JOURNAL_EPOCHS]:
+                for key in [k for k in self._kv_frames
+                            if k[0] == replica and k[1] == e]:
+                    self._kv_frames.pop(key, None)
+                for key in [k for k in self._kv_meta
+                            if k[0] == replica and k[1] == e]:
+                    self._kv_meta.pop(key, None)
+        return {"ok": True}, b""
+
+    def _cmd_kv_commit(self, head, payload):
+        replica, epoch = str(head["replica"]), int(head["epoch"])
+        rid = int(head["rid"])
+        meta = json.loads(payload) if payload else {}
+        n = int(meta.get("n_frames", 0))
+        with self._lock:
+            fence = self._fence.get(replica, 0)
+            if epoch < fence:
+                return {"ok": False, "fenced": True,
+                        "fence_epoch": fence}, b""
+            missing = [i for i in range(n)
+                       if (replica, epoch, rid, i) not in self._kv_frames]
+            if n < 1 or missing:
+                return {"ok": False,
+                        "reason": f"missing frames {missing or 'all'}"}, b""
+            self._kv_meta[(replica, epoch, rid)] = {
+                "meta": meta, "taken": False, "ts": time.time()}
+        return {"ok": True}, b""
+
+    def _cmd_kv_take(self, head, payload):
+        """One-shot claim of a committed rid: first taker wins, every
+        later take refuses — the decode-side half of exactly-once."""
+        key = (str(head["replica"]), int(head["epoch"]), int(head["rid"]))
+        with self._lock:
+            doc = self._kv_meta.get(key)
+            if doc is None:
+                return {"found": False}, b""
+            if doc["taken"]:
+                return {"found": True, "taken": True}, b""
+            doc["taken"] = True
+            return ({"found": True, "taken": False},
+                    json.dumps(doc["meta"]).encode())
+
+    def _cmd_kv_get(self, head, payload):
+        key = (str(head["replica"]), int(head["epoch"]), int(head["rid"]),
+               int(head["idx"]))
+        with self._lock:
+            doc = self._kv_frames.get(key)
+            if doc is None:
+                return {"found": False}, b""
+            return {"found": True, "crc": doc["crc"]}, doc["payload"]
+
+    def _cmd_kv_index(self, head, payload):
+        replica = str(head["replica"])
+        epoch = head.get("epoch")
+        with self._lock:
+            rids = [{"epoch": e, "rid": rid, "taken": d["taken"],
+                     "n_frames": int(d["meta"].get("n_frames", 0))}
+                    for (r, e, rid), d in sorted(self._kv_meta.items())
+                    if r == replica and (epoch is None or e == int(epoch))]
+            return {"rids": rids,
+                    "fence_epoch": self._fence.get(replica, 0)}, b""
+
     def _cmd_metrics_push(self, head, payload):
         doc = json.loads(payload) if payload else {}
         with self._lock:
@@ -602,6 +696,72 @@ class SnapshotClient:
         resp, _ = self._call({"cmd": "fence_epoch",
                               "replica": str(replica)})
         return int(resp.get("fence_epoch", 0))
+
+    # -- disaggregated-serving KV streams (ISSUE 19) -----------------------
+    def kv_put(self, replica: str, epoch: int, rid: int, idx: int,
+               payload: bytes) -> None:
+        """Stream one KV-page frame.  Raises :class:`FencedEpoch` when the
+        incarnation is fenced (the prefill worker is a zombie — its
+        half-streamed rid must never become claimable) and plain
+        ``OSError`` on transport/ingest-CRC failure (retryable)."""
+        resp, _ = self._call({
+            "cmd": "kv_put", "replica": str(replica), "epoch": int(epoch),
+            "rid": int(rid), "idx": int(idx),
+            "crc": crc32(payload)}, payload)
+        if not resp.get("ok"):
+            if resp.get("fenced"):
+                raise FencedEpoch(
+                    f"kv put refused: replica {replica} epoch {epoch} "
+                    f"fenced at {resp.get('fence_epoch')}")
+            raise OSError(f"kv put refused: "
+                          f"{resp.get('reason', 'unknown')}")
+
+    def kv_commit(self, replica: str, epoch: int, rid: int,
+                  meta: dict) -> None:
+        """Commit a fully-streamed rid (the exactly-once visibility gate:
+        nothing before this call is claimable).  The depot verifies every
+        frame ``0..n_frames-1`` arrived; raises :class:`FencedEpoch` /
+        ``OSError`` like :meth:`kv_put`."""
+        resp, _ = self._call({
+            "cmd": "kv_commit", "replica": str(replica),
+            "epoch": int(epoch), "rid": int(rid)},
+            json.dumps(meta, default=repr).encode())
+        if not resp.get("ok"):
+            if resp.get("fenced"):
+                raise FencedEpoch(
+                    f"kv commit refused: replica {replica} epoch {epoch} "
+                    f"fenced at {resp.get('fence_epoch')}")
+            raise OSError(f"kv commit refused: "
+                          f"{resp.get('reason', 'unknown')}")
+
+    def kv_take(self, replica: str, epoch: int, rid: int) -> Optional[dict]:
+        """Claim a committed rid exactly once: returns its meta doc for
+        the FIRST caller, ``None`` for everyone else (already taken, or
+        never committed)."""
+        resp, payload = self._call({"cmd": "kv_take",
+                                    "replica": str(replica),
+                                    "epoch": int(epoch), "rid": int(rid)})
+        if not resp.get("found") or resp.get("taken"):
+            return None
+        return json.loads(payload) if payload else {}
+
+    def kv_get(self, replica: str, epoch: int, rid: int,
+               idx: int) -> Optional[bytes]:
+        """One frame, CRC-verified; ``None`` when pruned/corrupt."""
+        resp, payload = self._call({
+            "cmd": "kv_get", "replica": str(replica), "epoch": int(epoch),
+            "rid": int(rid), "idx": int(idx)})
+        if not resp.get("found") or crc32(payload) != resp.get("crc"):
+            return None
+        return payload
+
+    def kv_index(self, replica: str, epoch: Optional[int] = None) -> dict:
+        """Committed rids of a replica (optionally one epoch) with their
+        taken flags, plus the current fence epoch — the fold/replay scan."""
+        resp, _ = self._call({"cmd": "kv_index", "replica": str(replica),
+                              "epoch": epoch})
+        return {"rids": resp.get("rids", []),
+                "fence_epoch": int(resp.get("fence_epoch", 0))}
 
     # -- telemetry snapshots (the fleet observability plane) ---------------
     def metrics_push(self, src: str, doc: dict) -> None:

@@ -591,15 +591,29 @@ def _serve_pod(args, node_rank: int, fleet_store_addr: Optional[str],
            if fleet_store_addr else {}),
         **({"PADDLE_TPU_SNAP_STORE": snap.addr} if snap else {}),
     }
+    # disaggregated tier topology (ISSUE 19): with
+    # PADDLE_TPU_DISAGG_PREFILL=K the pod's FIRST K children form a
+    # dedicated prefill tier (named prefill{N}, tier=prefill on their
+    # lease) and the rest stay decode replicas.  The router prefers
+    # prefill capacity for TTFT-bound work and falls back to the whole
+    # fleet when the tier is empty — K >= nproc_per_node degrades to a
+    # homogeneous (all-prefill-tagged) pod rather than refusing.
+    n_prefill = max(0, int(os.environ.get("PADDLE_TPU_DISAGG_PREFILL",
+                                          "0") or 0))
     for local in range(args.nproc_per_node):
-        name = f"replica{node_rank * args.nproc_per_node + local}"
+        idx = node_rank * args.nproc_per_node + local
+        tier = "prefill" if local < n_prefill else "decode"
+        name = (f"prefill{idx}" if tier == "prefill" else f"replica{idx}")
         pool.add(name, argv,
-                 env={**base_env, "PADDLE_LOCAL_RANK": str(local)},
+                 env={**base_env, "PADDLE_LOCAL_RANK": str(local),
+                      "PADDLE_TPU_SERVE_TIER": tier},
                  log_path=os.path.join(args.log_dir, f"{name}.log"))
     # scale-outs reuse the same child contract; their names continue the
     # pod's replica index sequence so they can never collide with (or
-    # inherit budget from) an existing or retired replica
-    pool.set_template(argv, env={**base_env, "PADDLE_LOCAL_RANK": "0"},
+    # inherit budget from) an existing or retired replica.  Autoscaled
+    # capacity is always DECODE tier: the prefill tier is a fixed split.
+    pool.set_template(argv, env={**base_env, "PADDLE_LOCAL_RANK": "0",
+                                 "PADDLE_TPU_SERVE_TIER": "decode"},
                       log_dir=args.log_dir, name_prefix="replica")
     scaler = None
     if os.environ.get("PADDLE_TPU_AS_ENABLE", "0") == "1" \
@@ -618,7 +632,7 @@ def _serve_pod(args, node_rank: int, fleet_store_addr: Optional[str],
         except Exception:
             scaler = None   # autoscaling is additive: never block serving
     _record_event("serve_pod_start", replicas=args.nproc_per_node,
-                  node_rank=node_rank,
+                  node_rank=node_rank, prefill_tier=n_prefill,
                   autoscale=scaler is not None)
     rc = 0
     try:

@@ -471,3 +471,50 @@ def decode_attention_int8(q, k_new, v_new, cache_k, cache_v, k_scale,
 
     out = out[:, :, :g, :].reshape(b, 1, h, d)
     return out, ck_out, cv_out, ks_out, vs_out
+
+
+# ---------------------------------------------------------------------------
+# TP-sharded dispatch gate (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+def decode_attention_sharded_supported(q_shape, cache_shape, *, tp: int = 1,
+                                       block_k: int = DEFAULT_BLOCK_K,
+                                       int8: bool = False,
+                                       emit_fallback: bool = False) -> bool:
+    """Can the decode kernel run per-shard under a ``model``-axis mesh of
+    size ``tp``?  GSPMD partitions the kv-head axis (arena sharding
+    ``P(None, None, "model", None)``), so each shard sees
+    ``kv // tp`` cache heads and ``h // tp`` query heads — the kernel
+    itself is unchanged; this gate answers whether the PER-SHARD shapes
+    still satisfy the (int8-)kernel constraints.  Heads must divide
+    evenly: a ragged shard would silently change the q-group geometry.
+    ``tp == 1`` degrades to the unsharded gates."""
+    def _reject(reason: str, **detail) -> bool:
+        if emit_fallback:
+            from ...telemetry import kernel_fallback
+
+            kernel_fallback("decode_attention_sharded", reason, tp=tp,
+                            **detail)
+        return False
+
+    if tp < 1:
+        return _reject("bad_tp")
+    if len(q_shape) != 4 or len(cache_shape) != 4:
+        return _reject("rank", q_rank=len(q_shape))
+    b, s, h, d = q_shape
+    bc, C, kv, dc = cache_shape
+    if h % tp != 0 or kv % tp != 0:
+        return _reject("ragged_heads", h=h, kv=kv)
+    q_shard = (b, s, h // tp, d)
+    cache_shard = (bc, C, kv // tp, dc)
+    if int8:
+        ok = decode_attention_int8_supported(q_shard, cache_shard,
+                                             block_k=block_k,
+                                             emit_fallback=emit_fallback)
+    else:
+        ok = decode_attention_supported(q_shard, cache_shard,
+                                        block_k=block_k)
+        if not ok:
+            return _reject("shard_shape", q_shard=list(q_shard),
+                           cache_len=C, block_k=block_k)
+    return bool(ok)

@@ -31,7 +31,16 @@ membership rides heartbeat leases, every replica ships its journal to the
 launcher's depot at the flush boundary that gates emission, and a dead
 replica's work is fenced, folded and replayed on survivors with delivered
 high-water marks primed — exactly-once tokens across replica death (see
-:mod:`.fleet`)."""
+:mod:`.fleet`).
+
+ISSUE-19 disaggregates: TP-sharded decode (:func:`decode_mesh` +
+:func:`shard_llama_params` partition the decode program and its paged KV
+arenas over a ``model`` mesh axis), a dedicated prefill tier
+(:class:`PrefillWorker` streams finished KV pages to decode replicas
+through the journal depot with the same fence/epoch exactly-once
+machinery), and a :class:`PrefixCache` (radix index over KV-pool pages
+with copy-on-write refcounts — shared prompt prefixes skip re-prefill,
+token-exact)."""
 
 from .kv_pool import PagedKVPool, PoolExhausted, TRASH_PAGE, \
     default_page_tokens  # noqa: F401
@@ -49,6 +58,11 @@ from .fleet import (EngineReplica, LocalKV, RemoteReplica,  # noqa: F401
                     TokenCollector, fold_depot_journal, run_replica)
 from .autoscaler import (Autoscaler, AutoscalePolicy,  # noqa: F401
                          FleetSignals)
+from .prefix_cache import PrefixCache, default_prefix_pages  # noqa: F401
+from .disagg import (DisaggCoordinator, PrefillWorker,  # noqa: F401
+                     decode_mesh, default_min_prompt, pack_kv_frame,
+                     shard_arenas, shard_llama_params, take_prefilled,
+                     unpack_kv_frame)
 
 __all__ = [
     "PagedKVPool", "PoolExhausted", "TRASH_PAGE", "default_page_tokens",
@@ -63,4 +77,8 @@ __all__ = [
     "ReplicaServer", "ServingFrontend", "TokenCollector",
     "fold_depot_journal", "run_replica",
     "Autoscaler", "AutoscalePolicy", "FleetSignals",
+    "PrefixCache", "default_prefix_pages",
+    "DisaggCoordinator", "PrefillWorker", "decode_mesh",
+    "default_min_prompt", "pack_kv_frame", "unpack_kv_frame",
+    "shard_arenas", "shard_llama_params", "take_prefilled",
 ]

@@ -1,0 +1,382 @@
+"""Disaggregated serving (ISSUE 19): TP mesh, prefill tier, KV streaming.
+
+Three cooperating pieces:
+
+* **TP-sharded decode** — :func:`decode_mesh` + :func:`shard_llama_params`
+  + :func:`shard_arenas` put a :class:`~paddle_tpu.serving.engine.
+  ServingEngine`'s params and paged KV arenas under a 1-D ``"model"``
+  mesh (the SNIPPETS [2] GSPMD pattern: committed ``NamedSharding``
+  inputs, ``jax.jit`` infers the rest).  Megatron decomposition over the
+  paddle ``[in, out]`` weight layout: q/k/v/gate/up shard the OUT dim,
+  o/down shard the IN dim (partial sums reduced by GSPMD), everything
+  else replicates; arenas shard the kv-head axis so the decode
+  attention's gather/scatter and the grouped einsum stay local per
+  shard.
+
+* **Prefill tier** — :class:`PrefillWorker` owns a (usually max_batch=1)
+  engine whose only job is :meth:`~paddle_tpu.serving.engine.
+  ServingEngine.prefill_export`: run a prompt's chunked prefill, stream
+  the finished KV pages to the depot as framed ``kv_put``\\ s, then
+  ``kv_commit``.  The COMMIT is the exactly-once gate: a worker dying
+  mid-stream leaves nothing claimable, and the fleet's fencing machinery
+  (one fence namespace for journal AND KV streams) refuses a zombie's
+  late frames.  Decode workers claim a committed rid with the one-shot
+  ``kv_take`` and import the frames via ``submit_prefilled`` — the
+  decode-side journal then owns the request exactly as if it had been
+  submitted locally.
+
+* **Coordinator** — :class:`DisaggCoordinator` is the tiered submit
+  plane: prompts at/above ``PADDLE_TPU_DISAGG_MIN_PROMPT`` tokens route
+  through a prefill worker, everything else lands on decode directly.
+  Any prefill-leg failure (worker death mid-stream, fenced epoch, depot
+  outage) triggers fence → fold → replay: the worker's epoch is fenced
+  at the depot (its zombie puts can change nothing), and the request
+  falls back to a decode-local prefill — the deduping token sink keeps
+  client emission exactly-once either way.
+
+Env knobs: ``PADDLE_TPU_SERVE_TP`` (decode mesh size, default 1),
+``PADDLE_TPU_DISAGG_MIN_PROMPT`` (prefill-tier routing threshold in
+tokens, default ``4 * page_tokens``), ``PADDLE_TPU_DISAGG_TTL``
+(seconds a coordinator waits on a committed rid's frames before
+falling back, default 5), ``PADDLE_TPU_SERVE_TIER`` (``prefill`` /
+``decode`` — stamped on fleet leases by launch ``--mode serve``),
+``PADDLE_TPU_DISAGG_PREFILL`` (launcher: how many replicas boot as the
+prefill tier).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..distributed.checkpoint import faults as _faults
+from ..distributed.checkpoint.replicator import FencedEpoch, env_int as \
+    _env_int
+from ..telemetry import record_event as _event
+from ..telemetry.runtime import bump as _bump
+from .admission import Deadline
+
+__all__ = ["decode_mesh", "shard_llama_params", "shard_arenas",
+           "arena_partition_spec", "pack_kv_frame", "unpack_kv_frame",
+           "PrefillWorker", "DisaggCoordinator", "take_prefilled",
+           "default_min_prompt", "disagg_ttl"]
+
+
+# -- TP-sharded decode (leg 1) ----------------------------------------------
+
+def decode_mesh(tp: int, *, devices=None):
+    """1-D ``"model"`` mesh over the first ``tp`` local devices (the
+    serving analogue of the trainer's mp axis; CPU tier-1 gets virtual
+    devices from ``xla_force_host_platform_device_count``)."""
+    import jax
+
+    devs = list(devices) if devices is not None else jax.devices()
+    if len(devs) < tp:
+        raise ValueError(f"tp={tp} needs {tp} devices, have {len(devs)}")
+    return jax.sharding.Mesh(np.asarray(devs[:tp]), ("model",))
+
+
+def _named(mesh, *spec):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+# paddle Linear weight layout is [in, out]: column-parallel projections
+# (q/k/v, gate/up) shard the OUT dim, row-parallel (o, down) shard the
+# IN dim — GSPMD inserts the partial-sum reduction the Megatron pairing
+# implies.  Matching is on the dotted parameter name's suffix.
+_COL_SUFFIXES = ("q_proj.weight", "k_proj.weight", "v_proj.weight",
+                 "gate_proj.weight", "up_proj.weight")
+_ROW_SUFFIXES = ("o_proj.weight", "down_proj.weight")
+
+
+def shard_llama_params(model, mesh) -> int:
+    """Commit every parameter and buffer of a llama-family model onto
+    ``mesh`` IN PLACE (``jax.device_put`` of each Tensor's ``_value``):
+    Megatron TP placement for the attention/MLP projections, replicated
+    for everything else (embeddings, norms, rope tables).  Returns the
+    number of model-axis-sharded parameters.  Idempotent — re-placing an
+    already-committed array is a no-op for XLA.
+
+    IN PLACE means in place: the model object must not be shared with an
+    unsharded engine afterwards — its params now carry committed mesh
+    shardings, and an engine compiling against them without the mesh gets
+    GSPMD-partitioned programs it never asked for (the donation lint
+    catches this as a halved per-device alias floor).  Give each TP
+    engine its own model instance."""
+    import jax
+
+    repl = _named(mesh)
+    sharded = 0
+    for name, p in model.named_parameters():
+        if name.endswith(_COL_SUFFIXES):
+            sh = _named(mesh, None, "model")
+            sharded += 1
+        elif name.endswith(_ROW_SUFFIXES):
+            sh = _named(mesh, "model", None)
+            sharded += 1
+        else:
+            sh = repl
+        p._value = jax.device_put(p._value, sh)
+    for _name, b in model.named_buffers():
+        b._value = jax.device_put(b._value, repl)
+    _event("disagg_shard_params", str(mesh.shape), sharded=sharded)
+    return sharded
+
+
+def arena_partition_spec(key: str):
+    """PartitionSpec axes for one arena plane: k/v pages are
+    ``[pages, page_tokens, kv_heads, head_dim]`` sharded on kv_heads;
+    int8 scale planes ``[pages, page_tokens, kv_heads]`` likewise."""
+    from jax.sharding import PartitionSpec
+
+    if key in ("ks", "vs"):
+        return PartitionSpec(None, None, "model")
+    return PartitionSpec(None, None, "model", None)
+
+
+def shard_arenas(arenas: Dict[str, list], mesh) -> Dict[str, list]:
+    """Commit every KV arena onto ``mesh``, sharded over the kv-head
+    axis — the decode program's scatter/gather and grouped einsum then
+    run shard-local on that axis, and donation aliases each shard's
+    slice."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    return {key: [jax.device_put(a, NamedSharding(
+        mesh, arena_partition_spec(key))) for a in arrs]
+        for key, arrs in arenas.items()}
+
+
+# -- KV page frames (leg 2 wire format) -------------------------------------
+
+def pack_kv_frame(frame: Dict[str, np.ndarray]) -> bytes:
+    """One page's planes -> depot payload: a JSON header (per-plane dtype
+    and shape) + the raw buffers, concatenated in sorted-key order.  CRC
+    integrity rides the depot's framing; this format only needs to be
+    self-describing."""
+    keys = sorted(frame)
+    head = {k: {"dtype": str(np.asarray(frame[k]).dtype),
+                "shape": list(np.asarray(frame[k]).shape)} for k in keys}
+    buf = io.BytesIO()
+    hb = json.dumps(head).encode()
+    buf.write(len(hb).to_bytes(4, "big"))
+    buf.write(hb)
+    for k in keys:
+        buf.write(np.ascontiguousarray(frame[k]).tobytes())
+    return buf.getvalue()
+
+
+def unpack_kv_frame(data: bytes) -> Dict[str, np.ndarray]:
+    n = int.from_bytes(data[:4], "big")
+    head = json.loads(data[4:4 + n].decode())
+    out: Dict[str, np.ndarray] = {}
+    off = 4 + n
+    for k in sorted(head):
+        dt = np.dtype(head[k]["dtype"])
+        shape = tuple(head[k]["shape"])
+        nbytes = dt.itemsize * int(np.prod(shape)) if shape else dt.itemsize
+        out[k] = np.frombuffer(data[off:off + nbytes],
+                               dtype=dt).reshape(shape)
+        off += nbytes
+    if off != len(data):
+        raise ValueError(f"kv frame payload size mismatch: consumed "
+                         f"{off} of {len(data)} bytes")
+    return out
+
+
+# -- prefill tier -----------------------------------------------------------
+
+def disagg_ttl() -> float:
+    """How long a coordinator polls a routed rid's committed frames
+    before executing the fallback ladder (``PADDLE_TPU_DISAGG_TTL``,
+    seconds, default 5).  With in-process workers the commit is visible
+    on the first take; the TTL only matters when the prefill worker runs
+    remotely and its ``kv_commit`` races the coordinator's claim."""
+    return float(os.environ.get("PADDLE_TPU_DISAGG_TTL", "5") or 5)
+
+
+def default_min_prompt(page_tokens: int) -> int:
+    """Routing threshold: prompts at/above this many tokens go to the
+    prefill tier (``PADDLE_TPU_DISAGG_MIN_PROMPT``, default 4 pages —
+    short prompts aren't worth a network round trip)."""
+    return _env_int("PADDLE_TPU_DISAGG_MIN_PROMPT", 4 * page_tokens)
+
+
+class PrefillWorker:
+    """One prefill-tier worker: an engine used ONLY for
+    ``prefill_export``, an adopted fencing epoch, and a depot to stream
+    into.  Construction fences the previous incarnation (the fleet's
+    ``adopt_epoch`` idiom), so a SIGKILL'd worker's restart immediately
+    invalidates any half-streamed rid the old incarnation left."""
+
+    def __init__(self, engine, depot, *, name: str = "prefill0",
+                 epoch: Optional[int] = None):
+        from .fleet import adopt_epoch
+
+        self.engine = engine
+        self.depot = depot
+        self.name = str(name)
+        self.epoch = int(epoch) if epoch is not None \
+            else adopt_epoch(depot, self.name)
+        self.prefills_total = 0
+        self.tokens_prefilled = 0
+
+    def prefill(self, prompt, *, rid: int, max_new_tokens: int = 64,
+                eos_token_id: Optional[int] = None,
+                deadline: Optional[Deadline] = None,
+                age_s: float = 0.0,
+                trace_id: Optional[str] = None) -> dict:
+        """Prefill ``prompt``, stream its KV pages to the depot, COMMIT,
+        and return the commit meta (the decode side's claim ticket).
+        The ``disagg_stream`` chaos seam fires before every frame put —
+        a worker "dying" mid-stream raises out of here with the rid
+        uncommitted, which is exactly the state a real SIGKILL leaves."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        first, frames = self.engine.prefill_export(prompt)
+        meta = {"rid": int(rid), "prompt": [int(t) for t in prompt],
+                "first_token": int(first), "n_frames": len(frames),
+                "max_new_tokens": int(max_new_tokens),
+                "eos_token_id": (None if eos_token_id is None
+                                 else int(eos_token_id)),
+                "deadline": (None if deadline is None
+                             else deadline.to_doc()),
+                "age_s": float(age_s), "trace_id": trace_id,
+                "kv_dtype": self.engine.kv_dtype,
+                "worker": self.name, "epoch": self.epoch}
+        for idx, f in enumerate(frames):
+            _faults.fire("disagg_stream",
+                         f"{self.name}/rid{rid}/frame{idx}")
+            self.depot.kv_put(self.name, self.epoch, int(rid), idx,
+                              pack_kv_frame(f))
+        self.depot.kv_commit(self.name, self.epoch, int(rid), meta)
+        self.prefills_total += 1
+        self.tokens_prefilled += int(prompt.size)
+        _event("disagg_prefill", str(rid), worker=self.name,
+               epoch=self.epoch, pages=len(frames), trace=trace_id)
+        _bump("serving.disagg_prefills_total")
+        return meta
+
+
+def take_prefilled(depot, replica: str, epoch: int,
+                   rid: int) -> Optional[Tuple[dict, List[dict]]]:
+    """Claim one committed rid exactly once and fetch its frames.
+    Returns ``(meta, frames)`` for the FIRST caller, ``None`` when the
+    rid is uncommitted/already claimed, or when a frame was pruned (the
+    claim is burned but the meta's journaled prompt lets the caller
+    fall back to a local prefill — still exactly-once: no tokens were
+    emitted yet)."""
+    meta = depot.kv_take(replica, epoch, rid)
+    if meta is None:
+        return None
+    frames: List[dict] = []
+    for idx in range(int(meta.get("n_frames", 0))):
+        data = depot.kv_get(replica, epoch, rid, idx)
+        if data is None:
+            _event("disagg_frames_lost", str(rid), worker=replica,
+                   epoch=epoch, frame=idx)
+            return None
+        frames.append(unpack_kv_frame(data))
+    return meta, frames
+
+
+class DisaggCoordinator:
+    """Tiered submit plane over one decode engine + N prefill workers.
+
+    ``submit`` is the single entry point: long prompts take the prefill
+    leg (worker prefill → depot stream → commit → one-shot take →
+    ``submit_prefilled``), short prompts go straight to decode.  Any
+    failure on the prefill leg executes the fence → fold → replay
+    ladder: the worker's epoch is fenced at the depot (a zombie's
+    in-flight puts/commits are refused from that instant), and the
+    request replays as a decode-local prefill.  Exactly-once holds by
+    construction — no token is ever emitted before the decode engine
+    journals the request, whichever leg admitted it."""
+
+    def __init__(self, decode_engine, prefill_workers, depot, *,
+                 min_prompt: Optional[int] = None):
+        self.decode = decode_engine
+        self.workers: List[PrefillWorker] = list(prefill_workers)
+        self.depot = depot
+        self.min_prompt = int(min_prompt) if min_prompt is not None \
+            else default_min_prompt(decode_engine.page_tokens)
+        self._rr = 0
+        self.prefill_routed = 0
+        self.decode_direct = 0
+        self.fallbacks = 0
+
+    def _next_rid(self) -> int:
+        from .engine import Request
+
+        rid = Request._next_rid
+        Request._next_rid += 1
+        return rid
+
+    def submit(self, prompt, max_new_tokens: int = 64,
+               eos_token_id: Optional[int] = None, *,
+               deadline: Optional[Deadline] = None,
+               age_s: float = 0.0,
+               trace_id: Optional[str] = None) -> int:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if self.workers and prompt.size >= self.min_prompt:
+            rid = self._next_rid()
+            w = self.workers[self._rr % len(self.workers)]
+            self._rr += 1
+            try:
+                w.prefill(prompt, rid=rid,
+                          max_new_tokens=max_new_tokens,
+                          eos_token_id=eos_token_id, deadline=deadline,
+                          age_s=age_s, trace_id=trace_id)
+                got = take_prefilled(self.depot, w.name, w.epoch, rid)
+                wait_until = time.monotonic() + disagg_ttl()
+                while got is None and time.monotonic() < wait_until:
+                    time.sleep(0.02)
+                    got = take_prefilled(self.depot, w.name, w.epoch,
+                                         rid)
+                if got is not None:
+                    meta, frames = got
+                    self.prefill_routed += 1
+                    _bump("serving.disagg_routed_total")
+                    return self.decode.submit_prefilled(
+                        meta["prompt"], meta["first_token"], frames,
+                        max_new_tokens=meta["max_new_tokens"],
+                        eos_token_id=meta["eos_token_id"],
+                        deadline=Deadline.from_doc(meta["deadline"]),
+                        rid=rid, age_s=age_s, trace_id=trace_id)
+                reason = "frames_unclaimable"
+            except (FencedEpoch, OSError, RuntimeError) as e:
+                reason = f"{type(e).__name__}: {e}"
+            # fence → fold → replay: declare the worker's incarnation
+            # dead so its late puts/commits change nothing, then replay
+            # the request as a decode-local prefill.  (Fold here is
+            # trivial — nothing uncommitted is ever claimable, and the
+            # one-shot take already burned any claim we made.)
+            try:
+                w.epoch = self.depot.fence(w.name, w.epoch + 1)
+            except OSError:
+                pass       # depot unreachable: local prefill still safe
+            self.fallbacks += 1
+            _event("disagg_fallback", str(rid), worker=w.name,
+                   reason=str(reason)[:200], trace=trace_id)
+            _bump("serving.disagg_fallbacks_total")
+            return self.decode.submit(prompt, max_new_tokens,
+                                      eos_token_id, deadline=deadline,
+                                      rid=rid, age_s=age_s,
+                                      trace_id=trace_id)
+        self.decode_direct += 1
+        return self.decode.submit(prompt, max_new_tokens, eos_token_id,
+                                  deadline=deadline, age_s=age_s,
+                                  trace_id=trace_id)
+
+    def summary(self) -> dict:
+        return {"prefill_routed": self.prefill_routed,
+                "decode_direct": self.decode_direct,
+                "fallbacks": self.fallbacks,
+                "min_prompt": self.min_prompt,
+                "workers": [w.name for w in self.workers]}

@@ -84,6 +84,7 @@ from .kv_pool import PagedKVPool, PoolExhausted, TRASH_PAGE, \
 from .kv_quant import (dequantize_kv, kv_cache_dtype, kv_page_bytes,
                        kv_scale_page_bytes, quantize_kv)
 from .metrics import SLOMeter
+from .prefix_cache import PrefixCache
 
 __all__ = ["Request", "ServingEngine", "check_decode_donation"]
 
@@ -132,6 +133,12 @@ class Request:
         self.delivered_tokens: List[int] = []
         self.defers = 0                       # FIFO-head bypasses suffered
         self.drafter = None                   # speculative proposer (or None)
+        self.cached_tokens = 0                # prompt tokens adopted from the
+        # prefix cache at the LAST admission (reset on eviction: the pages
+        # go back, the re-admission re-matches)
+        self.kv_import = None                 # (first_token, frames) from a
+        # prefill-tier worker, or None: set at submit, consumed instead of
+        # the local prefill (disagg.py)
 
     @property
     def pos(self) -> int:
@@ -147,7 +154,7 @@ class Request:
 
 def check_decode_donation(compiled, arena_bytes: int,
                           name: str = "serving_decode", *,
-                          scale_bytes: int = 0):
+                          scale_bytes: int = 0, shards: int = 1):
     """Shardlint gate for the serving path: run the ``donation`` rule over
     the compiled decode program and additionally require the KV arenas to
     be ALIASED (donated in, updated in place) — an unaliased arena means
@@ -156,6 +163,11 @@ def check_decode_donation(compiled, arena_bytes: int,
     buffers ride the same donation: an unaliased scale arena silently
     copies ``2 * layers * pages * page_tokens * kv_heads`` floats per
     step, so the gate requires ``arena_bytes + scale_bytes`` aliased.
+    Under a ``shards``-way TP mesh (ISSUE 19) the compiled memory
+    analysis is PER DEVICE and the arenas shard evenly over the kv-head
+    axis, so each shard must alias its ``1/shards`` slice — the gate
+    scales its floor accordingly (the donation-dropped failure mode still
+    reads as alias_bytes ~ 0, far below any per-shard floor).
     Returns the :class:`LintReport`; raises ``RuntimeError`` when the
     arenas (or scales) are not aliased or an unexempted donation error
     fires."""
@@ -169,17 +181,17 @@ def check_decode_donation(compiled, arena_bytes: int,
                "argument_bytes": int(ma.argument_size_in_bytes)}
     except Exception:
         pass
-    need = int(arena_bytes) + int(scale_bytes)
+    need = (int(arena_bytes) + int(scale_bytes)) // max(int(shards), 1)
     if mem is not None and mem["alias_bytes"] < need:
         what = "KV arenas" if not scale_bytes else \
             "KV arenas + int8 scale buffers"
         raise RuntimeError(
             f"serving decode program does not alias its {what}: "
             f"{mem['alias_bytes']} bytes aliased < {need} required "
-            f"({arena_bytes} arena + {scale_bytes} scale) — the cache is "
-            f"being copied every step (donation dropped; check "
-            f"donate_argnums and that arena/scale shapes/dtypes are "
-            f"unchanged between input and output)")
+            f"({arena_bytes} arena + {scale_bytes} scale over {shards} "
+            f"shard(s)) — the cache is being copied every step (donation "
+            f"dropped; check donate_argnums and that arena/scale "
+            f"shapes/dtypes are unchanged between input and output)")
     if not report.ok:
         raise RuntimeError(
             "serving decode program failed the donation lint:\n" +
@@ -201,7 +213,8 @@ class ServingEngine:
                  max_queue: Optional[int] = None,
                  admission: Optional[AdmissionController] = None,
                  journal=None, journal_ship=None, on_token=None, now=None,
-                 kv_dtype: Optional[str] = None, speculative=None):
+                 kv_dtype: Optional[str] = None, speculative=None,
+                 tp: Optional[int] = None, prefix_cache=None):
         import jax.numpy as jnp
 
         from ..generation.speculative import AdaptiveK, SpecConfig
@@ -250,6 +263,27 @@ class ServingEngine:
         self._cdt = cdt
         n_layers, kv_heads, head_dim = model._kv_cache_spec()
         self._arena_shape = (N, P, kv_heads, head_dim)
+        # TP-sharded decode (ISSUE 19 leg 1): tp > 1 compiles BOTH
+        # programs under a 1-D "model" mesh — params Megatron-sharded in
+        # place (q/k/v/gate/up out-dim, o/down in-dim), arenas sharded
+        # over the kv-head axis and STILL donated (each shard aliases its
+        # slice), step inputs replicated.  The page tables / scheduler /
+        # journal are untouched: sharding is a compile-time property of
+        # the two programs, not a scheduling concern.
+        self.tp = int(tp if tp is not None
+                      else _env_int("PADDLE_TPU_SERVE_TP", 1))
+        self._mesh = None
+        if self.tp > 1:
+            from .disagg import decode_mesh, shard_llama_params
+
+            h_att = model.config.num_attention_heads
+            if kv_heads % self.tp or h_att % self.tp:
+                raise ValueError(
+                    f"PADDLE_TPU_SERVE_TP={self.tp} must divide both "
+                    f"kv_heads ({kv_heads}) and attention heads ({h_att}) "
+                    f"— a ragged shard would change the q-group geometry")
+            self._mesh = decode_mesh(self.tp)
+            shard_llama_params(model, self._mesh)
         # KV page dtype (ISSUE 13): "bf16" = the native compute dtype,
         # bit-exact; "int8" stores quantized pages + f32 per-(slot, head)
         # scale arenas, dequantized at the gather inside the same program
@@ -269,6 +303,21 @@ class ServingEngine:
             arenas["vs"] = [jnp.zeros(sshape, jnp.float32)
                             for _ in range(n_layers)]
             self._scale_bytes = 2 * n_layers * int(np.prod(sshape)) * 4
+        if self._mesh is not None:
+            from .disagg import shard_arenas
+            from ..ops.pallas.decode_attention import \
+                decode_attention_sharded_supported
+
+            arenas = shard_arenas(arenas, self._mesh)
+            # pure telemetry: would the Pallas decode kernel still take
+            # the per-shard shapes on accel?  (CPU tier-1 always uses the
+            # einsum path; a silent per-shard fallback must be visible.)
+            decode_attention_sharded_supported(
+                (self.max_batch, 1, model.config.num_attention_heads,
+                 head_dim),
+                (self.max_batch, MP * P, kv_heads, head_dim),
+                tp=self.tp, int8=self.kv_dtype == "int8",
+                emit_fallback=True)
         self._arenas = arenas
         self._arena_bytes = 2 * n_layers * int(np.prod(self._arena_shape)) \
             * arenas["k"][0].dtype.itemsize
@@ -279,6 +328,20 @@ class ServingEngine:
                                 n_layers=n_layers),
             self.kv_dtype)
         self.meter.set_kv_bytes_per_token(self.pool.bytes_per_token())
+
+        # prefix cache (ISSUE 19 leg 3): True/env "1" = trie under the
+        # PADDLE_TPU_PREFIX_PAGES budget; an int = explicit page budget;
+        # a PrefixCache = caller-owned (tests share one across engines)
+        if prefix_cache is None:
+            prefix_cache = \
+                os.environ.get("PADDLE_TPU_PREFIX_CACHE", "0") == "1"
+        if prefix_cache is True:
+            prefix_cache = PrefixCache(self.pool)
+        elif isinstance(prefix_cache, int) and not isinstance(
+                prefix_cache, bool) and prefix_cache > 0:
+            prefix_cache = PrefixCache(self.pool, max_pages=prefix_cache)
+        self.prefix: Optional[PrefixCache] = \
+            prefix_cache if isinstance(prefix_cache, PrefixCache) else None
 
         # speculative decoding (ISSUE 13): the decode program widens to a
         # fixed [R, k_max+1] verify signature; a per-row dynamic valid
@@ -331,7 +394,8 @@ class ServingEngine:
                rid: Optional[int] = None,
                delivered_tokens: Optional[List[int]] = None,
                age_s: float = 0.0,
-               trace_id: Optional[str] = None) -> int:
+               trace_id: Optional[str] = None,
+               kv_import=None) -> int:
         """Admit a request or refuse it.  Raises ``ValueError`` for a
         request the engine could NEVER serve (malformed, or worst-case
         page demand beyond the whole pool), :class:`Overloaded` for a
@@ -349,6 +413,11 @@ class ServingEngine:
         trace_id = tracing.mint(trace_id)
         r = Request(prompt, max_new_tokens, eos_token_id, rid=rid,
                     trace_id=trace_id)
+        # disagg import (see submit_prefilled): set BEFORE the request is
+        # visible to the scheduler so admission never races the flag —
+        # an imported request takes a full private allocation (its frames
+        # cover every prompt page) and skips prefix matching
+        r.kv_import = kv_import
         if rid is not None and (
                 rid in self._results or rid in self.shed or
                 any(q.rid == rid for q in list(self._queue)) or
@@ -398,6 +467,37 @@ class ServingEngine:
         self.meter.set_queue_depth(len(self._queue))
         self._work.set()
         return r.rid
+
+    def submit_prefilled(self, prompt, first_token: int, kv_frames, *,
+                         max_new_tokens: int = 64,
+                         eos_token_id: Optional[int] = None,
+                         deadline: Optional[Deadline] = None,
+                         rid: Optional[int] = None,
+                         age_s: float = 0.0,
+                         trace_id: Optional[str] = None) -> int:
+        """Admit a request whose prefill already ran on a prefill-tier
+        worker (ISSUE 19 leg 2): ``kv_frames`` holds one host dict per
+        prompt page (the :meth:`prefill_export` format, streamed through
+        the depot) and ``first_token`` the token that prefill's logits
+        chose.  Instead of running the prefill program, admission scatters
+        the frames into the arenas and delivery starts at
+        ``first_token``.
+
+        The journal records the FULL prompt, exactly as a local submit
+        would: crash replay re-prefills locally — deterministic greedy
+        makes that token-exact even when the frames are long gone, and the
+        delivered high-water mark keeps emission exactly-once."""
+        frames = list(kv_frames)
+        p = np.asarray(prompt, np.int32).reshape(-1)
+        need = self.pool.pages_for(len(p))
+        if len(frames) != need:
+            raise ValueError(
+                f"kv_frames covers {len(frames)} pages but the prompt "
+                f"needs {need} (page_tokens={self.page_tokens})")
+        return self.submit(prompt, max_new_tokens, eos_token_id,
+                           deadline=deadline, rid=rid, age_s=age_s,
+                           trace_id=trace_id,
+                           kv_import=(int(first_token), frames))
 
     def handback_queued(self) -> List[dict]:
         """Drain hook: remove every queued-but-UNSTARTED request (nothing
@@ -501,7 +601,10 @@ class ServingEngine:
         finally:
             if wd is not None:
                 wd.stop()
-        self.pool.check_leaks()
+        # with a live prefix cache the trie legitimately pins pages at
+        # quiesce; the partition invariant (free ⊎ referenced = all
+        # pages, shared counted once) still holds and is still checked
+        self.pool.check_leaks(allow_shared=self.prefix is not None)
         return dict(self._results)
 
     def serve_forever(self, **kw) -> Dict[int, np.ndarray]:
@@ -595,11 +698,24 @@ class ServingEngine:
             self.journal.shed(r.rid, reason)
         self.meter.shed(r.rid, reason=reason)
 
+    def _admit_need(self, r: Request):
+        """``(pages to NEWLY allocate, cached prefix pages to adopt)`` for
+        admitting ``r``.  With a prefix cache, the trie's longest match
+        shrinks the fresh-page demand (the match cap guarantees at least
+        ONE private page: the last prompt token always re-prefills, and
+        decode writes land past the shared prefix).  Imported requests
+        (``kv_import``) carry frames for every page and skip matching."""
+        total = self.pool.pages_for(len(r.prompt) + 1)
+        if self.prefix is None or r.kv_import is not None:
+            return total, []
+        pages, _n_tok = self.prefix.match(r.prompt)
+        return total - len(pages), pages
+
     def _admit(self) -> None:
         rows = self._free_rows()
         while self._queue and rows:
             r = self._queue[0]
-            need = self.pool.pages_for(len(r.prompt) + 1)
+            need, cached = self._admit_need(r)
             if not self.pool.can_alloc(need):
                 # pool pressure: a long prompt at the head must not wedge
                 # admission — try ONE shorter request from the lookahead
@@ -607,15 +723,25 @@ class ServingEngine:
                 if not self._admit_bypass(r, need, rows):
                     break
                 continue
-            self._admit_one(r, need, rows, from_head=True)
+            self._admit_one(r, need, rows, from_head=True, cached=cached)
 
     def _admit_one(self, r: Request, need: int, rows: List[int],
-                   *, from_head: bool) -> None:
+                   *, from_head: bool, cached=()) -> None:
         _faults.fire("serve_pool", f"admit_rid{r.rid}")
         if from_head:
             self._queue.popleft()
         else:
             self._queue.remove(r)
+        if cached:
+            # prefix hit: adopt the trie's pages (COW refcount++) and
+            # allocate only the uncached tail — prefill resumes at the
+            # first uncached chunk (see _prefill)
+            self.pool.adopt(r.rid, cached)
+            r.cached_tokens = len(cached) * self.page_tokens
+        else:
+            r.cached_tokens = 0
+        if self.prefix is not None and r.kv_import is None:
+            self.prefix.note(bool(cached), n_tokens=r.cached_tokens)
         self.pool.alloc(r.rid, need)
         r.row = rows.pop(0)
         r.state = RUNNING
@@ -630,18 +756,21 @@ class ServingEngine:
         ``PADDLE_TPU_SERVE_DEFER_LOOKAHEAD`` queue slots instead of
         wedging.  The head keeps its place and can only be bypassed
         ``PADDLE_TPU_SERVE_DEFER_MAX`` times — after that admission holds
-        strictly FIFO until the head fits."""
+        strictly FIFO until the head fits.  Demand is compared on FRESH
+        pages (post prefix-cache match): a long prompt that is mostly
+        cached is cheap, not long."""
         if head.defers >= self._defer_max:
             return False
         window = min(len(self._queue), self._defer_lookahead + 1)
         for i in range(1, window):
             c = self._queue[i]
-            need = self.pool.pages_for(len(c.prompt) + 1)
+            need, cached = self._admit_need(c)
             if need < head_need and self.pool.can_alloc(need):
                 head.defers += 1
                 self.meter.defer(head.rid, defers=head.defers,
                                  need=head_need, free=self.pool.pages_free)
-                self._admit_one(c, need, rows, from_head=False)
+                self._admit_one(c, need, rows, from_head=False,
+                                cached=cached)
                 return True
         return False
 
@@ -655,6 +784,8 @@ class ServingEngine:
         victim.row = None
         victim.state = QUEUED
         victim.generated = []        # replayed from the prompt on re-admit
+        victim.cached_tokens = 0     # pages went back (trie-pinned ones
+        # survive there); the re-admission re-matches the prefix cache
         victim.drafter = None        # rebuilt at re-prefill; proposals only
         # ever influence WHICH positions get verified, never the tokens,
         # so a drafter reset cannot perturb the deterministic replay
@@ -730,28 +861,51 @@ class ServingEngine:
         t[:len(pages)] = pages
         return t
 
-    def _prefill(self, r: Request) -> None:
+    def _prefill_chunks(self, prompt, table, c0: int = 0):
+        """Drive the compiled prefill program over ``prompt``'s
+        page-sized chunks starting at chunk ``c0``; returns the
+        last-prompt-token logits.  Shared by scheduled prefills
+        (:meth:`_prefill`, where ``c0`` skips prefix-cached pages) and
+        the standalone :meth:`prefill_export` path."""
         import jax.numpy as jnp
 
-        _faults.fire("serve_prefill", f"rid{r.rid}")
         P = self.page_tokens
-        prompt = r.prompt
         n_chunks = -(-len(prompt) // P)
-        table = jnp.asarray(self._padded_table(r.rid)[None])
         logits = None
-        for c in range(n_chunks):
+        for c in range(c0, n_chunks):
             chunk = np.zeros((1, P), np.int32)
             part = prompt[c * P:(c + 1) * P]
             chunk[0, :len(part)] = part
             take = (len(prompt) - 1 - c * P) if c == n_chunks - 1 else 0
-            out = self._run_prefill(
+            logits = self._run_prefill(
                 jnp.asarray(chunk), jnp.int32(c * P), table,
                 jnp.int32(max(take, 0)))
-            logits = out
+        return logits
+
+    def _prefill(self, r: Request) -> None:
+        import jax.numpy as jnp
+
+        if r.kv_import is not None:
+            self._import_kv(r)
+            return
+        _faults.fire("serve_prefill", f"rid{r.rid}")
+        prompt = r.prompt
+        n_chunks = -(-len(prompt) // self.page_tokens)
+        # prefix-cache hit: chunks [0, c0) were adopted already-filled, so
+        # the forward pass resumes at the first uncached chunk; the match
+        # cap guarantees c0 < n_chunks — the last prompt token's logits
+        # are always computed fresh
+        c0 = min(r.cached_tokens // self.page_tokens, n_chunks - 1)
+        table = jnp.asarray(self._padded_table(r.rid)[None])
+        logits = self._prefill_chunks(prompt, table, c0)
         tok = int(np.argmax(np.asarray(logits)))
         r.generated.append(tok)
         self.meter.first_token(r.rid)
         self._deliver(r, tok)
+        if self.prefix is not None:
+            # register this prompt's FULL pages for future requests (the
+            # chunks matched at admission just get their LRU refreshed)
+            self.prefix.insert(r.prompt, self.pool.table(r.rid))
         if self.spec is not None:
             # (re)build the drafter here so eviction replay and crash
             # recovery get a fresh one primed with exactly the tokens a
@@ -759,6 +913,90 @@ class ServingEngine:
             r.drafter = self.spec.make_drafter()
             r.drafter.begin([int(t) for t in r.prompt])
             r.drafter.observe([tok])
+
+    def _import_kv(self, r: Request) -> None:
+        """Disaggregated admission (ISSUE 19 leg 2): instead of running
+        the prefill program, scatter the KV page frames a prefill-tier
+        worker streamed through the depot into this engine's arenas, then
+        deliver the first token that worker's prefill chose.
+        Deterministic prefill makes the imported pages bit-identical to a
+        local prefill, so eviction replay (re-import, ``kv_import`` stays
+        on the request) and crash replay (local re-prefill from the
+        journaled prompt) are both token-exact."""
+        import jax.numpy as jnp
+
+        _faults.fire("serve_prefill", f"rid{r.rid}")
+        first_tok, frames = r.kv_import
+        pids = self.pool.table(r.rid)[:len(frames)]
+        idx = jnp.asarray(np.asarray(pids, np.int32))
+        for key, arrs in self._arenas.items():
+            # frame[key] is [layers, page_tokens, ...] for ONE page;
+            # stack to [layers, n_pages, page_tokens, ...]
+            stacked = np.stack([np.asarray(f[key]) for f in frames],
+                               axis=1)
+            for li in range(len(arrs)):
+                arrs[li] = self._page_write(arrs[li], idx, stacked[li])
+        tok = int(first_tok)
+        r.generated.append(tok)
+        self.meter.first_token(r.rid)
+        self._deliver(r, tok)
+        if self.spec is not None:
+            r.drafter = self.spec.make_drafter()
+            r.drafter.begin([int(t) for t in r.prompt])
+            r.drafter.observe([tok])
+        _event("serve_kv_import", str(r.rid), pages=len(frames),
+               trace=r.trace_id)
+
+    def _page_write(self, arena, idx, vals):
+        """Host-side page scatter (the KV-import path): writes whole
+        pages at ``idx`` and keeps the arena's sharding committed so the
+        next compiled call sees the exact signature it lowered for."""
+        import jax
+        import jax.numpy as jnp
+
+        out = arena.at[idx].set(jnp.asarray(vals).astype(arena.dtype))
+        if self._mesh is not None:
+            out = jax.device_put(out, arena.sharding)
+        return out
+
+    def prefill_export(self, prompt):
+        """Run a standalone prefill and EXPORT the finished pages instead
+        of scheduling decode: returns ``(first_token, frames)`` where
+        ``frames`` holds one host dict per prompt page (``k``/``v`` and,
+        for int8 pools, ``ks``/``vs`` planes, each ``[layers,
+        page_tokens, ...]``).  This is the prefill-tier workhorse
+        (:class:`~paddle_tpu.serving.disagg.PrefillWorker`): pages are
+        allocated, filled by the SAME compiled prefill program a local
+        admission would use, copied out, and freed — nothing stays
+        scheduled on this engine."""
+        import jax.numpy as jnp
+
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        need = self.pool.pages_for(len(prompt))
+        if need > min(self.pool.capacity, self.max_pages_per_seq):
+            raise ValueError(
+                f"prompt needs {need} pages; this prefill engine takes "
+                f"at most {min(self.pool.capacity, self.max_pages_per_seq)}")
+        self._export_seq = getattr(self, "_export_seq", 0) + 1
+        key = ("__prefill_export__", self._export_seq)
+        self.pool.alloc(key, need)
+        try:
+            t = np.full((self.max_pages_per_seq,), TRASH_PAGE, np.int32)
+            pages = self.pool.table(key)
+            t[:len(pages)] = pages
+            logits = self._prefill_chunks(prompt, jnp.asarray(t[None]))
+            first = int(np.argmax(np.asarray(logits)))
+            frames = [self._export_page(p) for p in pages]
+            return first, frames
+        finally:
+            self.pool.free(key)
+
+    def _export_page(self, pid: int) -> dict:
+        """Host copy of one physical page across every layer and plane."""
+        return {key: np.stack([np.asarray(a[pid]) for a in arrs])
+                for key, arrs in self._arenas.items()}
 
     def _decode_step(self) -> None:
         """One verify-wide decode step.  Serial mode (spec off) is the
@@ -1146,11 +1384,26 @@ class ServingEngine:
             return ([p._value for p in self._params],
                     [b._value for b in self._buffers])
 
+    def _repl(self, x):
+        """Committed-replicated copy of a step input under the TP mesh
+        (no-op unsharded).  Compiled signatures are sharding-sensitive:
+        an uncommitted host array could lower with a different layout
+        than the one the executable was built for."""
+        if self._mesh is None:
+            return x
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.device_put(x, NamedSharding(self._mesh,
+                                               PartitionSpec()))
+
     def _run_decode(self, tokens, positions, tables, n_tok):
         import jax
 
         pa, ba = self._param_arrays()
-        args = (pa, ba, self._arenas, tokens, positions, tables, n_tok)
+        args = (pa, ba, self._arenas, self._repl(tokens),
+                self._repl(positions), self._repl(tables),
+                self._repl(n_tok))
         if self._decode_exec is None:
             self._decode_compiles += 1
             jitted = jax.jit(self._decode_fn, donate_argnums=(2,))
@@ -1159,7 +1412,7 @@ class ServingEngine:
             if self._lint:
                 self.lint_report = check_decode_donation(
                     self._decode_exec, self._arena_bytes,
-                    scale_bytes=self._scale_bytes)
+                    scale_bytes=self._scale_bytes, shards=self.tp)
         logits, self._arenas = self._decode_exec(*args)
         return logits
 
@@ -1167,8 +1420,9 @@ class ServingEngine:
         import jax
 
         pa, ba = self._param_arrays()
-        args = (pa, ba, self._arenas, tokens, chunk_start, tables,
-                take_idx)
+        args = (pa, ba, self._arenas, self._repl(tokens),
+                self._repl(chunk_start), self._repl(tables),
+                self._repl(take_idx))
         if self._prefill_exec is None:
             jitted = jax.jit(self._prefill_fn, donate_argnums=(2,))
             with _SWAP_LOCK:

@@ -102,6 +102,14 @@ def _status_interval(ttl: float) -> float:
     return max(0.05, _env_float("PADDLE_TPU_SERVE_FLEET_STATUS", ttl / 5.0))
 
 
+def _serve_tier() -> str:
+    """This replica's serving tier (``PADDLE_TPU_SERVE_TIER``): the
+    launcher tags dedicated prefill children ``prefill``; everything else
+    is ``decode``.  Published on the lease so the router can land
+    TTFT-bound work on prefill capacity (ISSUE 19 disaggregation)."""
+    return os.environ.get("PADDLE_TPU_SERVE_TIER", "decode") or "decode"
+
+
 # -- in-memory KV (single-process fleets: bench, unit tests) -----------------
 
 class LocalKV:
@@ -310,12 +318,16 @@ def _engine_status(engine: ServingEngine) -> dict:
     # status before the emission reaches the sink (the next poll picks the
     # rid up once the flush lands)
     pending = {rid for rid, _i, _t in list(engine._pending_delivery)}
+    prefix = getattr(engine, "prefix", None)
     return {"queue_depth": len(engine._queue),
             "active": len(engine._active),
             "est_first_token_s": engine.meter.est_first_token_s(),
             "finished": sorted(r for r in engine._results
                                if r not in pending),
             "shed": {int(r): v for r, v in engine.shed.items()},
+            "tier": _serve_tier(),
+            "prefix_hit_rate": (None if prefix is None
+                                else prefix.hit_rate()),
             "summary": engine.meter.summary()}
 
 
@@ -373,6 +385,7 @@ class _StatusLoop(threading.Thread):
             queue_depth=st["queue_depth"], active=st["active"],
             est_first_token_s=st["est_first_token_s"],
             tpot_ema_ms=None if ema is None else ema * 1e3,
+            tier=st["tier"], prefix_hit_rate=st["prefix_hit_rate"],
             warming=self._engine.first_step_wall is None,
             draining=bool(self._flags.draining) if self._flags else False,
             degraded=bool(self._flags.degraded) if self._flags else False)
@@ -414,6 +427,7 @@ class EngineReplica:
             payload={"name": self.name, "address": "inproc",
                      "capacity": self.engine.admission.max_queue,
                      "epoch": self.epoch, "pid": os.getpid(),
+                     "tier": _serve_tier(),
                      "warming": True, "draining": False})
         self._status = _StatusLoop(self.lease, self.engine,
                                    _status_interval(self.ttl),
@@ -712,6 +726,7 @@ def run_replica(model, name: Optional[str] = None, *,
         payload={"name": name, "address": server.address,
                  "capacity": engine.admission.max_queue,
                  "epoch": epoch, "pid": os.getpid(),
+                 "tier": _serve_tier(),
                  "warming": True, "draining": False})
     status = _StatusLoop(lease, engine, _status_interval(t), flags=flags)
     # a retire must hit the lease NOW, not a status beat later: the
@@ -826,6 +841,7 @@ class ServingFrontend:
             st = ReplicaStatus.from_doc(name, doc)
             st.draining = st.draining or name in self._draining
             st.degraded = st.degraded or name in self._degraded
+            st.extra["prefix_hit_rate"] = doc.get("prefix_hit_rate")
             out[name] = (st, age, doc)
         return out
 
@@ -841,8 +857,18 @@ class ServingFrontend:
                 continue
             out.append(st)
         self.meter.set_live_replicas(len(out))
+        tiers: Dict[str, List[float]] = {}
+        rates: List[float] = []
         for st in out:
             self.meter.set_replica_queue_depth(st.name, st.queue_depth)
+            tiers.setdefault(st.tier, []).append(st.load)
+            r = st.extra.get("prefix_hit_rate")
+            if isinstance(r, (int, float)):
+                rates.append(float(r))
+        for tier, loads in sorted(tiers.items()):
+            self.meter.set_tier_occupancy(tier, sum(loads) / len(loads))
+        self.meter.set_prefix_hit_rate(
+            sum(rates) / len(rates) if rates else None)
         return out
 
     def live_replicas(self) -> List[str]:
@@ -897,8 +923,14 @@ class ServingFrontend:
                       exclude: Set[str] = frozenset()) -> str:
         deadline = Deadline.from_doc(desc.get("deadline"))
         trace_id = desc.get("trace_id")
+        # TTFT-bound work PREFERS the dedicated prefill tier when one
+        # exists (the router falls back to the whole candidate set when it
+        # does not — a homogeneous fleet routes exactly as before)
+        tier = "prefill" if (deadline is not None
+                             and deadline.ttft_s is not None) else None
         order = self.router.order(self._routable(exclude), deadline,
-                                  age_s=age_s, trace_id=trace_id)
+                                  age_s=age_s, tier=tier,
+                                  trace_id=trace_id)
         if not order:
             raise Overloaded("no live serving replicas",
                              reason="no_replicas")
@@ -1296,6 +1328,18 @@ class ServingFrontend:
             time.sleep(poll)
         return want <= self.finished_rids()
 
+    def publish_disagg(self) -> None:
+        """Push the frontend's disaggregation self-report (prefix hit
+        rate, per-tier occupancy, prefill-tier counters) to the metrics
+        depot as the ``disagg`` extra — the report CLI folds it with
+        latest-``wall_time``-wins, mirroring the autoscaler's doc."""
+        try:
+            self.depot.metrics_push("frontend", {
+                "src": "frontend", "wall_time": self._wall(),
+                "disagg": self.meter.disagg_doc()})
+        except (OSError, AttributeError):
+            pass   # a flaky depot link must not kill the scan loop
+
     # -- background scanning ----------------------------------------------
     def start(self) -> "ServingFrontend":
         """Run :meth:`scan_once` on a daemon thread every
@@ -1308,6 +1352,7 @@ class ServingFrontend:
                 while not self._stop.wait(interval):
                     try:
                         self.scan_once()
+                        self.publish_disagg()
                     except Exception:
                         pass   # a flaky store read must not kill the scan
             self._scan_thread = threading.Thread(

@@ -14,6 +14,16 @@ the trash page — inactive batch rows in the compiled decode program write
 their (ignored) k/v there, so a row going idle never needs a reshape or a
 recompile.
 
+Pages are copy-on-write shareable (ISSUE 19 prefix caching): every
+allocated page carries a refcount, a request's table can ``adopt`` pages
+another holder already filled, and a page returns to the free list only
+when its LAST reference drops.  "Copy-on-write" here is enforced by
+construction rather than by copying: shared pages are always FULL prompt
+pages (every token slot written by the prefill that created them), and
+decode writes land at positions past the shared prefix, i.e. in pages the
+request allocated privately — so no writer can ever touch a shared page
+and no copy is ever needed.
+
 Env: ``PADDLE_TPU_PAGE_TOKENS`` sets the default page size (tokens per
 page).
 """
@@ -57,6 +67,10 @@ class PagedKVPool:
         self.page_tokens = int(page_tokens)
         self._free: List[int] = list(range(num_pages - 1, TRASH_PAGE, -1))
         self._tables: Dict[object, List[int]] = {}
+        # COW refcounts: page id -> live references (>= 1 while allocated).
+        # A page is EITHER on the free list OR in here, never both; the
+        # trash page is in neither (it is not allocatable state).
+        self._refs: Dict[int, int] = {}
         self._peak_used = 0
         # byte accountant (engine fills in via set_page_bytes): HBM cost
         # of one page's k+v arena slices and of its scale slices (int8
@@ -130,9 +144,74 @@ class PagedKVPool:
                 f"need {n} pages, {len(self._free)} free "
                 f"({self.pages_used}/{self.capacity} in use)")
         got = [self._free.pop() for _ in range(n)]
+        for p in got:
+            self._refs[p] = 1
         self._tables.setdefault(rid, []).extend(got)
         self._peak_used = max(self._peak_used, self.pages_used)
         return got
+
+    # -- COW sharing (ISSUE 19 prefix cache) -------------------------------
+    def refcount(self, page: int) -> int:
+        """Live references on ``page`` (0 = free / never allocated)."""
+        return self._refs.get(int(page), 0)
+
+    def shared_pages(self) -> int:
+        """Allocated pages with more than one live reference."""
+        return sum(1 for c in self._refs.values() if c > 1)
+
+    def incref(self, pages) -> None:
+        """Take an additional reference on already-allocated pages (a
+        prefix-trie node pinning a page, or a table adopting one).  The
+        trash page is never refcounted, and a page must be live (on some
+        holder, not the free list) to gain references — both violations
+        are caller bugs and raise."""
+        for p in pages:
+            p = int(p)
+            if p == TRASH_PAGE:
+                raise ValueError("incref of the trash page (page 0): the "
+                                 "trash page is compiled-shape overhead, "
+                                 "never allocatable state")
+            if p not in self._refs:
+                raise KeyError(f"incref of free/unknown page {p}: only "
+                               f"live pages can gain references")
+            self._refs[p] += 1
+
+    def decref(self, pages) -> int:
+        """Drop one reference per page; pages reaching zero return to the
+        free list.  Returns how many actually freed.  Dropping below zero
+        (a double-free of a shared page) raises — that is always a
+        refcount-discipline bug, never a recoverable state."""
+        freed = 0
+        for p in pages:
+            p = int(p)
+            if p == TRASH_PAGE:
+                raise ValueError("decref of the trash page (page 0)")
+            c = self._refs.get(p, 0)
+            if c <= 0:
+                raise KeyError(f"double-free: decref of page {p} with no "
+                               f"live references")
+            if c == 1:
+                del self._refs[p]
+                self._free.append(p)
+                freed += 1
+            else:
+                self._refs[p] = c - 1
+        return freed
+
+    def adopt(self, rid, pages) -> List[int]:
+        """Append already-allocated ``pages`` to ``rid``'s block table,
+        taking a reference on each (the prefix-cache hit path: the trie
+        keeps its reference, the request gains its own).  All-or-nothing:
+        validates every page before touching any refcount."""
+        pages = [int(p) for p in pages]
+        for p in pages:
+            if p == TRASH_PAGE:
+                raise ValueError("adopt of the trash page (page 0)")
+            if p not in self._refs:
+                raise KeyError(f"adopt of free/unknown page {p}")
+        self.incref(pages)
+        self._tables.setdefault(rid, []).extend(pages)
+        return pages
 
     def table(self, rid) -> List[int]:
         """The request's block table: physical page of logical page ``j``
@@ -140,21 +219,36 @@ class PagedKVPool:
         return list(self._tables.get(rid, ()))
 
     def free(self, rid) -> int:
-        """Release every page ``rid`` owns; returns the count.  Unknown
-        ``rid`` raises — a double-free is always an engine bug."""
+        """Drop ``rid``'s reference on every page it owns; returns how many
+        pages actually returned to the free list (pages still pinned by the
+        prefix trie or another table survive with their data intact).
+        Unknown ``rid`` raises — a double-free is always an engine bug."""
         if rid not in self._tables:
             raise KeyError(f"free of unknown/already-freed request {rid!r}")
         pages = self._tables.pop(rid)
-        self._free.extend(reversed(pages))
-        return len(pages)
+        return self.decref(reversed(pages))
 
-    def check_leaks(self) -> None:
-        """Assert the quiesced-pool invariant: every page either free or on
-        the free list exactly once, no table left behind."""
+    def check_leaks(self, allow_shared: bool = False) -> None:
+        """Assert the quiesced-pool invariant: no table left behind, and
+        the free list plus the ref'd pages partition ``{1..num_pages-1}``
+        exactly — a page shared by k holders still counts ONCE.  With
+        ``allow_shared`` (engine shutdown with a live prefix cache), pages
+        the trie still pins are legal; otherwise any surviving reference
+        is a leak."""
         if self._tables:
             raise AssertionError(
                 f"leaked block tables: { {k: len(v) for k, v in self._tables.items()} }")
-        if sorted(self._free) != list(range(1, self.num_pages)):
+        if not allow_shared and self._refs:
             raise AssertionError(
-                f"free list corrupt: {len(self._free)} pages, "
-                f"expected {self.capacity}")
+                f"leaked page references: { {p: c for p, c in sorted(self._refs.items())} }")
+        free_set = set(self._free)
+        if len(free_set) != len(self._free):
+            raise AssertionError("free list corrupt: duplicate entries")
+        if free_set & set(self._refs):
+            raise AssertionError(
+                f"pages both free and referenced: "
+                f"{sorted(free_set & set(self._refs))}")
+        if free_set | set(self._refs) != set(range(1, self.num_pages)):
+            raise AssertionError(
+                f"page accounting corrupt: {len(self._free)} free + "
+                f"{len(self._refs)} referenced != capacity {self.capacity}")

@@ -56,11 +56,14 @@ def kv_cache_dtype(override: Optional[str] = None) -> str:
         return "int8"
     if v in ("fp8", "f8", "f8e4m3fn", "f8e5m2"):
         raise NotImplementedError(
-            "PADDLE_TPU_KV_DTYPE=fp8: the fp8 KV seam is stubbed — "
+            "PADDLE_TPU_KV_DTYPE=fp8: the fp8 KV seam is stubbed — it is "
+            "ROADMAP item 5 (long-context scenario ladder: the "
+            "decode-bandwidth rung carried over from old item 2). "
             "analysis.program.DTYPE_BYTES already prices f8e4m3fn pages "
             "and observe_kv_absmax provides the static per-tensor scale "
-            "it needs, but no fp8 scatter/gather path is wired yet; use "
-            "int8")
+            "it needs, but no fp8 scatter/gather path is wired yet. "
+            f"Supported PADDLE_TPU_KV_DTYPE values: {KV_DTYPES} "
+            "(aliases: bfloat16/native/f32/float32 -> bf16, s8 -> int8)")
     raise ValueError(
         f"PADDLE_TPU_KV_DTYPE={v!r}: expected one of {KV_DTYPES} "
         f"(fp8 is a stubbed seam)")

@@ -449,6 +449,10 @@ class FleetMeter:
         self.degraded_ejects_total = 0
         self.degraded_readmits_total = 0
         self.last_autoscale: Optional[Dict[str, object]] = None
+        self.prefill_routed_total = 0
+        self.prefill_fallbacks_total = 0
+        self.prefix_hit_rate: Optional[float] = None
+        self.tier_occupancy: Dict[str, float] = {}
 
     def set_live_replicas(self, n: int) -> None:
         self.live_replicas = int(n)
@@ -505,6 +509,45 @@ class FleetMeter:
         record_event("autoscale_decision", str(direction),
                      target=int(target), reason=str(reason))
 
+    def set_prefix_hit_rate(self, rate: Optional[float]) -> None:
+        """Fleet-wide prefix-cache hit rate (token-weighted mean over the
+        replicas that publish one; ``None`` when no replica caches)."""
+        self.prefix_hit_rate = None if rate is None else float(rate)
+        if rate is not None:
+            set_gauge("serving.fleet_prefix_hit_rate", float(rate))
+
+    def set_tier_occupancy(self, tier: str, occupancy: float) -> None:
+        """Mean load of one serving tier (``prefill`` / ``decode``), as
+        the frontend's lease scan measures it — the capacity-planning
+        signal for the disaggregated split."""
+        self.tier_occupancy[str(tier)] = float(occupancy)
+        set_gauge(f"serving.fleet_tier_occupancy.{tier}", float(occupancy))
+
+    def prefill_route(self, name: str, rid: int) -> None:
+        """One long prompt routed through the dedicated prefill tier."""
+        self.prefill_routed_total += 1
+        bump("serving.fleet_prefill_routed_total")
+        record_event("fleet_prefill_route", str(name), rid=int(rid))
+
+    def prefill_fallback(self, name: str, rid: int, reason: str) -> None:
+        """A prefill-tier attempt abandoned mid-flight (worker death,
+        fenced epoch, pruned KV frames) — the request fell back to a
+        plain decode-tier prefill, exactly-once preserved."""
+        self.prefill_fallbacks_total += 1
+        bump("serving.fleet_prefill_fallbacks_total")
+        record_event("fleet_prefill_fallback", str(name), rid=int(rid),
+                     reason=str(reason))
+
+    def disagg_doc(self) -> Dict[str, object]:
+        """The frontend's disaggregation self-report, pushed to the
+        metrics depot as the ``disagg`` extra (the report CLI's
+        prefix-hit-rate / per-tier occupancy rows; latest ``wall_time``
+        wins in the rollup, mirroring ``autoscale``)."""
+        return {"prefix_hit_rate": self.prefix_hit_rate,
+                "tier_occupancy": dict(self.tier_occupancy),
+                "prefill_routed_total": self.prefill_routed_total,
+                "prefill_fallbacks_total": self.prefill_fallbacks_total}
+
     def failover(self, name: str, replayed: int = 0) -> None:
         self.failovers_total += 1
         self.replayed_requests_total += int(replayed)
@@ -533,4 +576,8 @@ class FleetMeter:
                 "degraded_replicas": self.degraded_replicas,
                 "degraded_ejects": self.degraded_ejects_total,
                 "degraded_readmits": self.degraded_readmits_total,
-                "last_autoscale": self.last_autoscale}
+                "last_autoscale": self.last_autoscale,
+                "prefill_routed": self.prefill_routed_total,
+                "prefill_fallbacks": self.prefill_fallbacks_total,
+                "prefix_hit_rate": self.prefix_hit_rate,
+                "tier_occupancy": dict(self.tier_occupancy)}

@@ -1,0 +1,230 @@
+"""Prefix cache: a radix/trie index over paged KV, COW page refcounts.
+
+ISSUE 19 leg 3 — the "millions of users hitting the same assistant
+preamble" win.  The trie maps *page-aligned token chunks* to physical KV
+pool pages that some earlier prefill already filled: a new request whose
+prompt starts with a cached prefix ``adopt``\\ s those pages (refcount++,
+see :mod:`kv_pool`) instead of re-prefilling them, and its prefill starts
+at the first uncached page.
+
+Correctness contract (why a hit is token-exact vs the re-prefill oracle):
+
+* keys are exact token tuples at page granularity — a page is only
+  reused when the request's tokens at those positions are IDENTICAL;
+* attention is causal and positions are absolute, so the KV written for
+  tokens ``[0, n)`` does not depend on anything after ``n``;
+* prefill and KV quantization are deterministic, so the cached page holds
+  bit-identical contents to what a fresh prefill would write;
+* only FULL pages are ever cached (``len(prompt) // page_tokens``), and
+  the page holding the LAST prompt token is never matched — its logits
+  must be recomputed to produce the first output token, and decode writes
+  always land past the shared prefix, in privately-allocated pages (the
+  COW-by-construction rule in :mod:`kv_pool`).
+
+Eviction: leaf-only LRU under a page budget (``PADDLE_TPU_PREFIX_PAGES``).
+Evicting a node drops the trie's reference; a request that adopted the
+page keeps it alive until its own free — the preemption path (ISSUE 10
+``_evict``) therefore composes: an evicted request's ``pool.free`` merely
+decrefs, the trie keeps the prefix warm, and the re-admitted request hits
+it again.
+
+``clear()`` drops every trie reference — the "poisoned prefix cache"
+remediation (see README failure matrix): suspected-corrupt cached pages
+stop being handed to new requests immediately, in-flight adopters finish
+on their own references, and the next prefills repopulate from scratch.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+from ..telemetry import record_event
+from ..telemetry.runtime import bump, set_gauge
+from .kv_pool import TRASH_PAGE, PagedKVPool
+
+__all__ = ["PrefixCache", "default_prefix_pages"]
+
+
+def default_prefix_pages() -> int:
+    """Trie page budget (``PADDLE_TPU_PREFIX_PAGES``, default 64)."""
+    return int(os.environ.get("PADDLE_TPU_PREFIX_PAGES", "64"))
+
+
+class _Node:
+    """One cached page: keyed in its parent by the page's exact token
+    tuple, holding the physical page id and an LRU stamp."""
+
+    __slots__ = ("chunk", "page", "children", "last_used")
+
+    def __init__(self, chunk: Tuple[int, ...], page: int):
+        self.chunk = chunk
+        self.page = int(page)
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.last_used = 0
+
+
+class PrefixCache:
+    """Radix trie over :class:`PagedKVPool` pages, COW refcounted.
+
+    The cache owns one pool reference per node (taken at ``insert`` via
+    ``pool.incref``, dropped at eviction/``clear`` via ``pool.decref``);
+    requests that hit take their OWN references via ``pool.adopt``, so
+    trie eviction and request preemption are order-independent.
+    """
+
+    def __init__(self, pool: PagedKVPool, *, max_pages: Optional[int] = None):
+        self.pool = pool
+        self.page_tokens = pool.page_tokens
+        self.max_pages = int(max_pages if max_pages is not None
+                             else default_prefix_pages())
+        self._root: Dict[Tuple[int, ...], _Node] = {}
+        self._nodes = 0
+        self._clock = 0          # monotonic LRU stamp (no wall time)
+        self.hits = 0            # admissions that adopted >= 1 cached page
+        self.misses = 0          # admissions that found nothing
+        self.tokens_saved = 0    # prompt tokens served from cache
+        self.pages_inserted = 0
+        self.pages_evicted = 0
+
+    # -- lookup ------------------------------------------------------------
+    def _chunks(self, prompt, n_pages: int) -> List[Tuple[int, ...]]:
+        P = self.page_tokens
+        return [tuple(int(t) for t in prompt[j * P:(j + 1) * P])
+                for j in range(n_pages)]
+
+    def match(self, prompt) -> Tuple[List[int], int]:
+        """Longest cached page-prefix of ``prompt``.
+
+        Returns ``(pages, n_tokens)``.  The walk is capped at
+        ``(len(prompt) - 1) // page_tokens`` pages so the page holding the
+        last prompt token is NEVER matched: its forward pass must run to
+        produce the first-output-token logits, so at least one page is
+        always prefilled locally.  Does not touch hit/miss counters —
+        admission calls :meth:`note` once per admitted request so a head
+        request retried across scheduler steps is not multi-counted.
+        """
+        cap = max(0, (len(prompt) - 1) // self.page_tokens)
+        self._clock += 1
+        pages: List[int] = []
+        kids = self._root
+        for chunk in self._chunks(prompt, cap):
+            node = kids.get(chunk)
+            if node is None:
+                break
+            node.last_used = self._clock
+            pages.append(node.page)
+            kids = node.children
+        return pages, len(pages) * self.page_tokens
+
+    def note(self, hit: bool, n_tokens: int = 0) -> None:
+        """Record one admission outcome (kept separate from :meth:`match`
+        so repeated head-of-queue probes don't skew the rate)."""
+        if hit:
+            self.hits += 1
+            self.tokens_saved += int(n_tokens)
+            bump("serving.prefix_hits_total")
+        else:
+            self.misses += 1
+            bump("serving.prefix_misses_total")
+        set_gauge("serving.prefix_hit_rate", self.hit_rate())
+
+    # -- insert ------------------------------------------------------------
+    def insert(self, prompt, table: List[int]) -> int:
+        """Register the FULL pages of a just-prefilled prompt; returns how
+        many new nodes were created.  Partial tail pages are never cached
+        (decode will keep writing into them).  Where the trie already holds
+        a node for a chunk, the trie's page wins (both hold identical
+        bytes by the determinism contract) and the walk descends without
+        taking new references."""
+        P = self.page_tokens
+        n_full = min(len(prompt) // P, len(table))
+        self._clock += 1
+        kids = self._root
+        added = 0
+        for j, chunk in enumerate(self._chunks(prompt, n_full)):
+            node = kids.get(chunk)
+            if node is None:
+                page = int(table[j])
+                if page == TRASH_PAGE or self.pool.refcount(page) == 0:
+                    break     # defensive: never cache trash/freed pages
+                self.pool.incref([page])
+                node = _Node(chunk, page)
+                kids[chunk] = node
+                self._nodes += 1
+                added += 1
+            node.last_used = self._clock
+            kids = node.children
+        if added:
+            self.pages_inserted += added
+            self._evict_to_budget()
+            set_gauge("serving.prefix_pages_held", self._nodes)
+        return added
+
+    # -- eviction ----------------------------------------------------------
+    def _leaves(self):
+        """(parent_dict, node) for every leaf, iteratively (deep tries on
+        long prompts must not hit the recursion limit)."""
+        out = []
+        stack = [(self._root, n) for n in self._root.values()]
+        while stack:
+            parent_of = stack.pop()
+            _, node = parent_of
+            if node.children:
+                stack.extend((node.children, c) for c in node.children.values())
+            else:
+                out.append(parent_of)
+        return out
+
+    def _evict_to_budget(self) -> int:
+        """Leaf-only LRU down to ``max_pages`` nodes.  Evicting a leaf
+        drops ONLY the trie's reference — adopters keep the page alive —
+        and leaf-only order guarantees a surviving node's prefix path is
+        always fully cached."""
+        evicted = 0
+        while self._nodes > self.max_pages:
+            leaves = self._leaves()
+            if not leaves:
+                break
+            parent, victim = min(leaves, key=lambda pn: pn[1].last_used)
+            del parent[victim.chunk]
+            self._nodes -= 1
+            self.pool.decref([victim.page])
+            evicted += 1
+        if evicted:
+            self.pages_evicted += evicted
+            bump("serving.prefix_pages_evicted_total", evicted)
+        return evicted
+
+    def clear(self) -> int:
+        """Drop every cached page (poisoned-cache remediation); returns
+        the number of pages released back toward the pool."""
+        n = 0
+        stack = list(self._root.values())
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            self.pool.decref([node.page])
+            n += 1
+        self._root = {}
+        self._nodes = 0
+        if n:
+            record_event("prefix_cache_clear", "prefix", pages=n)
+            set_gauge("serving.prefix_pages_held", 0)
+        return n
+
+    # -- introspection -----------------------------------------------------
+    def pages_held(self) -> int:
+        return self._nodes
+
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+    def summary(self) -> dict:
+        return {"pages_held": self._nodes, "max_pages": self.max_pages,
+                "hits": self.hits, "misses": self.misses,
+                "hit_rate": round(self.hit_rate(), 4),
+                "tokens_saved": self.tokens_saved,
+                "pages_inserted": self.pages_inserted,
+                "pages_evicted": self.pages_evicted}

@@ -27,6 +27,12 @@ deadline-aware spill:
   an over-budget estimate is, but stay routable for traffic without a
   TTFT budget; when EVERY routable replica is warming the pick falls
   back rather than refusing (same rationale as the all-spilled case).
+- **tiers** (ISSUE 19 disaggregation) — replicas advertise a ``tier``
+  (``decode`` by default; ``prefill`` for the dedicated prefill tier).
+  A tier-targeted pick PREFERS matching replicas and falls back to the
+  whole candidate set when the tier is empty/dead — TTFT-bound long
+  prompts land on prefill capacity when it exists, but the fleet
+  degrades to homogeneous serving rather than refusing.
 """
 
 from __future__ import annotations
@@ -55,6 +61,7 @@ class ReplicaStatus:
     warming: bool = False            # no completed step yet (cold start)
     degraded: bool = False           # latency outlier, route-excluded
     tpot_ema_ms: Optional[float] = None   # decode-speed trend (EWMA)
+    tier: str = "decode"             # serving tier (prefill / decode)
     extra: dict = field(default_factory=dict)
 
     @property
@@ -73,7 +80,8 @@ class ReplicaStatus:
                    draining=bool(doc.get("draining", False)),
                    warming=bool(doc.get("warming", False)),
                    degraded=bool(doc.get("degraded", False)),
-                   tpot_ema_ms=doc.get("tpot_ema_ms"))
+                   tpot_ema_ms=doc.get("tpot_ema_ms"),
+                   tier=str(doc.get("tier", "decode")))
 
 
 class Router:
@@ -82,16 +90,23 @@ class Router:
     def pick(self, replicas: List[ReplicaStatus],
              deadline: Optional[Deadline] = None, *,
              age_s: float = 0.0,
+             tier: Optional[str] = None,
              trace_id: Optional[str] = None) -> Optional[ReplicaStatus]:
         """Best replica for one request, or ``None`` when no routable
         replica exists at all (every one dead, draining or degraded).
-        With a
+        ``tier`` is a PREFERENCE: matching replicas win when any are
+        routable, otherwise the pick falls back to the full candidate
+        set (a fleet whose prefill tier died keeps serving).  With a
         ``trace_id`` the decision is stamped into the flight recorder
         (``fleet_route``) so the merged black box shows WHY a request
         landed where it did."""
         cands = [r for r in replicas if not r.draining and not r.degraded]
         if not cands:
             return None
+        if tier is not None:
+            tiered = [r for r in cands if r.tier == tier]
+            if tiered:
+                cands = tiered
         budget = None
         if deadline is not None and deadline.ttft_s is not None:
             budget = deadline.ttft_s - age_s
@@ -117,6 +132,7 @@ class Router:
     def order(self, replicas: List[ReplicaStatus],
               deadline: Optional[Deadline] = None, *,
               age_s: float = 0.0,
+              tier: Optional[str] = None,
               trace_id: Optional[str] = None) -> List[ReplicaStatus]:
         """All routable replicas, best first — the frontend walks this so
         a replica-side refusal (``Overloaded``) spills to the next one.
@@ -125,7 +141,7 @@ class Router:
         out: List[ReplicaStatus] = []
         pool = list(replicas)
         while True:
-            best = self.pick(pool, deadline, age_s=age_s,
+            best = self.pick(pool, deadline, age_s=age_s, tier=tier,
                              trace_id=trace_id if not out else None)
             if best is None:
                 return out

@@ -337,6 +337,9 @@ def rollup(snapshots: Dict[str, Dict[str, Any]],
     - ``autoscale``: the newest autoscaler self-report (replica states,
       occupancy, last decision) — latest ``wall_time`` wins, so a stale
       doc from a dead controller never shadows the live one.
+    - ``disagg``: the newest frontend disaggregation self-report (prefix
+      hit rate, per-tier occupancy, prefill-tier route/fallback totals);
+      same latest-``wall_time``-wins fold.
     """
     out: Dict[str, Any] = {"wall_time": time.time(),
                            "sources": sorted(snapshots),
@@ -347,12 +350,18 @@ def rollup(snapshots: Dict[str, Dict[str, Any]],
     step_dt: Dict[str, float] = {}
     mfu: Dict[str, float] = {}
     autoscale_wall = float("-inf")
+    disagg_wall = float("-inf")
     for src, doc in sorted(snapshots.items()):
         if doc.get("autoscale"):
             wall = float(doc.get("wall_time") or 0.0)
             if wall >= autoscale_wall:
                 autoscale_wall = wall
                 out["autoscale"] = dict(doc["autoscale"])
+        if doc.get("disagg"):
+            wall = float(doc.get("wall_time") or 0.0)
+            if wall >= disagg_wall:
+                disagg_wall = wall
+                out["disagg"] = dict(doc["disagg"])
         slo = doc.get("slo") or {}
         if slo:
             out["replicas"].append(src)

@@ -72,6 +72,16 @@ def dashboard_text(snapshots: Dict[str, Dict[str, Any]],
         if states:
             lines.append("  states: " + " ".join(
                 f"{n}={s}" for n, s in sorted(states.items())))
+    dis = agg.get("disagg")
+    if dis:
+        tiers = dis.get("tier_occupancy") or {}
+        tier_txt = " ".join(f"{t}={_fmt(o)}"
+                            for t, o in sorted(tiers.items())) or "-"
+        lines.append(
+            f"disagg: prefix_hit_rate={_fmt(dis.get('prefix_hit_rate'))} "
+            f"tier_occupancy: {tier_txt} "
+            f"prefill_routed={dis.get('prefill_routed_total', 0)} "
+            f"fallbacks={dis.get('prefill_fallbacks_total', 0)}")
     if agg["ranks"]:
         straggler = agg.get("straggler")
         conf = agg.get("straggler_confirmed")
@@ -130,6 +140,11 @@ def _smoke_snapshots() -> Dict[str, Dict[str, Any]]:
                                         "reason": "occupancy_high"},
                       "states": {"r0": "SERVING", "r1": "WARMING",
                                  "r2": "DEGRADED"}}}))
+    depot.metrics_push("frontend", local_snapshot(extra={
+        "disagg": {"prefix_hit_rate": 0.4,
+                   "tier_occupancy": {"decode": 0.3, "prefill": 0.7},
+                   "prefill_routed_total": 3,
+                   "prefill_fallbacks_total": 1}}))
     depot.metrics_push("rank0", local_snapshot(
         step_summary={"steps": 8, "total_s": 4.0, "mfu": 0.41},
         extra={"rank": 0}))

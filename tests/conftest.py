@@ -103,7 +103,16 @@ for _k, _v in (("PADDLE_TPU_SP", "1"),
                # assume cold replicas warm within ~0.5s on the CPU lane
                ("PADDLE_TPU_AS_COOLDOWN_S", "0.3"),
                ("PADDLE_TPU_AS_INTERVAL_S", "0.1"),
-               ("PADDLE_TPU_AS_WARMUP_ETA_S", "0.5")):
+               ("PADDLE_TPU_AS_WARMUP_ETA_S", "0.5"),
+               # disaggregated serving: the production prefix-cache budget
+               # (64 pages) dwarfs the tiny tier-1 pools — pin it down so
+               # LRU eviction is reachable; a short disagg-routing floor
+               # (9 tokens ~ 2 pages at the pinned 8-token pages) lets the
+               # prefill-tier e2e use small prompts, and a tight TTL keeps
+               # depot KV-frame retention tests fast
+               ("PADDLE_TPU_PREFIX_PAGES", "8"),
+               ("PADDLE_TPU_DISAGG_MIN_PROMPT", "9"),
+               ("PADDLE_TPU_DISAGG_TTL", "1.0")):
     os.environ.setdefault(_k, _v)
 
 import jax  # noqa: E402
