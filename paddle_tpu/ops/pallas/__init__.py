@@ -44,8 +44,11 @@ def tpu_compiler_params(**kwargs):
 from .flash_attention import (flash_attention, flash_attention_supported,
                               flash_attention_varlen,
                               flash_attention_varlen_supported)
-from .decode_attention import (decode_attention, decode_attention_int8,
+from .decode_attention import (decode_attention, decode_attention_fp8,
+                               decode_attention_fp8_supported,
+                               decode_attention_int8,
                                decode_attention_int8_supported,
+                               decode_attention_sharded_supported,
                                decode_attention_supported)
 from .fused_norm import fused_rms_norm
 from .rope import fused_rope
@@ -53,5 +56,7 @@ from .rope import fused_rope
 __all__ = ["flash_attention", "flash_attention_supported",
            "flash_attention_varlen", "flash_attention_varlen_supported",
            "decode_attention", "decode_attention_supported",
+           "decode_attention_fp8", "decode_attention_fp8_supported",
            "decode_attention_int8", "decode_attention_int8_supported",
+           "decode_attention_sharded_supported",
            "fused_rms_norm", "fused_rope"]

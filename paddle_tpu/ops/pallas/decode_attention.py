@@ -474,12 +474,226 @@ def decode_attention_int8(q, k_new, v_new, cache_k, cache_v, k_scale,
 
 
 # ---------------------------------------------------------------------------
+# fp8 (f8e4m3fn) static-scale cache variant (long-context ladder)
+# ---------------------------------------------------------------------------
+
+_FP8_MAX = 448.0        # f8e4m3fn finite max (e4m3fn encodes no inf)
+_FP8_MIN_ROWS = 32      # fp8 min VMEM tile is (32, 128) sublanes x lanes
+
+
+def decode_attention_fp8_supported(q_shape, cache_shape, *,
+                                   block_k: int = DEFAULT_BLOCK_K,
+                                   emit_fallback: bool = False) -> bool:
+    """Shapes the fp8 decode kernel handles.  The fp8 cache needs the
+    same lane-aligned ``block_k`` as int8 plus fp8's larger minimum VMEM
+    tile (32 sublanes): a cache block slice is ``(block_k, d)`` fp8 rows.
+    With ``emit_fallback`` every gate rejection lands a
+    ``kernel_fallback`` event so an fp8 deployment silently serving the
+    einsum path is visible."""
+    def _reject(reason: str, **detail) -> bool:
+        if emit_fallback:
+            from ...telemetry import kernel_fallback
+
+            kernel_fallback("decode_attention_fp8", reason, **detail)
+        return False
+
+    if len(q_shape) != 4 or len(cache_shape) != 4:
+        return _reject("rank", q_rank=len(q_shape))
+    b, s, h, d = q_shape
+    _, C, kv, dc = cache_shape
+    if not decode_attention_supported(q_shape, cache_shape, block_k=block_k):
+        return _reject("shape", q_shape=list(q_shape), cache_len=C,
+                       block_k=block_k)
+    if block_k % _LANES != 0 or block_k % _FP8_MIN_ROWS != 0:
+        return _reject("fp8_tile_alignment", block_k=block_k)
+    return True
+
+
+def _decode_kernel_fp8(pos_ref, pad_ref, q_ref, kn_ref, vn_ref, ck_ref,
+                       cv_ref, o_ref, cko_ref, cvo_ref, acc_ref, m_ref,
+                       l_ref, *, scale: float, kv_scale: float,
+                       block_k: int):
+    """Same online-softmax structure as :func:`_decode_kernel`, but the
+    cache blocks are f8e4m3fn under ONE static scale baked into the
+    program as a compile-time constant — no scale planes, no scale
+    loads.  Dequant fuses into the block math: the k factor folds into
+    the score scale (``q . (k*c) == (q . k) * c``) and the v factor is a
+    scalar VPU multiply on the block load.  The append clips to ±448
+    (e4m3fn saturates instead of producing inf) and writes the fp8 row
+    through the aliased buffer."""
+    ib, ik = pl.program_id(0), pl.program_id(2)
+    nk = pl.num_programs(2)
+    pos = pos_ref[0]
+    pad = pad_ref[ib]
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    def _bcast(col):
+        return jnp.broadcast_to(col, (col.shape[0], _LANES))
+
+    def _online(s_col, v_rows):
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s_col, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        m_ok = jnp.where(m_new == _NEG_INF, 0.0, m_new)
+        p = jnp.exp(s_col - m_ok)
+        alpha = jnp.exp(m_prev - m_ok)
+        l_ref[:] = _bcast(l_prev * alpha + jnp.sum(p, axis=1, keepdims=True))
+        m_ref[:] = _bcast(m_new)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v_rows.dtype), v_rows, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when((ik * block_k < pos) & ((ik + 1) * block_k > pad))
+    def _attend():
+        q = q_ref[0, 0].astype(jnp.float32)            # (g, d)
+        k = ck_ref[0, :, 0, :].astype(jnp.float32)     # (block_k, d) fp8
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * (scale * kv_scale)                     # fused k dequant
+        col = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where((col < pos) & (col >= pad), s, _NEG_INF)
+        _online(s, cv_ref[0, :, 0, :].astype(jnp.float32) * kv_scale)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        # the new token folds in EXACT (pre-quantization k/v), same
+        # contract as the int8 kernel: next step's readers see the fp8
+        # row _append writes, exactly like the einsum oracle
+        q = q_ref[0, 0].astype(jnp.float32)
+        kn = kn_ref[0, 0].astype(jnp.float32)          # (1, d)
+        s_new = jax.lax.dot_general(q, kn, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) \
+            * scale
+        _online(s_new, vn_ref[0, 0].astype(jnp.float32))
+        l = l_ref[:, :1]
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+    @pl.when(ik == pos // block_k)
+    def _append():
+        row = pos % block_k
+        kn = kn_ref[0, 0].astype(jnp.float32)          # (1, d)
+        vn = vn_ref[0, 0].astype(jnp.float32)
+        cko_ref[0, :, 0, :] = ck_ref[0, :, 0, :]
+        cvo_ref[0, :, 0, :] = cv_ref[0, :, 0, :]
+        cko_ref[0, pl.ds(row, 1), 0, :] = jnp.clip(
+            kn / kv_scale, -_FP8_MAX, _FP8_MAX).astype(cko_ref.dtype)
+        cvo_ref[0, pl.ds(row, 1), 0, :] = jnp.clip(
+            vn / kv_scale, -_FP8_MAX, _FP8_MAX).astype(cvo_ref.dtype)
+
+
+def decode_attention_fp8(q, k_new, v_new, cache_k, cache_v, pos,
+                         pad_lens=None, *, kv_scale: float = 1.0,
+                         scale: Optional[float] = None,
+                         block_k: int = DEFAULT_BLOCK_K,
+                         interpret: bool = False):
+    """Fused fp8-cache decode step: dequantize the f8e4m3fn k/v block
+    loads in place under the STATIC ``kv_scale`` (a compile-time scalar —
+    half of int8's per-page bytes because no scale planes exist),
+    clip+quantize+append the new token at ``pos``, and attend ``q`` over
+    cols ``[pad_lens, pos]``.
+
+    Returns ``(out, new_ck, new_cv)`` with the caches aliased in place."""
+    b, s, h, d = q.shape
+    _, C, kv, _ = cache_k.shape
+    assert s == 1, "decode kernel is single-query (s == 1)"
+    assert cache_k.dtype == jnp.float8_e4m3fn \
+        and cache_v.dtype == jnp.float8_e4m3fn
+    g = h // kv
+    gp = max(g, _MIN_SUBLANES)
+    sc = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    q4 = q.reshape(b, kv, g, d)
+    if gp != g:
+        q4 = jnp.concatenate(
+            [q4, jnp.zeros((b, kv, gp - g, d), q4.dtype)], axis=2)
+    kn3 = jnp.transpose(k_new, (0, 2, 1, 3))           # [b, kv, 1, d]
+    vn3 = jnp.transpose(v_new, (0, 2, 1, 3))
+    pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)
+    pad_arr = (jnp.zeros((b,), jnp.int32) if pad_lens is None
+               else jnp.asarray(pad_lens, jnp.int32).reshape(b))
+
+    nk = C // block_k
+    kernel = functools.partial(_decode_kernel_fp8, scale=sc,
+                               kv_scale=float(kv_scale), block_k=block_k)
+    grid = (b, kv, nk)
+
+    out, ck_out, cv_out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, gp, d),
+                             lambda ib, ikv, ik, pos_r, pad_r:
+                             (ib, ikv, 0, 0)),
+                pl.BlockSpec((1, 1, 1, d),
+                             lambda ib, ikv, ik, pos_r, pad_r:
+                             (ib, ikv, 0, 0)),
+                pl.BlockSpec((1, 1, 1, d),
+                             lambda ib, ikv, ik, pos_r, pad_r:
+                             (ib, ikv, 0, 0)),
+                pl.BlockSpec((1, block_k, 1, d),
+                             lambda ib, ikv, ik, pos_r, pad_r:
+                             (ib, ik, ikv, 0)),
+                pl.BlockSpec((1, block_k, 1, d),
+                             lambda ib, ikv, ik, pos_r, pad_r:
+                             (ib, ik, ikv, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, gp, d),
+                             lambda ib, ikv, ik, pos_r, pad_r:
+                             (ib, ikv, 0, 0)),
+                pl.BlockSpec((1, block_k, 1, d),
+                             lambda ib, ikv, ik, pos_r, pad_r:
+                             (ib, pos_r[0] // block_k, ikv, 0)),
+                pl.BlockSpec((1, block_k, 1, d),
+                             lambda ib, ikv, ik, pos_r, pad_r:
+                             (ib, pos_r[0] // block_k, ikv, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((gp, d), jnp.float32),
+                pltpu.VMEM((gp, _LANES), jnp.float32),
+                pltpu.VMEM((gp, _LANES), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, kv, gp, d), q.dtype),
+            jax.ShapeDtypeStruct(cache_k.shape, jnp.float8_e4m3fn),
+            jax.ShapeDtypeStruct(cache_v.shape, jnp.float8_e4m3fn),
+        ],
+        # operand indices count the scalar-prefetch args: pos=0, pad=1,
+        # q=2, k_new=3, v_new=4, cache_k=5, cache_v=6
+        input_output_aliases={5: 1, 6: 2},
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * h * C * d,
+            bytes_accessed=(2 * b * C * kv * d        # fp8 rows, 1 byte
+                            + 2 * block_k * kv * d
+                            + b * h * d * q.dtype.itemsize),
+            transcendentals=b * h * C),
+        interpret=interpret,
+    )(pos_arr, pad_arr, q4, kn3, vn3, cache_k, cache_v)
+
+    out = out[:, :, :g, :].reshape(b, 1, h, d)
+    return out, ck_out, cv_out
+
+
+# ---------------------------------------------------------------------------
 # TP-sharded dispatch gate (ISSUE 19)
 # ---------------------------------------------------------------------------
 
 def decode_attention_sharded_supported(q_shape, cache_shape, *, tp: int = 1,
                                        block_k: int = DEFAULT_BLOCK_K,
                                        int8: bool = False,
+                                       fp8: bool = False,
                                        emit_fallback: bool = False) -> bool:
     """Can the decode kernel run per-shard under a ``model``-axis mesh of
     size ``tp``?  GSPMD partitions the kv-head axis (arena sharding
@@ -507,10 +721,16 @@ def decode_attention_sharded_supported(q_shape, cache_shape, *, tp: int = 1,
         return _reject("ragged_heads", h=h, kv=kv)
     q_shard = (b, s, h // tp, d)
     cache_shard = (bc, C, kv // tp, dc)
+    if int8 and fp8:
+        return _reject("conflicting_cache_dtypes")
     if int8:
         ok = decode_attention_int8_supported(q_shard, cache_shard,
                                              block_k=block_k,
                                              emit_fallback=emit_fallback)
+    elif fp8:
+        ok = decode_attention_fp8_supported(q_shard, cache_shard,
+                                            block_k=block_k,
+                                            emit_fallback=emit_fallback)
     else:
         ok = decode_attention_supported(q_shard, cache_shard,
                                         block_k=block_k)

@@ -40,13 +40,26 @@ arenas over a ``model`` mesh axis), a dedicated prefill tier
 through the journal depot with the same fence/epoch exactly-once
 machinery), and a :class:`PrefixCache` (radix index over KV-pool pages
 with copy-on-write refcounts — shared prompt prefixes skip re-prefill,
-token-exact)."""
+token-exact).
 
-from .kv_pool import PagedKVPool, PoolExhausted, TRASH_PAGE, \
-    default_page_tokens  # noqa: F401
-from .kv_quant import (KV_DTYPES, dequantize_kv, kv_cache_dtype,  # noqa: F401
+ISSUE-20 serves LONG context: a context-parallel prefill program shards a
+long prompt's sequence dim over a ``sep`` ring mesh (``cp=N`` /
+``PADDLE_TPU_SERVE_CP`` — one ring forward replaces the chunk-by-chunk
+prefill loop, KV landing in the page arenas token-exact), cold requests
+spill their KV pages to a host-RAM :class:`OffloadPool` tier under pool
+pressure and resume decode after recall with ZERO recompute
+(``offload=True`` / ``PADDLE_TPU_KV_OFFLOAD``; LRU-dropped frames
+downgrade to the eviction-replay re-prefill — the "offload stall" row),
+and ``kv_dtype="fp8"`` stores f8e4m3fn pages under one static scale at
+exactly half the bf16 page bytes."""
+
+from .kv_pool import (OffloadPool, PagedKVPool, PoolExhausted,  # noqa: F401
+                      TRASH_PAGE, default_offload_pages,
+                      default_page_tokens)
+from .kv_quant import (FP8_MAX, KV_DTYPES, default_fp8_scale,  # noqa: F401
+                       dequantize_kv, dequantize_kv_fp8, kv_cache_dtype,
                        kv_page_bytes, kv_scale_page_bytes,
-                       observe_kv_absmax, quantize_kv)
+                       observe_kv_absmax, quantize_kv, quantize_kv_fp8)
 from .metrics import FleetMeter, RequestClock, SLOMeter  # noqa: F401
 from .admission import (AdmissionController, CircuitBreaker, Deadline,  # noqa: F401
                         Overloaded)
@@ -66,7 +79,9 @@ from .disagg import (DisaggCoordinator, PrefillWorker,  # noqa: F401
 
 __all__ = [
     "PagedKVPool", "PoolExhausted", "TRASH_PAGE", "default_page_tokens",
+    "OffloadPool", "default_offload_pages",
     "KV_DTYPES", "kv_cache_dtype", "quantize_kv", "dequantize_kv",
+    "quantize_kv_fp8", "dequantize_kv_fp8", "default_fp8_scale", "FP8_MAX",
     "observe_kv_absmax", "kv_page_bytes", "kv_scale_page_bytes",
     "RequestClock", "SLOMeter", "FleetMeter",
     "AdmissionController", "CircuitBreaker", "Deadline", "Overloaded",

@@ -79,10 +79,11 @@ from ..telemetry import tracing
 from ..telemetry.runtime import bump as _bump
 from .admission import AdmissionController, Deadline, Overloaded
 from .journal import ServingJournal
-from .kv_pool import PagedKVPool, PoolExhausted, TRASH_PAGE, \
+from .kv_pool import OffloadPool, PagedKVPool, PoolExhausted, TRASH_PAGE, \
     default_page_tokens
-from .kv_quant import (dequantize_kv, kv_cache_dtype, kv_page_bytes,
-                       kv_scale_page_bytes, quantize_kv)
+from .kv_quant import (default_fp8_scale, dequantize_kv, dequantize_kv_fp8,
+                       kv_cache_dtype, kv_page_bytes, kv_scale_page_bytes,
+                       quantize_kv, quantize_kv_fp8)
 from .metrics import SLOMeter
 from .prefix_cache import PrefixCache
 
@@ -139,6 +140,7 @@ class Request:
         self.kv_import = None                 # (first_token, frames) from a
         # prefill-tier worker, or None: set at submit, consumed instead of
         # the local prefill (disagg.py)
+        self.offloads = 0                     # host-RAM swap-outs suffered
 
     @property
     def pos(self) -> int:
@@ -214,7 +216,8 @@ class ServingEngine:
                  admission: Optional[AdmissionController] = None,
                  journal=None, journal_ship=None, on_token=None, now=None,
                  kv_dtype: Optional[str] = None, speculative=None,
-                 tp: Optional[int] = None, prefix_cache=None):
+                 tp: Optional[int] = None, prefix_cache=None,
+                 cp: Optional[int] = None, offload=None):
         import jax.numpy as jnp
 
         from ..generation.speculative import AdaptiveK, SpecConfig
@@ -284,11 +287,50 @@ class ServingEngine:
                     f"— a ragged shard would change the q-group geometry")
             self._mesh = decode_mesh(self.tp)
             shard_llama_params(model, self._mesh)
-        # KV page dtype (ISSUE 13): "bf16" = the native compute dtype,
-        # bit-exact; "int8" stores quantized pages + f32 per-(slot, head)
-        # scale arenas, dequantized at the gather inside the same program
+        # context-parallel prefill (long-context ladder): cp > 1 builds a
+        # 1-D "sep" mesh and compiles ONE extra prefill program per padded
+        # prompt signature that shards the prompt's seq dim over the ring
+        # (ops/pallas/ring_flash.py / the jnp ppermute ring).  Params,
+        # buffers, arenas and step inputs commit REPLICATED on the mesh so
+        # the two standard programs keep their shapes (and their donation);
+        # only the CP program's interior is seq-sharded.
+        self.cp = int(cp if cp is not None
+                      else _env_int("PADDLE_TPU_SERVE_CP", 1))
+        if self.cp > 1:
+            import jax as _jax
+            from jax.sharding import Mesh as _Mesh
+
+            if self.tp > 1:
+                raise ValueError(
+                    f"PADDLE_TPU_SERVE_CP={self.cp} cannot combine with "
+                    f"PADDLE_TPU_SERVE_TP={self.tp}: the serving mesh is "
+                    f"one axis (shard prompts OR heads, not both yet)")
+            devs = _jax.devices()
+            if len(devs) < self.cp:
+                raise ValueError(
+                    f"PADDLE_TPU_SERVE_CP={self.cp} needs {self.cp} "
+                    f"devices, have {len(devs)}")
+            self._mesh = _Mesh(np.array(devs[:self.cp]), ("sep",))
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(self._mesh, PartitionSpec())
+            for p in self._params:
+                p._value = _jax.device_put(p._value, rep)
+            for bb in self._buffers:
+                bb._value = _jax.device_put(bb._value, rep)
+        self._cp_execs: Dict[int, object] = {}
+        self.cp_lint_reports: Dict[int, object] = {}
+        # KV page dtype (ISSUE 13 + long-context ladder): "bf16" = the
+        # native compute dtype, bit-exact; "int8" stores quantized pages +
+        # f32 per-(slot, head) scale arenas, dequantized at the gather
+        # inside the same program; "fp8" stores f8e4m3fn pages under ONE
+        # static scale baked into the programs (no scale arenas — exactly
+        # half the bf16 page bytes)
         self.kv_dtype = kv_cache_dtype(kv_dtype)
-        adt = jnp.int8 if self.kv_dtype == "int8" else cdt
+        self._fp8_scale = default_fp8_scale() \
+            if self.kv_dtype == "fp8" else None
+        adt = (jnp.int8 if self.kv_dtype == "int8"
+               else jnp.float8_e4m3fn if self.kv_dtype == "fp8" else cdt)
         arenas = {
             "k": [jnp.zeros(self._arena_shape, adt)
                   for _ in range(n_layers)],
@@ -303,7 +345,7 @@ class ServingEngine:
             arenas["vs"] = [jnp.zeros(sshape, jnp.float32)
                             for _ in range(n_layers)]
             self._scale_bytes = 2 * n_layers * int(np.prod(sshape)) * 4
-        if self._mesh is not None:
+        if self._mesh is not None and self.tp > 1:
             from .disagg import shard_arenas
             from ..ops.pallas.decode_attention import \
                 decode_attention_sharded_supported
@@ -317,7 +359,17 @@ class ServingEngine:
                  head_dim),
                 (self.max_batch, MP * P, kv_heads, head_dim),
                 tp=self.tp, int8=self.kv_dtype == "int8",
+                fp8=self.kv_dtype == "fp8",
                 emit_fallback=True)
+        elif self._mesh is not None:
+            # cp mesh: arenas replicate — each device aliases its full
+            # copy, so the donation lint floors are unchanged (shards=1)
+            import jax as _jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(self._mesh, PartitionSpec())
+            arenas = {key: [_jax.device_put(a, rep) for a in arrs]
+                      for key, arrs in arenas.items()}
         self._arenas = arenas
         self._arena_bytes = 2 * n_layers * int(np.prod(self._arena_shape)) \
             * arenas["k"][0].dtype.itemsize
@@ -342,6 +394,27 @@ class ServingEngine:
             prefix_cache = PrefixCache(self.pool, max_pages=prefix_cache)
         self.prefix: Optional[PrefixCache] = \
             prefix_cache if isinstance(prefix_cache, PrefixCache) else None
+
+        # host-RAM KV offload (long-context ladder): preemption swaps a
+        # victim's private pages to the OffloadPool instead of discarding
+        # them — its generated tokens SURVIVE and decode resumes
+        # token-exact after the recall scatter.  Shared (prefix-trie)
+        # pages never copy: the park keeps the victim's reference so the
+        # one resident copy stays in HBM.  True/env "1" = tier under the
+        # PADDLE_TPU_KV_OFFLOAD_PAGES budget; an OffloadPool = caller-owned
+        if offload is None:
+            offload = os.environ.get("PADDLE_TPU_KV_OFFLOAD", "0") == "1"
+        if offload is True:
+            offload = OffloadPool()
+        elif isinstance(offload, int) and not isinstance(offload, bool) \
+                and offload > 0:
+            offload = OffloadPool(max_pages=offload)
+        self.offload: Optional[OffloadPool] = \
+            offload if isinstance(offload, OffloadPool) else None
+        self._offload_lost: set = set()   # parked rids whose host frames
+        # were LRU-dropped: recall is impossible, re-admission downgrades
+        # them to the eviction-replay re-prefill path (the README failure
+        # matrix's "offload stall" row)
 
         # speculative decoding (ISSUE 13): the decode program widens to a
         # fixed [R, k_max+1] verify signature; a per-row dynamic valid
@@ -715,6 +788,13 @@ class ServingEngine:
         rows = self._free_rows()
         while self._queue and rows:
             r = self._queue[0]
+            if self.pool.is_parked(r.rid):
+                # swapped-out request at the head: restore its KV from the
+                # host tier (or downgrade to an eviction-style re-prefill
+                # if the frames were LRU-dropped) instead of re-allocating
+                if self._recall(r, rows) == "wait":
+                    break
+                continue
             need, cached = self._admit_need(r)
             if not self.pool.can_alloc(need):
                 # pool pressure: a long prompt at the head must not wedge
@@ -764,6 +844,8 @@ class ServingEngine:
         window = min(len(self._queue), self._defer_lookahead + 1)
         for i in range(1, window):
             c = self._queue[i]
+            if self.pool.is_parked(c.rid):
+                continue   # parked requests re-enter only through _recall
             need, cached = self._admit_need(c)
             if need < head_need and self.pool.can_alloc(need):
                 head.defers += 1
@@ -793,6 +875,109 @@ class ServingEngine:
         self._queue.appendleft(victim)
         self.meter.evict(victim.rid, reason="pool_pressure",
                          pages_freed=freed)
+
+    def _preempt(self, victim: Request) -> None:
+        """Route a pool-pressure preemption: with a host-RAM offload tier
+        the victim's KV pages spill and the request resumes WITHOUT
+        recompute; without one it falls back to the eviction replay."""
+        if self.offload is not None:
+            self._offload(victim)
+        else:
+            self._evict(victim)
+
+    def _offload(self, victim: Request) -> None:
+        """Swap ``victim`` out to the host tier: its PRIVATE pages'
+        contents are exported to :class:`OffloadPool` frames and the HBM
+        pages freed; SHARED pages (prefix-cache COW) keep the victim's
+        pool reference and never copy — one resident HBM copy serves
+        every holder, so a shared page "offloads" for free.  The request
+        keeps its generated tokens and drafter (the whole point: recall
+        resumes decode with zero recompute) and requeues at the front.
+        If the put LRU-drops frames of ANY parked request (including this
+        one), that owner is marked lost and downgrades to an
+        eviction-style re-prefill at recall time."""
+        pages = self.pool.table(victim.rid)
+        spill = [(j, p) for j, p in enumerate(pages)
+                 if self.pool.refcount(p) <= 1]
+        frames = [(j, self._export_page(p)) for j, p in spill]
+        self.pool.swap_out(victim.rid)
+        del self._active[victim.row]
+        victim.row = None
+        victim.state = QUEUED
+        victim.offloads += 1
+        self._queue.appendleft(victim)
+        nbytes = 0
+        lost = set()
+        for j, fr in frames:
+            nbytes += sum(int(v.nbytes) for v in fr.values())
+            for rid_lost, _slot in self.offload.put(victim.rid, j, fr):
+                lost.add(rid_lost)
+        for rid_lost in lost:
+            # partial frame sets are useless: drop the survivors too and
+            # let _recall downgrade the owner to a re-prefill
+            self._offload_lost.add(rid_lost)
+            self.offload.drop(rid_lost)
+        self.meter.offload(victim.rid, pages=len(frames),
+                           shared_pages=len(pages) - len(frames),
+                           bytes_out=nbytes)
+
+    def _recall(self, r: Request, rows: List[int]) -> str:
+        """Re-admit a parked request from the head of the queue.  Returns
+        ``"recalled"`` (row active again, KV restored), ``"downgraded"``
+        (host frames were dropped — request reset to a fresh re-prefill,
+        still queued), or ``"wait"`` (frames intact but HBM pages are
+        short; the admit loop breaks and retries next step)."""
+        import jax.numpy as jnp
+
+        if r.rid in self._offload_lost or self.offload is None:
+            self._downgrade(r)
+            return "downgraded"
+        plan = self.pool.parked_plan(r.rid)
+        missing = [j for j, p in enumerate(plan) if p is None]
+        if not all(self.offload.holds(r.rid, j) for j in missing):
+            self._downgrade(r)
+            return "downgraded"
+        if not self.pool.can_alloc(len(missing)):
+            # nearing the head of the queue: refresh this request's frames
+            # so the LRU trims colder parked requests first
+            # (distance-to-next-use approximated by queue position)
+            self.offload.touch(r.rid)
+            return "wait"
+        table, refill = self.pool.swap_in(r.rid)
+        nbytes = 0
+        for j, pid in refill:
+            frame = self.offload.get(r.rid, j)
+            nbytes += sum(int(v.nbytes) for v in frame.values())
+            idx = jnp.asarray(np.asarray([pid], np.int32))
+            for key, arrs in self._arenas.items():
+                vals = np.asarray(frame[key])[:, None]  # [layers, 1, ...]
+                for li in range(len(arrs)):
+                    arrs[li] = self._page_write(arrs[li], idx, vals[li])
+        self._queue.popleft()
+        r.row = rows.pop(0)
+        r.state = RUNNING
+        self._active[r.row] = r
+        self.meter.recall(r.rid, pages=len(refill), bytes_in=nbytes,
+                          n_tokens=len(r.generated))
+        self.meter.set_occupancy(self.pool.occupancy())
+        return "recalled"
+
+    def _downgrade(self, r: Request) -> None:
+        """Offload-stall fallback: the parked request's host frames are
+        gone (LRU-dropped, or the tier vanished), so release its retained
+        pool refs and reset it to eviction-replay semantics — re-prefill
+        from the journaled prompt, with the ``delivered`` high-water mark
+        suppressing re-emission.  The request keeps its queue position
+        and re-enters through the normal admit path."""
+        self.pool.drop_parked(r.rid)
+        if self.offload is not None:
+            self.offload.drop(r.rid)
+        self._offload_lost.discard(r.rid)
+        r.generated = []
+        r.cached_tokens = 0
+        r.drafter = None
+        r.evictions += 1
+        self.meter.offload_stall(r.rid)
 
     def _victim_key(self, x: Request):
         """Eviction preference under pool pressure, largest key loses.
@@ -834,7 +1019,7 @@ class ServingEngine:
                     f"exhausted — raise PADDLE_TPU_SERVE_PAGES or lower "
                     f"the per-request budget")
             victim = max(live, key=self._victim_key)
-            self._evict(victim)
+            self._preempt(victim)
             if victim is r:
                 return False
         return True
@@ -886,6 +1071,10 @@ class ServingEngine:
         import jax.numpy as jnp
 
         if r.kv_import is not None:
+            if self.cp > 1:
+                from ..telemetry import kernel_fallback
+                kernel_fallback("serving_cp_prefill", "kv_import",
+                                rid=str(r.rid))
             self._import_kv(r)
             return
         _faults.fire("serve_prefill", f"rid{r.rid}")
@@ -896,8 +1085,11 @@ class ServingEngine:
         # cap guarantees c0 < n_chunks — the last prompt token's logits
         # are always computed fresh
         c0 = min(r.cached_tokens // self.page_tokens, n_chunks - 1)
-        table = jnp.asarray(self._padded_table(r.rid)[None])
-        logits = self._prefill_chunks(prompt, table, c0)
+        if self._cp_accepts(len(prompt), cached_tokens=r.cached_tokens):
+            logits = self._cp_prefill_run(prompt, self.pool.table(r.rid))
+        else:
+            table = jnp.asarray(self._padded_table(r.rid)[None])
+            logits = self._prefill_chunks(prompt, table, c0)
         tok = int(np.argmax(np.asarray(logits)))
         r.generated.append(tok)
         self.meter.first_token(r.rid)
@@ -986,7 +1178,10 @@ class ServingEngine:
             t = np.full((self.max_pages_per_seq,), TRASH_PAGE, np.int32)
             pages = self.pool.table(key)
             t[:len(pages)] = pages
-            logits = self._prefill_chunks(prompt, jnp.asarray(t[None]))
+            if self._cp_accepts(len(prompt)):
+                logits = self._cp_prefill_run(prompt, pages)
+            else:
+                logits = self._prefill_chunks(prompt, jnp.asarray(t[None]))
             first = int(np.argmax(np.asarray(logits)))
             frames = [self._export_page(p) for p in pages]
             return first, frames
@@ -1262,6 +1457,7 @@ class ServingEngine:
         MP = tables.shape[1]
         kp, vp = arenas["k"][li], arenas["v"][li]
         quant = self.kv_dtype == "int8"
+        fp8 = self.kv_dtype == "fp8"
         pos_js = positions[:, None] + jnp.arange(s)[None, :]      # [R, s]
         valid = jnp.arange(s)[None, :] < n_tok[:, None]           # [R, s]
         page = jnp.take_along_axis(tables,
@@ -1275,6 +1471,12 @@ class ServingEngine:
             vp = vp.at[page, slot].set(vq)
             ksp = arenas["ks"][li].at[page, slot].set(ksc)
             vsp = arenas["vs"][li].at[page, slot].set(vsc)
+        elif fp8:
+            # static scale: quantize on the scatter, no scale planes
+            kp = kp.at[page, slot].set(
+                quantize_kv_fp8(k_new, self._fp8_scale))
+            vp = vp.at[page, slot].set(
+                quantize_kv_fp8(v_new, self._fp8_scale))
         else:
             kp = kp.at[page, slot].set(k_new.astype(kp.dtype))
             vp = vp.at[page, slot].set(v_new.astype(vp.dtype))
@@ -1286,6 +1488,11 @@ class ServingEngine:
             vv = dequantize_kv(vp[tables].reshape(R, C, kv, d),
                                vsp[tables].reshape(R, C, kv)).astype(
                                    self._cdt)
+        elif fp8:
+            kk = dequantize_kv_fp8(kp[tables].reshape(R, C, kv, d),
+                                   self._fp8_scale).astype(self._cdt)
+            vv = dequantize_kv_fp8(vp[tables].reshape(R, C, kv, d),
+                                   self._fp8_scale).astype(self._cdt)
         else:
             kk = kp[tables].reshape(R, C, kv, d)
             vv = vp[tables].reshape(R, C, kv, d)
@@ -1429,3 +1636,179 @@ class ServingEngine:
                 self._prefill_exec = jitted.lower(*args).compile()
         logits, self._arenas = self._prefill_exec(*args)
         return logits
+
+    # -- context-parallel prefill (ISSUE 20 leg 1) -------------------------
+    def _cp_accepts(self, n_prompt: int, *, cached_tokens: int = 0) -> bool:
+        """Gate for the context-parallel prefill program.  Every rejection
+        emits a ``kernel_fallback("serving_cp_prefill", reason)`` event so
+        telemetry shows WHY a long-prompt engine fell back to the chunked
+        path: ``prefix_cached`` (the CP program refills every page — a
+        cached prefix would be recomputed, losing the cache win) and
+        ``short_prompt`` (fewer page-chunks than ring devices: some shards
+        would be all-padding and the ring overhead can't amortize)."""
+        if self.cp <= 1:
+            return False
+        from ..telemetry import kernel_fallback
+
+        n_chunks = -(-n_prompt // self.page_tokens)
+        if cached_tokens > 0:
+            kernel_fallback("serving_cp_prefill", "prefix_cached",
+                            cached_tokens=cached_tokens)
+            return False
+        if n_chunks < self.cp:
+            kernel_fallback("serving_cp_prefill", "short_prompt",
+                            n_chunks=n_chunks, cp=self.cp)
+            return False
+        return True
+
+    def _cp_prefill_fn(self, param_arrays, buffer_arrays, arenas, tokens,
+                       tables, take_idx):
+        """Context-parallel prefill program: ONE forward over the whole
+        zero-padded prompt ``tokens`` [1, nc_pad * page_tokens] with the
+        sequence dim ring-sharded over the ``sep`` mesh axis
+        (:func:`ring_attention` — the same ring the training side uses).
+        KV lands in the page arenas exactly where the chunked program
+        would put it (``tables`` [1, nc_pad] routes pad chunks to the
+        trash page), and the one needed hidden row is sliced at
+        ``take_idx`` BEFORE the lm_head so no full-sequence logits
+        [s, V] ever materializes."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from ..autograd import no_grad
+        from ..distributed.meta_parallel.context_parallel import \
+            ring_attention
+        from ..jit import _StateSwap
+        from ..models.llama import rotate_half_apply
+        from ..nn import functional as F
+        from ..tensor.manipulation import reshape
+        from ..tensor.tensor import Tensor
+
+        model = self.model
+        with _StateSwap(self._params, param_arrays), \
+                _StateSwap(self._buffers, buffer_arrays), no_grad():
+            base = model.llama
+            R, s = tokens.shape                       # R == 1
+            cfg = model.config
+            h, kvh, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                         cfg.head_dim)
+            cos = base.rope_cos._value
+            sin = base.rope_sin._value
+            pos_ids = jnp.clip(jnp.arange(s)[None, :], 0,
+                               cos.shape[0] - 1)                  # [1, s]
+            cos_s = jnp.take(cos, pos_ids, axis=0)[:, :, None, :]
+            sin_s = jnp.take(sin, pos_ids, axis=0)[:, :, None, :]
+            x = base.embed_tokens(Tensor(tokens))
+            # seed GSPMD: the hidden stream is seq-sharded over the ring;
+            # the projections stay local per-shard and only the ring
+            # rotates K/V between devices
+            seq_sh = NamedSharding(self._mesh,
+                                   PartitionSpec(None, "sep", None))
+            x = Tensor(jax.lax.with_sharding_constraint(x._value, seq_sh))
+            P = self.page_tokens
+            pos = jnp.arange(s)
+            page_idx = jnp.take(tables[0], pos // P)              # [s]
+            slot = pos % P
+            new_arenas = {key: [] for key in arenas}
+            for li, layer in enumerate(base.layers):
+                xin = layer.input_layernorm(x)
+                q = reshape(layer.self_attn.q_proj(xin), [R, s, h, d])
+                k = reshape(layer.self_attn.k_proj(xin), [R, s, kvh, d])
+                v = reshape(layer.self_attn.v_proj(xin), [R, s, kvh, d])
+                qv, kv_ = rotate_half_apply(q._value, k._value, cos_s,
+                                            sin_s)
+                vv = v._value
+                kp, vp = arenas["k"][li], arenas["v"][li]
+                # quantize-then-dequantize BEFORE the ring for quantized
+                # pools: the chunked oracle reads even its own chunk's KV
+                # back from the arena, so CP must attend over the same
+                # rounded values to stay token-exact
+                if self.kv_dtype == "int8":
+                    kq, ksc = quantize_kv(kv_)
+                    vq, vsc = quantize_kv(vv)
+                    kp = kp.at[page_idx, slot].set(kq[0])
+                    vp = vp.at[page_idx, slot].set(vq[0])
+                    new_arenas["ks"].append(
+                        arenas["ks"][li].at[page_idx, slot].set(ksc[0]))
+                    new_arenas["vs"].append(
+                        arenas["vs"][li].at[page_idx, slot].set(vsc[0]))
+                    k_att = dequantize_kv(kq, ksc).astype(self._cdt)
+                    v_att = dequantize_kv(vq, vsc).astype(self._cdt)
+                elif self.kv_dtype == "fp8":
+                    kq = quantize_kv_fp8(kv_, self._fp8_scale)
+                    vq = quantize_kv_fp8(vv, self._fp8_scale)
+                    kp = kp.at[page_idx, slot].set(kq[0])
+                    vp = vp.at[page_idx, slot].set(vq[0])
+                    k_att = dequantize_kv_fp8(
+                        kq, self._fp8_scale).astype(self._cdt)
+                    v_att = dequantize_kv_fp8(
+                        vq, self._fp8_scale).astype(self._cdt)
+                else:
+                    kp = kp.at[page_idx, slot].set(kv_[0].astype(kp.dtype))
+                    vp = vp.at[page_idx, slot].set(vv[0].astype(vp.dtype))
+                    k_att = kv_.astype(kp.dtype)
+                    v_att = vv.astype(vp.dtype)
+                new_arenas["k"].append(kp)
+                new_arenas["v"].append(vp)
+                out = ring_attention(qv, k_att, v_att, mesh=self._mesh,
+                                     sep_axis="sep", causal=True)
+                x = x + layer.self_attn.o_proj(
+                    Tensor(out._value.astype(qv.dtype).reshape(R, s,
+                                                               h * d)))
+                x = x + layer.mlp(layer.post_attention_layernorm(x))
+            hidden = base.norm(x)
+            # ONE row of hidden state, then the vocab projection — the
+            # full-seq [s, V] logits never exist
+            hrow = Tensor(jnp.take(hidden._value[0], take_idx[None],
+                                   axis=0)[None])                # [1,1,D]
+            if model.lm_head is not None:
+                logits = model.lm_head(hrow)
+            else:
+                logits = F.linear(hrow, base.embed_tokens.weight.T)
+            return logits._value[0, 0], new_arenas
+
+    def _run_cp_prefill(self, tokens, tables, take_idx):
+        """Compile-and-run for the CP program, one executable per padded
+        prompt length (``nc_pad`` chunks — prompts that pad to the same
+        multiple of ``cp`` share an executable; ``take_idx`` is traced,
+        so the exact prompt length never recompiles)."""
+        import jax
+
+        pa, ba = self._param_arrays()
+        args = (pa, ba, self._arenas, self._repl(tokens),
+                self._repl(tables), self._repl(take_idx))
+        sig = int(tokens.shape[1])
+        exec_ = self._cp_execs.get(sig)
+        if exec_ is None:
+            jitted = jax.jit(self._cp_prefill_fn, donate_argnums=(2,))
+            with _SWAP_LOCK:
+                exec_ = jitted.lower(*args).compile()
+            self._cp_execs[sig] = exec_
+            if self._lint:
+                # arenas are replicated over the ring (shards=1: every
+                # device aliases the full arena bytes)
+                self.cp_lint_reports[sig] = check_decode_donation(
+                    exec_, self._arena_bytes,
+                    name=f"serving_cp_prefill_{sig}",
+                    scale_bytes=self._scale_bytes)
+        logits, self._arenas = exec_(*args)
+        return logits
+
+    def _cp_prefill_run(self, prompt, pages):
+        """Build the padded CP inputs for ``prompt`` over its allocated
+        ``pages`` and run the CP program; returns last-token logits [V].
+        The chunk count pads up to a multiple of ``cp`` so the ring
+        divides evenly — pad chunks carry zero tokens and scatter to the
+        trash page."""
+        import jax.numpy as jnp
+
+        P = self.page_tokens
+        n_chunks = -(-len(prompt) // P)
+        nc_pad = -(-n_chunks // self.cp) * self.cp
+        tokens = np.zeros((1, nc_pad * P), np.int32)
+        tokens[0, :len(prompt)] = np.asarray(prompt, np.int32)
+        tbl = np.full((1, nc_pad), TRASH_PAGE, np.int32)
+        tbl[0, :n_chunks] = np.asarray(pages[:n_chunks], np.int32)
+        return self._run_cp_prefill(jnp.asarray(tokens), jnp.asarray(tbl),
+                                    jnp.int32(len(prompt) - 1))

@@ -31,10 +31,11 @@ page).
 from __future__ import annotations
 
 import os
-from typing import Dict, List
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
 
-__all__ = ["PagedKVPool", "PoolExhausted", "default_page_tokens",
-           "TRASH_PAGE"]
+__all__ = ["PagedKVPool", "OffloadPool", "PoolExhausted",
+           "default_page_tokens", "default_offload_pages", "TRASH_PAGE"]
 
 # int8 paging (ISSUE 13) keeps the accounting here and the arrays in the
 # engine, same split as the bf16 pool: kv_quant.py prices a page through
@@ -46,6 +47,12 @@ TRASH_PAGE = 0
 
 def default_page_tokens() -> int:
     return int(os.environ.get("PADDLE_TPU_PAGE_TOKENS", "16"))
+
+
+def default_offload_pages() -> int:
+    """Host-RAM offload tier capacity in pages
+    (``PADDLE_TPU_KV_OFFLOAD_PAGES``, default 64)."""
+    return int(os.environ.get("PADDLE_TPU_KV_OFFLOAD_PAGES", "64"))
 
 
 class PoolExhausted(RuntimeError):
@@ -71,6 +78,12 @@ class PagedKVPool:
         # A page is EITHER on the free list OR in here, never both; the
         # trash page is in neither (it is not allocatable state).
         self._refs: Dict[int, int] = {}
+        # offload parking (long-context ladder): rid -> per-slot plan.
+        # Entry j is a page id when slot j's page is SHARED (the rid's
+        # reference is retained so no other holder's decref can free it
+        # while the request sits in host RAM) or None when the slot was
+        # private and its bytes were spilled to the OffloadPool.
+        self._parked: Dict[object, List[Optional[int]]] = {}
         self._peak_used = 0
         # byte accountant (engine fills in via set_page_bytes): HBM cost
         # of one page's k+v arena slices and of its scale slices (int8
@@ -228,6 +241,81 @@ class PagedKVPool:
         pages = self._tables.pop(rid)
         return self.decref(reversed(pages))
 
+    # -- host-RAM offload parking (long-context ladder) --------------------
+    def swap_out(self, rid) -> List[Optional[int]]:
+        """Park ``rid``'s table for host-RAM offload and return the
+        per-slot plan.  Private pages (this table holds the sole
+        reference) are released to the free list — the CALLER must have
+        copied their bytes to the :class:`OffloadPool` first — and park
+        as ``None``.  Shared pages are never copied: the rid's reference
+        is RETAINED (so trie eviction or another holder's free cannot
+        drop the page while this request is parked) and park as their
+        page id — "a shared page offloads once" because its one resident
+        copy stays in HBM for every holder."""
+        if rid not in self._tables:
+            raise KeyError(f"swap_out of unknown request {rid!r}")
+        if rid in self._parked:
+            raise KeyError(f"swap_out of already-parked request {rid!r}")
+        pages = self._tables.pop(rid)
+        plan: List[Optional[int]] = []
+        for p in pages:
+            if self._refs.get(p, 0) > 1:
+                plan.append(p)          # shared: keep our ref, no copy
+            else:
+                self.decref([p])        # private: bytes now live on host
+                plan.append(None)
+        self._parked[rid] = plan
+        return list(plan)
+
+    def swap_in(self, rid) -> Tuple[List[int], List[Tuple[int, int]]]:
+        """Un-park ``rid``: rebuild its block table and return
+        ``(table, refill)`` where ``refill`` lists ``(slot_index,
+        new_page)`` pairs the caller must restore from the
+        :class:`OffloadPool` frames.  Shared slots resume on their parked
+        page (reference was never dropped).  All-or-nothing: raises
+        :class:`PoolExhausted` (leaving the request parked) when the
+        private slots cannot all be re-allocated."""
+        if rid not in self._parked:
+            raise KeyError(f"swap_in of unparked request {rid!r}")
+        plan = self._parked[rid]
+        need = sum(1 for p in plan if p is None)
+        if len(self._free) < need:
+            raise PoolExhausted(
+                f"swap_in needs {need} pages, {len(self._free)} free "
+                f"({self.pages_used}/{self.capacity} in use)")
+        del self._parked[rid]
+        table: List[int] = []
+        refill: List[Tuple[int, int]] = []
+        for j, p in enumerate(plan):
+            if p is None:
+                fresh = self._free.pop()
+                self._refs[fresh] = 1
+                refill.append((j, fresh))
+                table.append(fresh)
+            else:
+                table.append(int(p))
+        self._tables[rid] = table
+        self._peak_used = max(self._peak_used, self.pages_used)
+        return list(table), refill
+
+    def drop_parked(self, rid) -> int:
+        """Abandon a parked request (its host frames were LRU-dropped, so
+        recall is impossible — the engine falls back to eviction-replay
+        re-prefill).  Releases the retained shared-page references;
+        returns how many pages actually freed."""
+        if rid not in self._parked:
+            raise KeyError(f"drop_parked of unparked request {rid!r}")
+        plan = self._parked.pop(rid)
+        return self.decref([p for p in reversed(plan) if p is not None])
+
+    def is_parked(self, rid) -> bool:
+        return rid in self._parked
+
+    def parked_plan(self, rid) -> List[Optional[int]]:
+        """The per-slot park plan (page id for resident shared slots,
+        ``None`` for host-spilled private slots)."""
+        return list(self._parked[rid])
+
     def check_leaks(self, allow_shared: bool = False) -> None:
         """Assert the quiesced-pool invariant: no table left behind, and
         the free list plus the ref'd pages partition ``{1..num_pages-1}``
@@ -238,6 +326,9 @@ class PagedKVPool:
         if self._tables:
             raise AssertionError(
                 f"leaked block tables: { {k: len(v) for k, v in self._tables.items()} }")
+        if self._parked:
+            raise AssertionError(
+                f"leaked parked requests: { {k: len(v) for k, v in self._parked.items()} }")
         if not allow_shared and self._refs:
             raise AssertionError(
                 f"leaked page references: { {p: c for p, c in sorted(self._refs.items())} }")
@@ -252,3 +343,109 @@ class PagedKVPool:
             raise AssertionError(
                 f"page accounting corrupt: {len(self._free)} free + "
                 f"{len(self._refs)} referenced != capacity {self.capacity}")
+
+
+class OffloadPool:
+    """Host-RAM tier for spilled KV page frames (long-context ladder).
+
+    Holds the exported per-page arena frames (numpy, host RAM) of
+    requests the engine parked via :meth:`PagedKVPool.swap_out`, under a
+    page budget (``PADDLE_TPU_KV_OFFLOAD_PAGES``).  Inserts follow the
+    PR-8 snapshot double-buffer discipline: the device-get fills a
+    staging slot first and the frame is PUBLISHED into the store as one
+    atomic dict insert, so a crash mid-spill never leaves a torn frame a
+    later recall could read.  Eviction is LRU over frames with a
+    distance-to-next-use override: the engine re-stamps a parked
+    request's frames when it moves toward the head of the admission
+    queue, so frames about to be recalled are the last to drop.  A drop
+    is LOSS, not corruption — :meth:`put` returns the dropped owners and
+    the engine downgrades those requests to eviction-replay re-prefill
+    (the README failure-matrix "offload stall" row).
+    """
+
+    def __init__(self, max_pages: Optional[int] = None):
+        self.max_pages = int(max_pages if max_pages is not None
+                             else default_offload_pages())
+        # (rid, slot) -> frame dict {arena key -> np.ndarray [layers, ...]}
+        self._frames: "OrderedDict[Tuple[object, int], dict]" = OrderedDict()
+        self._staging: Optional[Tuple[Tuple[object, int], dict]] = None
+        self.pages_out = 0        # frames spilled to host
+        self.pages_in = 0         # frames recalled to device
+        self.pages_dropped = 0    # frames LRU-dropped (recall impossible)
+        self.bytes_out = 0
+        self.bytes_in = 0
+
+    # -- capacity ----------------------------------------------------------
+    def frames_held(self) -> int:
+        return len(self._frames)
+
+    def holds(self, rid, slot: int) -> bool:
+        return (rid, slot) in self._frames
+
+    @staticmethod
+    def _frame_bytes(frame: dict) -> int:
+        return sum(int(v.nbytes) for v in frame.values())
+
+    # -- spill -------------------------------------------------------------
+    def stage(self, rid, slot: int, frame: dict) -> None:
+        """Phase one of a spill: park the host copy in the staging slot.
+        Nothing is recallable yet — :meth:`publish` flips it in."""
+        self._staging = ((rid, int(slot)), frame)
+
+    def publish(self) -> List[Tuple[object, int]]:
+        """Phase two: atomically insert the staged frame, then trim to
+        budget.  Returns the (rid, slot) owners of any LRU-dropped
+        frames so the engine can downgrade those requests."""
+        if self._staging is None:
+            raise RuntimeError("publish with no staged frame")
+        key, frame = self._staging
+        self._staging = None
+        self._frames[key] = frame
+        self._frames.move_to_end(key)
+        self.pages_out += 1
+        self.bytes_out += self._frame_bytes(frame)
+        dropped: List[Tuple[object, int]] = []
+        while len(self._frames) > self.max_pages:
+            k, f = self._frames.popitem(last=False)
+            self.pages_dropped += 1
+            dropped.append(k)
+        return dropped
+
+    def put(self, rid, slot: int, frame: dict) -> List[Tuple[object, int]]:
+        """Stage + publish in one call (the common path)."""
+        self.stage(rid, slot, frame)
+        return self.publish()
+
+    # -- recall ------------------------------------------------------------
+    def get(self, rid, slot: int) -> Optional[dict]:
+        """Pop and return the frame for ``(rid, slot)``, or ``None`` if
+        it was LRU-dropped (the caller must fall back to re-prefill)."""
+        frame = self._frames.pop((rid, int(slot)), None)
+        if frame is not None:
+            self.pages_in += 1
+            self.bytes_in += self._frame_bytes(frame)
+        return frame
+
+    def touch(self, rid) -> int:
+        """Re-stamp every frame of ``rid`` as most-recently-useful (the
+        distance-to-next-use signal: ``rid`` is nearing re-admission).
+        Returns how many frames were stamped."""
+        keys = [k for k in self._frames if k[0] == rid]
+        for k in keys:
+            self._frames.move_to_end(k)
+        return len(keys)
+
+    def drop(self, rid) -> int:
+        """Discard every frame of ``rid`` (request finished or was
+        downgraded); returns how many frames were dropped."""
+        keys = [k for k in self._frames if k[0] == rid]
+        for k in keys:
+            del self._frames[k]
+        return len(keys)
+
+    def summary(self) -> dict:
+        return {"frames_held": len(self._frames),
+                "max_pages": self.max_pages,
+                "pages_out": self.pages_out, "pages_in": self.pages_in,
+                "pages_dropped": self.pages_dropped,
+                "bytes_out": self.bytes_out, "bytes_in": self.bytes_in}

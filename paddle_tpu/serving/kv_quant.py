@@ -21,13 +21,18 @@ how many concurrent users a fixed pool holds.  Scheme:
   the per-tensor scale it yields is what a static-scale format (the fp8
   seam below) needs, and tests use it to sanity-bound the per-token scales
   against the observed distribution.
-- **fp8 seam** — ``PADDLE_TPU_KV_DTYPE=fp8`` is STUBBED: ``DTYPE_BYTES``
-  already prices ``f8e4m3fn`` so the accounting is ready, but no fp8
-  scatter/gather path is wired; resolving it raises loudly instead of
-  silently serving bf16.
+- **fp8 pages** — ``PADDLE_TPU_KV_DTYPE=fp8`` stores ``f8e4m3fn`` pages
+  under a STATIC per-tensor scale (``PADDLE_TPU_KV_FP8_SCALE``, the
+  calibration :func:`observe_kv_absmax` yields; default 1.0 — e4m3's
+  ±448 dynamic range covers typical KV magnitudes raw).  No per-token
+  scale planes ride along, so an fp8 page costs EXACTLY half a bf16 page
+  — int8's total exceeds half by its f32 scale planes.  Dequant is fused
+  at the gather (``f32(q) * scale``), same no-materialized-copy contract
+  as int8.
 
-Env: ``PADDLE_TPU_KV_DTYPE=bf16|int8`` (default ``bf16`` = the engine's
-native compute dtype, bit-exact path).
+Env: ``PADDLE_TPU_KV_DTYPE=bf16|int8|fp8`` (default ``bf16`` = the
+engine's native compute dtype, bit-exact path);
+``PADDLE_TPU_KV_FP8_SCALE`` sets the fp8 static scale.
 """
 
 from __future__ import annotations
@@ -36,11 +41,14 @@ import os
 from typing import Optional
 
 __all__ = ["KV_DTYPES", "kv_cache_dtype", "quantize_kv", "dequantize_kv",
-           "observe_kv_absmax", "kv_page_bytes", "kv_scale_page_bytes"]
+           "quantize_kv_fp8", "dequantize_kv_fp8", "default_fp8_scale",
+           "observe_kv_absmax", "kv_page_bytes", "kv_scale_page_bytes",
+           "FP8_MAX"]
 
-KV_DTYPES = ("bf16", "int8")
+KV_DTYPES = ("bf16", "int8", "fp8")
 _QMAX = 127.0
 _SCALE_EPS = 1e-8       # all-zero tokens (trash page writes) quantize to 0
+FP8_MAX = 448.0         # f8e4m3fn finite max (no inf encoding in e4m3fn)
 
 
 def kv_cache_dtype(override: Optional[str] = None) -> str:
@@ -54,19 +62,17 @@ def kv_cache_dtype(override: Optional[str] = None) -> str:
         return "bf16"
     if v in ("int8", "s8"):
         return "int8"
-    if v in ("fp8", "f8", "f8e4m3fn", "f8e5m2"):
+    if v in ("fp8", "f8", "f8e4m3fn"):
+        return "fp8"
+    if v == "f8e5m2":
         raise NotImplementedError(
-            "PADDLE_TPU_KV_DTYPE=fp8: the fp8 KV seam is stubbed — it is "
-            "ROADMAP item 5 (long-context scenario ladder: the "
-            "decode-bandwidth rung carried over from old item 2). "
-            "analysis.program.DTYPE_BYTES already prices f8e4m3fn pages "
-            "and observe_kv_absmax provides the static per-tensor scale "
-            "it needs, but no fp8 scatter/gather path is wired yet. "
-            f"Supported PADDLE_TPU_KV_DTYPE values: {KV_DTYPES} "
-            "(aliases: bfloat16/native/f32/float32 -> bf16, s8 -> int8)")
+            "PADDLE_TPU_KV_DTYPE=f8e5m2: only the e4m3fn fp8 flavor is "
+            "wired (KV magnitudes want mantissa, not exponent range). "
+            f"Supported PADDLE_TPU_KV_DTYPE values: {KV_DTYPES}")
     raise ValueError(
         f"PADDLE_TPU_KV_DTYPE={v!r}: expected one of {KV_DTYPES} "
-        f"(fp8 is a stubbed seam)")
+        "(aliases: bfloat16/native/f32/float32 -> bf16, s8 -> int8, "
+        "f8/f8e4m3fn -> fp8)")
 
 
 def quantize_kv(x):
@@ -87,6 +93,35 @@ def dequantize_kv(q, scale):
     import jax.numpy as jnp
 
     return q.astype(jnp.float32) * scale[..., None].astype(jnp.float32)
+
+
+def default_fp8_scale() -> float:
+    """Static per-tensor fp8 scale (``PADDLE_TPU_KV_FP8_SCALE``, default
+    1.0).  Calibrate with :func:`observe_kv_absmax`: ``absmax / FP8_MAX``
+    maps the observed range onto e4m3fn's ±448 exactly; the 1.0 default
+    stores KV raw, which e4m3fn's range covers for typical magnitudes."""
+    s = float(os.environ.get("PADDLE_TPU_KV_FP8_SCALE", "1.0"))
+    if not s > 0.0:
+        raise ValueError(f"PADDLE_TPU_KV_FP8_SCALE={s}: must be > 0")
+    return s
+
+
+def quantize_kv_fp8(x, scale: float):
+    """Static-scale f8e4m3fn: ``clip(x / scale, ±FP8_MAX)`` cast to fp8.
+    The clip makes saturation explicit — e4m3fn has no inf, so an
+    unclipped overflow would silently wrap to NaN and the decode path's
+    non-finite tripwire would fire far from the cause."""
+    import jax.numpy as jnp
+
+    xf = x.astype(jnp.float32) / scale
+    return jnp.clip(xf, -FP8_MAX, FP8_MAX).astype(jnp.float8_e4m3fn)
+
+
+def dequantize_kv_fp8(q, scale: float):
+    """Inverse of :func:`quantize_kv_fp8`: f32 values ``q * scale``."""
+    import jax.numpy as jnp
+
+    return q.astype(jnp.float32) * scale
 
 
 def observe_kv_absmax(samples) -> float:
@@ -122,9 +157,12 @@ def kv_page_bytes(page_tokens: int, kv_heads: int, head_dim: int,
 def kv_scale_page_bytes(page_tokens: int, kv_heads: int, kv_dtype: str,
                         *, n_layers: int = 1) -> int:
     """Bytes of one page's k+v scale slices (f32 per token-slot per
-    kv-head); zero for the unquantized dtype."""
+    kv-head).  Zero for bf16 (no quantization) AND for fp8: its scale is
+    a single static scalar baked into the compiled programs, not a
+    per-token plane — which is what makes an fp8 page land at exactly
+    half the bf16 page bytes while int8's total exceeds half."""
     from ..analysis.program import DTYPE_BYTES
 
-    if kv_dtype == "bf16":
+    if kv_dtype in ("bf16", "fp8"):
         return 0
     return 2 * n_layers * page_tokens * kv_heads * DTYPE_BYTES["f32"]

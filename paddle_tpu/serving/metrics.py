@@ -126,6 +126,16 @@ class SLOMeter:
         self.spec_verify_steps = 0
         self.spec_rows_total = 0
         self.kv_bytes_per_token: Optional[float] = None
+        # host-RAM KV offload tier (long-context ladder): swap traffic in
+        # pages and bytes, plus the token denominator the recall-MBU
+        # gauge divides by (replays excluded — recall exists precisely so
+        # tokens are NOT recomputed)
+        self.offloads_total = 0
+        self.recalls_total = 0
+        self.offload_stalls_total = 0
+        self.offload_bytes_out_total = 0
+        self.recall_bytes_in_total = 0
+        self.tokens_out_total = 0
         # per-replica decode-speed trend: EWMA of finished requests' TPOT.
         # The fleet frontend compares this against the fleet median to
         # eject a degraded (slow-chip) replica from routing.
@@ -214,8 +224,7 @@ class SLOMeter:
         c.n_tokens += 1
         self._count_token(c)
 
-    @staticmethod
-    def _count_token(c: RequestClock) -> None:
+    def _count_token(self, c: RequestClock) -> None:
         """Recomputing an already-produced token after an eviction is
         replay WORK, not new output — count the two separately so the
         bench's token totals match what the stream actually delivered."""
@@ -223,6 +232,7 @@ class SLOMeter:
             bump("serving.tokens_replayed")
         else:
             bump("serving.tokens_generated")
+            self.tokens_out_total += 1
 
     def evict(self, rid, *, reason: str, pages_freed: int) -> None:
         c = self._clocks[rid]
@@ -237,6 +247,60 @@ class SLOMeter:
                      pages_freed=pages_freed, evictions=c.evictions,
                      trace=c.trace_id)
         bump("serving.evictions")
+
+    def offload(self, rid, *, pages: int, shared_pages: int,
+                bytes_out: int) -> None:
+        """A preempted request's private KV pages swapped to the host
+        tier (shared pages stay resident and move zero bytes).  Unlike
+        :meth:`evict`, the token milestones STAND — nothing will be
+        recomputed; the recall scatter restores the exact cache state."""
+        c = self._clocks[rid]
+        self.offloads_total += 1
+        self.offload_bytes_out_total += int(bytes_out)
+        record_event("serve_offload", str(rid), pages=pages,
+                     shared_pages=shared_pages, bytes_out=int(bytes_out),
+                     trace=c.trace_id)
+        bump("serving.kv_offloads_total")
+        bump("serving.kv_offload_bytes_out_total", int(bytes_out))
+
+    def recall(self, rid, *, pages: int, bytes_in: int,
+               n_tokens: int) -> None:
+        """A parked request's frames streamed back from the host tier and
+        re-activated — ``n_tokens`` generated tokens resume without
+        recompute.  The recall traffic prices into the MBU story through
+        :meth:`kv_recall_bytes_per_token`."""
+        c = self._clocks[rid]
+        self.recalls_total += 1
+        self.recall_bytes_in_total += int(bytes_in)
+        record_event("serve_recall", str(rid), pages=pages,
+                     bytes_in=int(bytes_in), n_tokens=int(n_tokens),
+                     trace=c.trace_id)
+        bump("serving.kv_recalls_total")
+        bump("serving.kv_recall_bytes_in_total", int(bytes_in))
+        set_gauge("serving.kv_recall_bytes_per_token",
+                  self.kv_recall_bytes_per_token())
+
+    def offload_stall(self, rid) -> None:
+        """A parked request whose host frames were LRU-dropped before
+        recall: it downgrades to the eviction-replay re-prefill path (the
+        failure-matrix "offload stall" row).  Token milestones reset like
+        an eviction — the replay recomputes them."""
+        c = self._clocks[rid]
+        self.offload_stalls_total += 1
+        self.evictions_total += 1
+        c.evictions += 1
+        c.replay_watermark = max(c.replay_watermark, c.n_tokens)
+        c.n_tokens = 0
+        record_event("serve_offload_stall", str(rid), trace=c.trace_id)
+        bump("serving.kv_offload_stalls_total")
+
+    def kv_recall_bytes_per_token(self) -> float:
+        """Host→HBM recall traffic amortized over every NEW token the
+        engine produced — the term the long-context MBU accounting adds
+        on top of ``kv_bytes_per_token`` (0.0 until a recall happens)."""
+        if self.tokens_out_total <= 0:
+            return 0.0
+        return self.recall_bytes_in_total / self.tokens_out_total
 
     def shed(self, rid, *, reason: str) -> None:
         """A queued request dropped by deadline shedding (or recovery of a
@@ -418,6 +482,13 @@ class SLOMeter:
                 round(self.effective_tokens_per_step(), 4)
                 if self.spec_verify_steps else None),
             "kv_bytes_per_token": self.kv_bytes_per_token,
+            "kv_offloads": self.offloads_total,
+            "kv_recalls": self.recalls_total,
+            "kv_offload_stalls": self.offload_stalls_total,
+            "kv_offload_bytes_out": self.offload_bytes_out_total,
+            "kv_recall_bytes_in": self.recall_bytes_in_total,
+            "kv_recall_bytes_per_token": round(
+                self.kv_recall_bytes_per_token(), 3),
             "tpot_ema_ms": _r(None if self.tpot_ema_s is None
                               else self.tpot_ema_s * 1e3),
         }

@@ -112,7 +112,13 @@ for _k, _v in (("PADDLE_TPU_SP", "1"),
                # depot KV-frame retention tests fast
                ("PADDLE_TPU_PREFIX_PAGES", "8"),
                ("PADDLE_TPU_DISAGG_MIN_PROMPT", "9"),
-               ("PADDLE_TPU_DISAGG_TTL", "1.0")):
+               ("PADDLE_TPU_DISAGG_TTL", "1.0"),
+               # long-context ladder: a small host-RAM offload tier so the
+               # LRU-drop ("offload stall") downgrade path is reachable
+               # with tier-1-sized traffic; CP degree stays 1 by default —
+               # CP tests pass cp=2 explicitly against the 8 virtual
+               # devices pinned above
+               ("PADDLE_TPU_KV_OFFLOAD_PAGES", "16")):
     os.environ.setdefault(_k, _v)
 
 import jax  # noqa: E402
@@ -128,6 +134,10 @@ def pytest_configure(config):
         "markers",
         "slow: heavy tests (many XLA compiles / multi-process); run the fast "
         "lane with -m 'not slow', the heavies with -m slow")
+    config.addinivalue_line(
+        "markers",
+        "longctx: long-context serving ladder (CP prefill, KV offload, fp8 "
+        "pages); tier-1 fast lane, select with -m longctx")
 
 
 @pytest.fixture
